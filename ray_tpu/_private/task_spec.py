@@ -1,0 +1,65 @@
+"""Task/actor specifications exchanged between driver, head, and workers.
+
+Capability parity with the reference's ``TaskSpecification``
+(reference: ``src/ray/common/task/task_spec.h``) and its scheduling-strategy
+oneof (reference: ``src/ray/protobuf/common.proto:111-122``): default,
+spread, node-affinity, and placement-group strategies are all expressible.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class SchedulingStrategy:
+    """Default hybrid policy unless a specific target is set."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    # Function payload: ("kv", function_key) once exported, or ("inline", bytes).
+    function_ref: Tuple[str, Any]
+    # Serialized call args: list of ("inline", frames) | ("ref", ObjectRef meta).
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs_keys: List[str] = field(default_factory=list)  # trailing args are kwargs
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_count: int = 0
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    seq_no: int = -1  # per-handle ordering for actor tasks
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    name: str = ""
+    runtime_env: Optional[Dict[str, Any]] = None
+    owner_address: Any = None  # socket address of the submitting process
+    # Streaming generator support
+    is_generator: bool = False
+
+    def return_object_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
+        ]

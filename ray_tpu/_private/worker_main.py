@@ -1,0 +1,61 @@
+"""Worker process entry point (reference capability: default_worker.py).
+
+Spawned by the head's worker pool; registers back over the head socket and
+then serves ``push_task`` / ``create_actor`` RPCs until terminated.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--head-sock", required=True)
+    args = parser.parse_args()
+
+    # Import after arg parsing to keep failure messages clean.
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.ids import WorkerID
+    from ray_tpu.core.worker import CoreWorker
+
+    core = CoreWorker(
+        session_dir=args.session_dir,
+        head_sock=args.head_sock,
+        mode="worker",
+        config=Config(),
+        worker_id=WorkerID.from_hex(args.worker_id),
+    )
+    core.start()
+
+    # Register with the head: announce our serving socket.
+    core.head_call("register_worker", {
+        "worker_id": args.worker_id,
+        "address": core.sock_path,
+        "pid": os.getpid(),
+    }, timeout=30)
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+            core.flush_task_events()
+    finally:
+        core.shutdown()
+
+
+if __name__ == "__main__":
+    main()

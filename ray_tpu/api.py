@@ -1,0 +1,402 @@
+"""Public API: init/shutdown, @remote tasks & actors, get/put/wait.
+
+Capability parity with the reference's Python frontend
+(reference: ``python/ray/_private/worker.py:1216`` ``ray.init``,
+``remote_function.py:266`` and ``actor.py`` for ``@ray.remote``), designed
+fresh for this runtime.
+"""
+from __future__ import annotations
+
+import asyncio
+import atexit
+import functools
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ._private.config import Config, set_global_config
+from ._private.head import HeadService
+from ._private.ids import ActorID, PlacementGroupID
+from ._private.task_spec import SchedulingStrategy
+from .core.worker import CoreWorker, ObjectRef
+from .exceptions import RayTpuError
+
+_init_lock = threading.Lock()
+_global_state: Dict[str, Any] = {"core": None, "head_thread": None}
+
+
+class _HeadThread:
+    """Runs the head service on a dedicated asyncio loop thread."""
+
+    def __init__(self, session_dir: str, config: Config,
+                 resources: Dict[str, float]):
+        self.session_dir = session_dir
+        self.config = config
+        self.resources = resources
+        self.head: Optional[HeadService] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="rt-head",
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        self._ready.wait(timeout=30)
+        return self
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self.head = HeadService(self.session_dir, self.config, self.resources)
+        self._loop.run_until_complete(self.head.start())
+        self._ready.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.run_until_complete(self.head.stop())
+            self._loop.close()
+
+    def stop(self):
+        if self._loop and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
+def is_initialized() -> bool:
+    return _global_state["core"] is not None
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         system_config: Optional[Dict[str, Any]] = None,
+         namespace: str = "default", ignore_reinit_error: bool = False):
+    """Start (or connect to) a cluster and attach this process as driver."""
+    with _init_lock:
+        if _global_state["core"] is not None:
+            if ignore_reinit_error:
+                return _global_state["core"]
+            raise RayTpuError("ray_tpu.init() already called "
+                              "(use ignore_reinit_error=True)")
+        cfg_overrides = dict(system_config or {})
+        if object_store_memory is not None:
+            cfg_overrides["object_store_memory"] = object_store_memory
+        config = Config(cfg_overrides)
+        set_global_config(config)
+
+        if address is None:
+            session_dir = os.path.join(
+                os.environ.get("TMPDIR", "/tmp"), "ray_tpu",
+                f"session_{int(time.time() * 1000)}_{os.getpid()}")
+            os.makedirs(session_dir, exist_ok=True)
+            total = dict(resources or {})
+            total.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                          else max(8, os.cpu_count() or 1)))
+            if num_tpus is not None:
+                total.setdefault("TPU", float(num_tpus))
+            else:
+                total.setdefault("TPU", float(_detect_tpu_chips()))
+            total.setdefault("memory", float(
+                os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")))
+            head_thread = _HeadThread(session_dir, config, total).start()
+            head_sock = head_thread.head.sock_path
+            _global_state["head_thread"] = head_thread
+        else:
+            head_sock = address
+            session_dir = os.path.dirname(address)
+
+        core = CoreWorker(session_dir=session_dir, head_sock=head_sock,
+                          mode="driver", config=config)
+        core.start()
+        _global_state["core"] = core
+        atexit.register(_atexit_shutdown)
+        return core
+
+
+def _detect_tpu_chips() -> int:
+    """Count local TPU chips without importing jax (cheap heuristics)."""
+    env = os.environ.get("TPU_VISIBLE_CHIPS") or os.environ.get(
+        "TPU_VISIBLE_DEVICES")
+    if env:
+        return len([c for c in env.split(",") if c.strip()])
+    import glob
+
+    accels = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*")
+    if accels:
+        return len(accels)
+    if os.environ.get("JAX_PLATFORMS", "").startswith("tpu") or \
+            "axon" in os.environ.get("JAX_PLATFORMS", ""):
+        return 1
+    return 0
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    with _init_lock:
+        core: CoreWorker = _global_state.get("core")
+        if core is not None:
+            try:
+                core.release_all_leases()
+            except Exception:
+                pass
+            core.shutdown()
+            _global_state["core"] = None
+        ht = _global_state.get("head_thread")
+        if ht is not None:
+            ht.stop()
+            _global_state["head_thread"] = None
+
+
+def _core() -> CoreWorker:
+    return CoreWorker.current()
+
+
+def put(value: Any) -> ObjectRef:
+    return _core().put(value)
+
+
+def get(refs, timeout: Optional[float] = None):
+    return _core().get(refs, timeout=timeout)
+
+
+def wait(refs: List[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    return _core().wait(refs, num_returns=num_returns, timeout=timeout,
+                        fetch_local=fetch_local)
+
+
+def kill(actor_handle: "ActorHandle", *, no_restart: bool = True):
+    _core().kill_actor(actor_handle._actor_id, no_restart=no_restart)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _core().head_call("cluster_resources")
+
+
+def available_resources() -> Dict[str, float]:
+    return _core().head_call("available_resources")
+
+
+def _resources_from_options(opts: Dict[str, Any]) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    num_tpus = opts.get("num_tpus")
+    res["CPU"] = float(1 if num_cpus is None else num_cpus)
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    res = {k: v for k, v in res.items() if v}
+    return res
+
+
+def _strategy_from_options(opts) -> Optional[SchedulingStrategy]:
+    s = opts.get("scheduling_strategy")
+    if s is None or s == "DEFAULT":
+        return SchedulingStrategy()
+    if s == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    if isinstance(s, PlacementGroupSchedulingStrategy):
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=s.placement_group._id,
+            bundle_index=s.placement_group_bundle_index,
+            capture_child_tasks=s.placement_group_capture_child_tasks)
+    if isinstance(s, SchedulingStrategy):
+        return s
+    raise ValueError(f"bad scheduling_strategy {s!r}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Dict[str, Any]):
+        self._fn = fn
+        self._options = options
+        self._fn_key: Optional[str] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            "remote functions cannot be called directly; use .remote()")
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(opts)
+        rf = RemoteFunction(self._fn, merged)
+        rf._fn_key = self._fn_key
+        return rf
+
+    def remote(self, *args, **kwargs):
+        core = _core()
+        if self._fn_key is None:
+            self._fn_key = core.export_function(self._fn)
+        num_returns = self._options.get("num_returns", 1)
+        refs = core.submit_task(
+            self._fn_key, args, kwargs,
+            num_returns=num_returns,
+            resources=_resources_from_options(self._options),
+            max_retries=self._options.get("max_retries"),
+            strategy=_strategy_from_options(self._options),
+            name=self._options.get("name") or self._fn.__name__,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        core = _core()
+        refs = core.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns)
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:14]}…)"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+    def _wait_ready(self, timeout=None):
+        _core().wait_actor_ready(self._actor_id, timeout)
+        return self
+
+
+class ActorClass:
+    def __init__(self, cls, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = options
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *a, **kw):
+        raise TypeError("actor classes must be instantiated with .remote()")
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = _core()
+        actor_id = core.create_actor(
+            self._cls, args, kwargs,
+            resources=_resources_from_options(self._options),
+            name=self._options.get("name") or "",
+            max_restarts=self._options.get("max_restarts", 0),
+            max_concurrency=self._options.get("max_concurrency", 1),
+            strategy=_strategy_from_options(self._options),
+            lifetime=self._options.get("lifetime"),
+        )
+        return ActorHandle(actor_id)
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes."""
+
+    def decorate(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and not options and callable(args[0]):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return decorate
+
+
+def get_actor(name: str, timeout: float = 5.0) -> ActorHandle:
+    """Look up a named actor; retries briefly since registration is async."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            meta = _core().head_call("get_named_actor", {"name": name})
+            return ActorHandle(ActorID.from_hex(meta["actor_id"]))
+        except Exception:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+def list_actors() -> List[dict]:
+    return _core().head_call("list_actors")
+
+
+def timeline() -> List[dict]:
+    """Task timeline events (chrome://tracing-style records)."""
+    _core().flush_task_events()
+    return _core().head_call("get_task_events", {"limit": 100000})
+
+
+# --------------------------------------------------------------- placement
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[dict]):
+        self._id = pg_id
+        self.bundle_specs = bundles
+
+    def ready(self, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = _core().head_call("pg_state", {"pg_id": self._id.hex()})
+            if st["state"] == "CREATED":
+                return True
+            if st["state"] == "REMOVED":
+                raise RayTpuError("placement group removed")
+            time.sleep(0.02)
+        raise TimeoutError("placement group not ready")
+
+    def __reduce__(self):
+        return (PlacementGroup, (self._id, self.bundle_specs))
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks)
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime=None) -> PlacementGroup:
+    pg_id = PlacementGroupID.from_random()
+    payload = {"pg_id": pg_id.hex(), "bundles": bundles, "strategy": strategy,
+               "name": name}
+    core = _core()
+
+    def _create():
+        try:
+            core.head_call("create_placement_group", payload, timeout=120)
+        except Exception:
+            pass
+
+    threading.Thread(target=_create, daemon=True).start()
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    _core().head_call("remove_placement_group", {"pg_id": pg._id.hex()})

@@ -1,0 +1,49 @@
+"""Test fixtures: virtual 8-device CPU mesh for jax + mini-cluster fixtures.
+
+Mirrors the reference's test strategy (SURVEY.md §4): real mini-clusters
+in-process per fixture, the same way ``ray_start_regular`` works
+(reference: ``python/ray/tests/conftest.py:419``).
+"""
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RT_HEALTH_CHECK_PERIOD_S", "0.2")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt_cluster():
+    """A running 8-CPU cluster, reused across tests (re-inits if torn down)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=8, num_tpus=0, ignore_reinit_error=True)
+    yield rt
+    # Leave running for reuse; session-level atexit handles final teardown.
+
+
+@pytest.fixture
+def rt_fresh():
+    """A fresh cluster per test (for failure-injection tests)."""
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    rt.init(num_cpus=8, num_tpus=0)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    """Ensure jax sees 8 virtual CPU devices."""
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {devs}"
+    return devs

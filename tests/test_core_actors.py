@@ -1,0 +1,196 @@
+"""Actors: creation, ordering, concurrency, restarts, named actors
+(reference: python/ray/tests/test_actor*.py)."""
+import time
+
+import pytest
+
+
+def test_actor_basic(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class Counter:
+        def __init__(self, v=0):
+            self.v = v
+
+        def inc(self, n=1):
+            self.v += n
+            return self.v
+
+    c = Counter.remote(5)
+    assert rt.get(c.inc.remote()) == 6
+    assert rt.get(c.inc.remote(4)) == 10
+
+
+def test_actor_ordering(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def read(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(20):
+        log.append.remote(i)
+    assert rt.get(log.read.remote()) == list(range(20))
+
+
+def test_actor_state_isolation(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class Holder:
+        def __init__(self):
+            self.v = 0
+
+        def bump(self):
+            self.v += 1
+            return self.v
+
+    a, b = Holder.remote(), Holder.remote()
+    assert rt.get(a.bump.remote()) == 1
+    assert rt.get(a.bump.remote()) == 2
+    assert rt.get(b.bump.remote()) == 1
+
+
+def test_async_actor_concurrency(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class A:
+        async def go(self):
+            import asyncio
+
+            await asyncio.sleep(0.2)
+            return 1
+
+    a = A.options(max_concurrency=10).remote()
+    rt.get(a.go.remote())  # warm: actor worker spawn + first call
+    t0 = time.time()
+    assert sum(rt.get([a.go.remote() for _ in range(10)])) == 10
+    assert time.time() - t0 < 1.5  # concurrent, not 2s serial
+
+
+def test_named_actor(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc_test").remote()
+    h = rt.get_actor("svc_test")
+    assert rt.get(h.ping.remote()) == "pong"
+
+
+def test_actor_handle_passing(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @rt.remote
+    def writer(store, k, v):
+        rt.get(store.set.remote(k, v))
+        return True
+
+    s = Store.remote()
+    assert rt.get(writer.remote(s, "x", 42))
+    assert rt.get(s.get.remote("x")) == 42
+
+
+def test_actor_error(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(rt.exceptions.TaskError):
+        rt.get(b.fail.remote())
+    # Actor survives method errors.
+    assert rt.get(b.ok.remote()) == 1
+
+
+def test_kill_actor(rt_fresh):
+    rt = rt_fresh
+
+    @rt.remote
+    class K:
+        def ping(self):
+            return 1
+
+    k = K.remote()
+    assert rt.get(k.ping.remote()) == 1
+    rt.kill(k)
+    time.sleep(0.3)
+    with pytest.raises(Exception):
+        rt.get(k.ping.remote(), timeout=10)
+
+
+def test_actor_restart(rt_fresh):
+    rt = rt_fresh
+
+    @rt.remote
+    class Dier:
+        def __init__(self):
+            self.n = 0
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            self.n += 1
+            return self.n
+
+    d = Dier.options(max_restarts=2).remote()
+    assert rt.get(d.ping.remote()) == 1
+    d.crash.remote()
+    # Wait for head to detect death + restart.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            # Fresh instance => counter reset to 1.
+            if rt.get(d.ping.remote(), timeout=10) == 1:
+                break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_list_actors(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    class L:
+        def x(self):
+            return 1
+
+    L.options(name="listed_actor").remote()
+    infos = rt.list_actors()
+    names = {i["name"] for i in infos}
+    assert "listed_actor" in names

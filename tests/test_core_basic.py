@@ -1,0 +1,185 @@
+"""Core runtime: tasks, objects, errors (reference: python/ray/tests/test_basic.py)."""
+import time
+
+import numpy as np
+import pytest
+
+
+def test_put_get(rt_cluster):
+    rt = rt_cluster
+    ref = rt.put({"a": 1, "b": [1, 2, 3]})
+    assert rt.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_large_object_shm(rt_cluster):
+    rt = rt_cluster
+    arr = np.random.rand(500_000).astype(np.float32)
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def f(x):
+        return x + 1
+
+    assert rt.get(f.remote(1)) == 2
+
+
+def test_task_fanout(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert rt.get(refs) == [i * i for i in range(50)]
+
+
+def test_task_args_kwargs(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def g(a, b, c=0, d=0):
+        return a + b + c + d
+
+    assert rt.get(g.remote(1, 2, c=3, d=4)) == 10
+
+
+def test_task_ref_arg(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def h(x):
+        return x * 2
+
+    ref = rt.put(21)
+    assert rt.get(h.remote(ref)) == 42
+
+
+def test_chained_tasks(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    r = inc.remote(0)
+    for _ in range(5):
+        r = inc.remote(r)
+    assert rt.get(r) == 6
+
+
+def test_large_arg_and_return(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def double(a):
+        return a * 2
+
+    arr = np.arange(300_000, dtype=np.float64)
+    out = rt.get(double.remote(arr))
+    np.testing.assert_array_equal(out, arr * 2)
+
+
+def test_num_returns(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_error_propagation(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def boom():
+        raise KeyError("missing")
+
+    with pytest.raises(rt.exceptions.TaskError) as ei:
+        rt.get(boom.remote())
+    assert ei.value.cause_type == "KeyError"
+
+
+def test_error_through_chain(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def boom():
+        raise ValueError("first")
+
+    @rt.remote
+    def passthrough(x):
+        return x
+
+    with pytest.raises(rt.exceptions.TaskError):
+        rt.get(passthrough.remote(boom.remote()))
+
+
+def test_wait(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def fast():
+        return "fast"
+
+    @rt.remote
+    def slow():
+        time.sleep(1.0)
+        return "slow"
+
+    rs, rf = slow.remote(), fast.remote()
+    ready, not_ready = rt.wait([rs, rf], num_returns=1, timeout=5)
+    assert ready == [rf]
+    assert not_ready == [rs]
+    ready, not_ready = rt.wait([rs, rf], num_returns=2, timeout=10)
+    assert len(ready) == 2
+
+
+def test_get_timeout(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def hang():
+        time.sleep(10)
+
+    with pytest.raises(rt.exceptions.GetTimeoutError):
+        rt.get(hang.remote(), timeout=0.3)
+
+
+def test_cluster_resources(rt_cluster):
+    rt = rt_cluster
+    total = rt.cluster_resources()
+    assert total["CPU"] == 8.0
+
+
+def test_options_name(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def named():
+        return 1
+
+    assert rt.get(named.options(name="custom").remote()) == 1
+
+
+def test_nested_tasks(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def inner(x):
+        return x + 1
+
+    @rt.remote
+    def outer(x):
+        return rt.get(inner.remote(x)) + 10
+
+    assert rt.get(outer.remote(0)) == 11

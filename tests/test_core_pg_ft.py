@@ -1,0 +1,95 @@
+"""Placement groups + fault tolerance
+(reference: python/ray/tests/test_placement_group*.py, test_reconstruction*.py)."""
+import time
+
+import pytest
+
+
+def test_pg_create_ready(rt_cluster):
+    rt = rt_cluster
+    pg = rt.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=15)
+    rt.remove_placement_group(pg)
+
+
+def test_pg_schedule_into_bundle(rt_cluster):
+    rt = rt_cluster
+    pg = rt.placement_group([{"CPU": 2}], strategy="PACK")
+    pg.ready(timeout=15)
+
+    @rt.remote
+    def f():
+        return "in-bundle"
+
+    s = rt.PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    assert rt.get(f.options(scheduling_strategy=s).remote()) == "in-bundle"
+    rt.remove_placement_group(pg)
+
+
+def test_pg_actor_in_bundle(rt_cluster):
+    rt = rt_cluster
+    pg = rt.placement_group([{"CPU": 1}], strategy="PACK")
+    pg.ready(timeout=15)
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return 1
+
+    s = rt.PlacementGroupSchedulingStrategy(pg)
+    a = A.options(scheduling_strategy=s).remote()
+    assert rt.get(a.ping.remote()) == 1
+    rt.kill(a)
+    rt.remove_placement_group(pg)
+
+
+def test_pg_infeasible(rt_cluster):
+    rt = rt_cluster
+    pg = rt.placement_group([{"CPU": 1000}], strategy="PACK")
+    with pytest.raises(Exception):
+        pg.ready(timeout=1.0)
+
+
+def test_pg_resources_returned_after_remove(rt_cluster):
+    rt = rt_cluster
+    before = rt.available_resources()["CPU"]
+    pg = rt.placement_group([{"CPU": 4}])
+    pg.ready(timeout=15)
+    during = rt.available_resources()["CPU"]
+    assert during <= before - 4
+    rt.remove_placement_group(pg)
+    time.sleep(0.2)
+    after = rt.available_resources()["CPU"]
+    assert after >= before - 0.01
+
+
+def test_task_retry_on_worker_death(rt_fresh):
+    rt = rt_fresh
+
+    @rt.remote(max_retries=3)
+    def flaky(marker_path):
+        import os
+
+        # Die the first time, succeed on retry (marker file persists).
+        if not os.path.exists(marker_path):
+            open(marker_path, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    import tempfile
+
+    marker = tempfile.mktemp()
+    assert rt.get(flaky.remote(marker), timeout=60) == "recovered"
+
+
+def test_worker_crash_no_retry(rt_fresh):
+    rt = rt_fresh
+
+    @rt.remote(max_retries=0)
+    def die():
+        import os
+
+        os._exit(1)
+
+    with pytest.raises(Exception):
+        rt.get(die.remote(), timeout=60)
