@@ -1,0 +1,20 @@
+"""Collective communication (reference: ``python/ray/util/collective/``).
+
+Backends: ``"xla"`` (mesh-axis group; lax collectives over ICI) and
+``"store"`` (cross-actor host-side rendezvous through the head KV).
+"""
+from .collective import (  # noqa: F401
+    BaseGroup,
+    StoreGroup,
+    XlaMeshGroup,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_group,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)

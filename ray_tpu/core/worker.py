@@ -937,6 +937,27 @@ class CoreWorker:
     def head_call(self, method: str, payload=None, timeout=30.0):
         return self.run_sync(self._head.call_simple(method, payload), timeout)
 
+    def kv_put(self, key: str, value: bytes, ns: str = "default",
+               overwrite: bool = True) -> bool:
+        meta = self.run_sync(self._head.call(
+            "kv_put", {"ns": ns, "key": key, "overwrite": overwrite},
+            [bytes(value)]), 30)[0]
+        return bool(meta.get("added"))
+
+    def kv_get(self, key: str, ns: str = "default"):
+        meta, bufs = self.run_sync(
+            self._head.call("kv_get", {"ns": ns, "key": key}), 30)
+        if not meta.get("found"):
+            return None
+        return bufs[0] if bufs else b""
+
+    def kv_del(self, key: str, ns: str = "default") -> bool:
+        return bool(self.head_call("kv_del", {"ns": ns, "key": key})
+                    .get("deleted"))
+
+    def kv_keys(self, prefix: str = "", ns: str = "default"):
+        return self.head_call("kv_keys", {"ns": ns, "prefix": prefix})
+
     def flush_task_events(self):
         if self._task_events:
             evs = list(self._task_events)

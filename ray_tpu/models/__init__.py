@@ -1,0 +1,8 @@
+"""Model zoo: TPU-first reference models used by train/serve/rllib/bench.
+
+The reference framework wraps user-supplied torch models; here the zoo is
+part of the framework so every library and benchmark has a real MXU-bound
+workload out of the box.
+"""
+from . import gpt  # noqa: F401
+from .gpt import CONFIGS, GPTConfig  # noqa: F401

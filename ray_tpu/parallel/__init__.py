@@ -1,0 +1,25 @@
+"""Device meshes, sharding rules, and parallelism plans (TPU-native core).
+
+Replaces the reference's process-group plumbing (NCCL/gloo rendezvous,
+torch DDP/FSDP wrapping — ``python/ray/train/torch/config.py``,
+``train_loop_utils.py``) with jax Mesh + NamedSharding: the compiler, not
+the framework, owns the collective schedule.
+"""
+from .mesh import (  # noqa: F401
+    AXIS_ORDER,
+    MeshConfig,
+    create_mesh,
+    initialize_multihost,
+    local_chip_count,
+    mesh_shape,
+    single_device_mesh,
+)
+from .sharding import (  # noqa: F401
+    DP_RULES,
+    LM_RULES,
+    batch_sharding,
+    replicated,
+    shard_tree,
+    spec_for,
+    tree_shardings,
+)

@@ -1,0 +1,35 @@
+"""Test/dev helpers: virtual device meshes without TPU hardware.
+
+The reference tests distributed behavior with in-process multi-raylet
+clusters (``python/ray/cluster_utils.py:135``); the analogous trick for the
+numeric plane is XLA's virtual host-device flag — N CPU "chips" in one
+process so every mesh/sharding path compiles and runs without a slice.
+"""
+from __future__ import annotations
+
+import os
+
+
+def force_host_devices(n: int = 8) -> None:
+    """Force this process (and children) onto N virtual CPU devices.
+
+    Must be called before the first jax backend use in this process.
+    Also scrubs env so spawned worker processes inherit the CPU platform
+    (any vendor PJRT plugin registered by sitecustomize is bypassed).
+    """
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def assert_device_count(n: int) -> None:
+    import jax
+
+    got = len(jax.devices())
+    assert got >= n, f"need >= {n} devices, have {got}"

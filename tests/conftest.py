@@ -6,12 +6,11 @@ in-process per fixture, the same way ``ray_start_regular`` works
 """
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# Must run before jax backends initialize anywhere in the test process.
+# (Handles vendor PJRT plugins force-registered by sitecustomize too.)
+from ray_tpu.testing import force_host_devices  # noqa: E402
+
+force_host_devices(8)
 os.environ.setdefault("RT_HEALTH_CHECK_PERIOD_S", "0.2")
 
 import pytest  # noqa: E402
