@@ -155,10 +155,17 @@ class XlaMeshGroup(BaseGroup):
         return self._op("alltoall")(self._sharded(x))
 
     def broadcast(self, x, src_rank=0):
+        """x is [world, ...]; returns rank ``src_rank``'s slice replicated."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        return jax.device_put(x, NamedSharding(self.mesh, P()))
+        key = ("broadcast",)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                lambda v, i: jax.lax.dynamic_index_in_dim(
+                    v, i, axis=0, keepdims=False),
+                out_shardings=NamedSharding(self.mesh, P()))
+        return self._jit_cache[key](self._sharded(x), src_rank)
 
     def barrier(self):
         import jax
@@ -208,15 +215,16 @@ class StoreGroup(BaseGroup):
         return (f"__coll__/{self.name}/{gen}/{what}/{tag}/{rank}")
 
     def _gc(self, gen: int):
-        # By the time this rank starts gen g, every rank finished gen g-1,
-        # which required reading all gen g-2 slots — safe to delete ours.
+        # Every op routes through _gather_to_all, so starting gen g means
+        # this rank finished gen g-1, which required ALL ranks to have
+        # written gen g-1 — hence all ranks read every gen g-2 slot.
+        # Safe to delete our own g-2 slot.
         if gen >= 2:
-            for what in ("ag", "bc"):
-                try:
-                    self._core.kv_del(self._slot(gen - 2, what, self.rank),
-                                      ns="collective")
-                except Exception:
-                    pass
+            try:
+                self._core.kv_del(self._slot(gen - 2, "ag", self.rank),
+                                  ns="collective")
+            except Exception:
+                pass
 
     # -- collectives ------------------------------------------------------
     def _gather_to_all(self, x) -> List[Any]:
@@ -253,13 +261,12 @@ class StoreGroup(BaseGroup):
         return np.split(full, self.world_size)[self.rank]
 
     def broadcast(self, x, src_rank=0):
-        gen = self._gen
-        self._gen += 1
-        self._gc(gen)
-        if self.rank == src_rank:
-            self._kv_put(self._slot(gen, "bc", src_rank), _encode(x))
-            return x
-        return _decode(self._kv_get(self._slot(gen, "bc", src_rank)))
+        # Symmetric gather (everyone publishes, src's value wins) so the
+        # _gc generation invariant holds for broadcast too — an
+        # asymmetric fast path would let the src delete slots receivers
+        # haven't read yet.
+        vals = self._gather_to_all(x if self.rank == src_rank else None)
+        return vals[src_rank]
 
     def barrier(self):
         self._gather_to_all(0)

@@ -1,0 +1,34 @@
+"""ray_tpu.train — distributed training library.
+
+Reference surface: ``python/ray/train/`` (SURVEY.md §2.5). The torch
+process-group backend is replaced by jax mesh rendezvous; checkpoints are
+directory-based and written by workers straight to storage.
+"""
+from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .backend_executor import (  # noqa: F401
+    Backend,
+    BackendExecutor,
+    JaxBackend,
+    JaxBackendConfig,
+    TrainingFailedError,
+)
+from .session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from .trainer import (  # noqa: F401
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+    TrainingIterator,
+)
+from .worker_group import RayTrainWorker, WorkerGroup  # noqa: F401

@@ -1,0 +1,197 @@
+"""BackendExecutor: gang placement + backend rendezvous + training drive.
+
+Reference: ``python/ray/train/_internal/backend_executor.py:66``
+(``start:124``, PG creation ``:206-229``, ``start_training:436``,
+``_restart:708``). The TPU-native backend replaces torch process-group
+rendezvous with either:
+
+- single-controller: ONE worker owns the whole mesh (the default on a
+  single host/slice — XLA SPMD does the scaling), or
+- multi-controller: every worker calls ``jax.distributed.initialize``
+  against rank-0's coordinator (DCN), after which each process sees the
+  global device set and builds the same Mesh.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as rt
+
+from .config import ScalingConfig
+from .worker_group import WorkerGroup
+
+
+class Backend:
+    """Per-framework hooks (reference ``train/backend.py`` Backend)."""
+
+    def on_start(self, worker_group: WorkerGroup, backend_config) -> None:
+        pass
+
+    def on_training_start(self, worker_group: WorkerGroup,
+                          backend_config) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup) -> None:
+        pass
+
+
+class JaxBackendConfig:
+    def __init__(self, multi_controller: bool = False,
+                 coordinator_port: int = 0):
+        self.multi_controller = multi_controller
+        self.coordinator_port = coordinator_port
+
+    def backend_cls(self):
+        return JaxBackend
+
+
+class JaxBackend(Backend):
+    """Mesh rendezvous (replaces ``_setup_torch_process_group``,
+    reference ``train/torch/config.py:65``)."""
+
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: JaxBackendConfig) -> None:
+        if not backend_config.multi_controller:
+            return
+        fixed_port = backend_config.coordinator_port
+
+        def get_host_port(fixed):
+            import socket as s
+
+            host = s.gethostbyname(s.gethostname())
+            if fixed:
+                return host, fixed
+            # probe the free port on the host that will bind it (rank 0)
+            sock = s.socket()
+            sock.bind(("", 0))
+            port = sock.getsockname()[1]
+            sock.close()
+            return host, port
+
+        host, port = worker_group.execute_single(0, get_host_port,
+                                                 fixed_port)
+        coord = f"{host}:{port}"
+        n = len(worker_group)
+
+        def init_dist(coord, n, rank):
+            from ray_tpu.parallel import initialize_multihost
+
+            initialize_multihost(coordinator_address=coord,
+                                 num_processes=n, process_id=rank)
+            return True
+
+        refs = [w.execute.remote(init_dist, coord, n, rank)
+                for rank, w in enumerate(worker_group.workers)]
+        rt.get(refs, timeout=120)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, backend_config, scaling_config: ScalingConfig,
+                 max_failures: int = 0,
+                 env: Optional[Dict[str, str]] = None):
+        self.backend_config = backend_config
+        self.scaling = scaling_config
+        self.max_failures = max_failures
+        self.env = env or {}
+        self.backend: Backend = backend_config.backend_cls()()
+        self.worker_group: Optional[WorkerGroup] = None
+        self.placement_group = None
+        self._num_failures = 0
+        self._train_args: Optional[tuple] = None
+
+    # ------------------------------------------------------------- start
+    def start(self):
+        if self.scaling.num_workers > 1 or self.scaling.use_tpu:
+            self.placement_group = rt.placement_group(
+                self.scaling.bundles(),
+                strategy=self.scaling.placement_strategy)
+            self.placement_group.ready(timeout=60)
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers, self.scaling.worker_resources,
+            placement_group=self.placement_group, env=self.env)
+        self.worker_group.start()
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       session_kwargs_per_rank: List[Dict[str, Any]]):
+        self.backend.on_training_start(self.worker_group,
+                                       self.backend_config)
+        self._train_args = (train_fn, config, session_kwargs_per_rank)
+        refs = [
+            w.start_training.remote(train_fn, config,
+                                    session_kwargs_per_rank[rank])
+            for rank, w in enumerate(self.worker_group.workers)
+        ]
+        rt.get(refs, timeout=120)
+
+    # ------------------------------------------------------------- poll
+    def poll(self) -> Dict[str, Any]:
+        """One poll across workers → {"items": [...], "done": bool}.
+
+        Raises TrainingFailedError (after restarts are exhausted) if any
+        worker's loop raised or any worker actor died.
+        """
+        assert self.worker_group is not None
+        try:
+            outs = rt.get([w.poll.remote() for w in
+                           self.worker_group.workers], timeout=60)
+        except Exception as e:  # actor death → group restart
+            self._handle_failure(f"worker actor failure: {e!r}")
+            return {"items": [], "done": False, "restarted": True}
+        items: List[dict] = []
+        done = True
+        for rank, (reports, finished, err) in enumerate(outs):
+            if err:
+                self._handle_failure(f"rank {rank} train loop error:\n{err}")
+                return {"items": [], "done": False, "restarted": True}
+            items.extend(reports)
+            done = done and finished
+        return {"items": items, "done": done}
+
+    def _handle_failure(self, msg: str):
+        self._num_failures += 1
+        if self._num_failures > self.max_failures:
+            self.shutdown()
+            raise TrainingFailedError(
+                f"{msg}\n(failure {self._num_failures} > "
+                f"max_failures={self.max_failures})")
+        self._restart()
+
+    def set_latest_checkpoint(self, checkpoint) -> None:
+        """Patch resume-checkpoint into session kwargs for future restarts."""
+        if self._train_args is not None:
+            for kw in self._train_args[2]:
+                kw["latest_checkpoint"] = checkpoint
+
+    def _restart(self):
+        """Tear down the gang and rebuild; caller resumes from latest
+        checkpoint (reference ``backend_executor.py:708``)."""
+        assert self._train_args is not None
+        if self.worker_group:
+            self.worker_group.shutdown()
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers, self.scaling.worker_resources,
+            placement_group=self.placement_group, env=self.env)
+        self.worker_group.start()
+        self.backend.on_start(self.worker_group, self.backend_config)
+        train_fn, config, session_kwargs = self._train_args
+        self.start_training(train_fn, config, session_kwargs)
+
+    def shutdown(self):
+        if self.worker_group:
+            try:
+                self.backend.on_shutdown(self.worker_group)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self.placement_group is not None:
+            try:
+                rt.remove_placement_group(self.placement_group)
+            except Exception:
+                pass
+            self.placement_group = None

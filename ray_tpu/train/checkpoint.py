@@ -1,0 +1,158 @@
+"""Directory-based checkpoints + top-k retention.
+
+Reference: ``python/ray/train/_checkpoint.py`` (Checkpoint = dir on a
+pyarrow fs) and ``_internal/checkpoint_manager.py`` (top-k by metric).
+Workers upload directly to ``storage_path`` — the driver only tracks
+metadata, never relays checkpoint bytes (same dataflow as the reference's
+``_internal/storage.py``).
+
+For jax pytrees the payload helpers use ``orbax``-style flat msgpack via
+numpy ``.npz`` — no torch pickle; a checkpoint dir is portable across
+hosts and mesh shapes (params are saved unsharded per-leaf).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Checkpoint:
+    """A directory of files; the unit of save/restore."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path}
+
+    # ---- jax pytree payload helpers ------------------------------------
+    @classmethod
+    def from_state(cls, state: Any, base_dir: Optional[str] = None,
+                   name: str = "state") -> "Checkpoint":
+        """Save a pytree of arrays (gathers sharded jax arrays to host)."""
+        import numpy as np
+
+        try:
+            import jax
+            leaves, treedef = jax.tree_util.tree_flatten(state)
+            tree_repr = str(treedef)
+        except Exception:
+            leaves, tree_repr = [state], "leaf"
+        d = tempfile.mkdtemp(prefix="ckpt_", dir=base_dir)
+        arrs = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+        np.savez(os.path.join(d, f"{name}.npz"), **arrs)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump({"n_leaves": len(leaves), "treedef": tree_repr,
+                       "name": name}, f)
+        return cls(d)
+
+    def load_state(self, like: Any = None, name: str = "state") -> Any:
+        """Restore the pytree; ``like`` supplies structure (and shardings
+        if its leaves are jax arrays with shardings)."""
+        import numpy as np
+
+        with np.load(os.path.join(self.path, f"{name}.npz")) as z:
+            leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        if like is None:
+            return leaves
+        import jax
+
+        like_leaves, treedef = jax.tree_util.tree_flatten(like)
+        out = []
+        for host, ref in zip(leaves, like_leaves):
+            arr = host
+            sharding = getattr(ref, "sharding", None)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            out.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class _TrackedCheckpoint:
+    def __init__(self, checkpoint: Checkpoint, metrics: Dict[str, Any],
+                 index: int):
+        self.checkpoint = checkpoint
+        self.metrics = metrics
+        self.index = index
+
+
+class CheckpointManager:
+    """Top-k retention by score attribute (reference
+    ``_internal/checkpoint_manager.py``)."""
+
+    def __init__(self, storage_dir: str,
+                 num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.storage_dir = storage_dir
+        os.makedirs(storage_dir, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._tracked: List[_TrackedCheckpoint] = []
+        self._counter = 0
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        """Move the checkpoint dir under storage and apply retention."""
+        dst = os.path.join(self.storage_dir,
+                           f"checkpoint_{self._counter:06d}")
+        if os.path.abspath(checkpoint.path) != dst:
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.move(checkpoint.path, dst)
+        tracked = _TrackedCheckpoint(Checkpoint(dst), metrics, self._counter)
+        self._counter += 1
+        self._tracked.append(tracked)
+        self._apply_retention()
+        return tracked.checkpoint
+
+    def _score(self, t: _TrackedCheckpoint) -> Tuple:
+        """Higher tuple = better; a missing metric always ranks worst."""
+        if not self.score_attribute:
+            return (t.index,)
+        v = t.metrics.get(self.score_attribute)
+        if v is None:
+            return (float("-inf"), t.index)
+        v = float(v)
+        return (v if self.score_order == "max" else -v, t.index)
+
+    def _apply_retention(self):
+        if self.num_to_keep is None:
+            return
+        while len(self._tracked) > self.num_to_keep:
+            worst = min(self._tracked, key=self._score)
+            # never delete the most recent (resume anchor)
+            if worst is self._tracked[-1]:
+                worst = min(self._tracked[:-1], key=self._score)
+            self._tracked.remove(worst)
+            shutil.rmtree(worst.checkpoint.path, ignore_errors=True)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=self._score).checkpoint
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return self._tracked[-1].checkpoint if self._tracked else None
+
+    @property
+    def checkpoints(self) -> List[Checkpoint]:
+        return [t.checkpoint for t in self._tracked]

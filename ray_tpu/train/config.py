@@ -1,0 +1,74 @@
+"""Train configuration dataclasses.
+
+Reference surface: ``python/ray/air/config.py`` (``ScalingConfig``,
+``RunConfig``, ``FailureConfig``, ``CheckpointConfig``) — rebuilt with TPU
+as the first-class accelerator (``use_tpu``, chips per worker, topology).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one owns.
+
+    On TPU the natural unit is one worker actor per host driving that
+    host's chips through a shared Mesh (multi-controller), or a single
+    worker owning the whole slice (single-controller SPMD). ``use_tpu``
+    plus ``topology`` let the placement layer reserve whole ICI domains
+    (reference seeds this idea in ``_private/accelerators/tpu.py``).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: int = 0          # chips each worker actor owns
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None    # e.g. "v5e-16" — gang resource name
+
+    @property
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and self.tpus_per_worker:
+            res["TPU"] = float(self.tpus_per_worker)
+        return res
+
+    def bundles(self) -> List[Dict[str, float]]:
+        return [dict(self.worker_resources) for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Restart-the-group fault tolerance (reference
+    ``backend_executor.py:101-103``): a TPU slice is an ICI gang — one
+    failed worker poisons the mesh, so recovery is group restart from the
+    latest checkpoint, never per-worker retry."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        return base

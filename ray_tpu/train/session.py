@@ -1,0 +1,139 @@
+"""In-worker training session: report/get_checkpoint/get_dataset_shard.
+
+Reference: ``python/ray/train/_internal/session.py`` (``_TrainSession:110``,
+``report:402,666``, ``get_dataset_shard:477``) and ``context.py``. The user
+loop calls ``ray_tpu.train.report(metrics, checkpoint=...)``; results stream
+to the driver through a queue the worker actor exposes.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+class _TrainSession:
+    def __init__(self, world_rank: int, world_size: int,
+                 local_rank: int = 0,
+                 experiment_name: str = "train",
+                 storage_dir: Optional[str] = None,
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 trial_info: Optional[Dict[str, Any]] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.experiment_name = experiment_name
+        self.storage_dir = storage_dir
+        self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.trial_info = trial_info or {}
+        self.result_queue: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self._report_idx = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        payload: Dict[str, Any] = {
+            "metrics": dict(metrics),
+            "idx": self._report_idx,
+            "rank": self.world_rank,
+        }
+        if checkpoint is not None:
+            # Persist the checkpoint under storage from the worker itself —
+            # the driver only ever sees the path (reference storage.py flow).
+            if self.storage_dir:
+                os.makedirs(self.storage_dir, exist_ok=True)
+                dst = os.path.join(
+                    self.storage_dir,
+                    f"pending_rank{self.world_rank}_{self._report_idx:06d}")
+                if os.path.abspath(checkpoint.path) != dst:
+                    if os.path.exists(dst):
+                        shutil.rmtree(dst)
+                    shutil.move(checkpoint.path, dst)
+                checkpoint = Checkpoint(dst)
+            payload["checkpoint"] = checkpoint.to_dict()
+            self.latest_checkpoint = checkpoint
+        self._report_idx += 1
+        self.result_queue.put(payload)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.latest_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r}; available: "
+                           f"{list(self.dataset_shards)}")
+        return shard
+
+
+def init_session(**kwargs) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(**kwargs)
+        return _session
+
+
+def get_session() -> Optional[_TrainSession]:
+    return _session
+
+
+def shutdown_session():
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def _require_session() -> _TrainSession:
+    s = get_session()
+    if s is None:
+        raise RuntimeError(
+            "No train session active — this API must be called inside a "
+            "train_loop_per_worker launched by a Trainer")
+    return s
+
+
+# ------------------------------------------------------------ public API
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _require_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _require_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return _require_session().get_dataset_shard(name)
+
+
+class TrainContext:
+    """Reference ``ray.train.get_context()`` surface."""
+
+    def get_world_rank(self) -> int:
+        return _require_session().world_rank
+
+    def get_world_size(self) -> int:
+        return _require_session().world_size
+
+    def get_local_rank(self) -> int:
+        return _require_session().local_rank
+
+    def get_experiment_name(self) -> str:
+        return _require_session().experiment_name
+
+    def get_trial_info(self) -> Dict[str, Any]:
+        return _require_session().trial_info
+
+
+def get_context() -> TrainContext:
+    return TrainContext()

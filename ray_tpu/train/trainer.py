@@ -1,0 +1,253 @@
+"""Trainers: DataParallelTrainer / JaxTrainer + TrainingIterator + Result.
+
+Reference: ``python/ray/train/base_trainer.py:111`` (``fit:567``),
+``data_parallel_trainer.py:25`` (``training_loop:428``), ``trainer.py``
+(``TrainingIterator:31``). ``fit()`` runs the loop inline when no tuner is
+involved; under ``ray_tpu.tune`` the trainer is wrapped as a trainable and
+runs as a single trial exactly like the reference (``base_trainer.py:567``).
+"""
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from .backend_executor import (BackendExecutor, JaxBackendConfig,
+                               TrainingFailedError)
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (CheckpointConfig, FailureConfig, RunConfig,
+                     ScalingConfig)
+
+
+class Result:
+    """Outcome of a run (reference ``ray.train.Result``)."""
+
+    def __init__(self, metrics: Dict[str, Any],
+                 checkpoint: Optional[Checkpoint],
+                 best_checkpoint: Optional[Checkpoint],
+                 metrics_history: List[Dict[str, Any]],
+                 error: Optional[BaseException] = None,
+                 path: Optional[str] = None):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.best_checkpoint = best_checkpoint
+        self.metrics_history = metrics_history
+        self.error = error
+        self.path = path
+
+    def __repr__(self):
+        return (f"Result(metrics={self.metrics}, "
+                f"checkpoint={self.checkpoint})")
+
+
+class TrainingIterator:
+    """Drives the poll loop; yields per-report aggregated metrics."""
+
+    def __init__(self, executor: BackendExecutor,
+                 checkpoint_manager: CheckpointManager,
+                 poll_interval: float = 0.05):
+        self.executor = executor
+        self.ckpt_manager = checkpoint_manager
+        self.poll_interval = poll_interval
+
+    def __iter__(self):
+        pending: Dict[int, Dict[int, dict]] = {}
+        next_idx = 0
+        world = self.executor.scaling.num_workers
+        while True:
+            out = self.executor.poll()
+            if out.get("restarted"):
+                # Fresh group resumed from latest checkpoint; reports
+                # restart from idx 0 on the new incarnation.
+                pending.clear()
+                next_idx = 0
+                continue
+            for item in out["items"]:
+                pending.setdefault(item["idx"], {})[item["rank"]] = item
+            # emit every fully-gathered report index in order
+            while next_idx in pending and \
+                    len(pending[next_idx]) == world:
+                by_rank = pending.pop(next_idx)
+                next_idx += 1
+                yield self._aggregate(by_rank)
+            if out["done"]:
+                # Ranks may report unequal counts (e.g. rank-0-only
+                # reporting); flush partial indices in order rather than
+                # spinning forever on a barrier nobody will complete.
+                for idx in sorted(pending):
+                    yield self._aggregate(pending[idx])
+                return
+            time.sleep(self.poll_interval)
+
+    def _aggregate(self, by_rank: Dict[int, dict]) -> Dict[str, Any]:
+        """Rank-0's metrics win (reference semantics); register rank-0
+        checkpoint if present; drop other ranks' staged copies."""
+        import shutil
+
+        lead = by_rank.get(min(by_rank))
+        metrics = dict(lead["metrics"])
+        for rank, item in by_rank.items():
+            meta = item.get("checkpoint")
+            if not meta:
+                continue
+            if item is lead:
+                ckpt = self.ckpt_manager.register(
+                    Checkpoint(meta["path"]), metrics)
+                self.executor.set_latest_checkpoint(ckpt)
+                metrics["checkpoint_path"] = ckpt.path
+            else:
+                # staged by a non-lead rank and never registered — delete
+                # or worker_staging grows without bound
+                shutil.rmtree(meta["path"], ignore_errors=True)
+        return metrics
+
+
+class BaseTrainer:
+    _handles_tune = False
+
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self) -> Callable:
+        """Function-trainable wrapper for ray_tpu.tune (reference
+        ``base_trainer.py:567-611`` runs every Trainer as a Tune trial)."""
+        trainer = self
+
+        def _trainable(config: Dict[str, Any]):
+            import ray_tpu.tune as tune_mod
+
+            t = trainer._with_overrides(config)
+            result = t.fit()
+            for m in result.metrics_history[-1:]:
+                tune_mod.report(m)
+
+        _trainable.__name__ = type(self).__name__
+        return _trainable
+
+    def _with_overrides(self, config: Dict[str, Any]) -> "BaseTrainer":
+        return self
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Spawns N workers running ``train_loop_per_worker``
+    (reference ``data_parallel_trainer.py:25``)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 backend_config=None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or JaxBackendConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # -- storage ----------------------------------------------------------
+    def _experiment_dir(self) -> str:
+        name = self.run_config.name or \
+            f"{type(self).__name__}_{uuid.uuid4().hex[:8]}"
+        d = os.path.join(self.run_config.resolved_storage_path(), name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def fit(self) -> Result:
+        import ray_tpu as rt
+
+        if not rt.is_initialized():
+            rt.init(ignore_reinit_error=True)
+
+        exp_dir = self._experiment_dir()
+        cc: CheckpointConfig = self.run_config.checkpoint_config
+        ckpt_manager = CheckpointManager(
+            os.path.join(exp_dir, "checkpoints"),
+            num_to_keep=cc.num_to_keep,
+            score_attribute=cc.checkpoint_score_attribute,
+            score_order=cc.checkpoint_score_order)
+
+        executor = BackendExecutor(
+            self.backend_config, self.scaling_config,
+            max_failures=self.run_config.failure_config.max_failures)
+        executor.start()
+
+        # dataset shards: ray_tpu.data Dataset → streaming_split; plain
+        # iterables pass through whole.
+        shards_per_rank = self._split_datasets()
+
+        session_kwargs = []
+        for rank in range(self.scaling_config.num_workers):
+            session_kwargs.append({
+                "experiment_name": self.run_config.name or "train",
+                "storage_dir": os.path.join(exp_dir, "worker_staging"),
+                "latest_checkpoint": self.resume_from_checkpoint,
+                "dataset_shards": shards_per_rank[rank],
+            })
+
+        executor.start_training(self.train_loop_per_worker,
+                                self.train_loop_config, session_kwargs)
+
+        history: List[Dict[str, Any]] = []
+        error: Optional[BaseException] = None
+        try:
+            for metrics in TrainingIterator(executor, ckpt_manager):
+                history.append(metrics)
+        except TrainingFailedError as e:
+            error = e
+        finally:
+            executor.shutdown()
+
+        return Result(
+            metrics=history[-1] if history else {},
+            checkpoint=ckpt_manager.latest_checkpoint,
+            best_checkpoint=ckpt_manager.best_checkpoint,
+            metrics_history=history,
+            error=error,
+            path=exp_dir,
+        )
+
+    def _split_datasets(self) -> List[Dict[str, Any]]:
+        n = self.scaling_config.num_workers
+        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            split = getattr(ds, "streaming_split", None)
+            if callable(split):
+                for rank, it in enumerate(split(n)):
+                    shards[rank][name] = it
+            else:
+                for rank in range(n):
+                    shards[rank][name] = ds
+        return shards
+
+    def _with_overrides(self, config: Dict[str, Any]) -> "BaseTrainer":
+        merged = dict(self.train_loop_config)
+        merged.update(config.get("train_loop_config", config))
+        return type(self)(
+            self.train_loop_per_worker,
+            train_loop_config=merged,
+            backend_config=self.backend_config,
+            scaling_config=self.scaling_config,
+            run_config=self.run_config,
+            datasets=self.datasets,
+            resume_from_checkpoint=self.resume_from_checkpoint,
+        )
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the Jax backend defaults (the TPU sibling
+    of the reference's ``TorchTrainer``, ``torch/torch_trainer.py:11``)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 jax_config: Optional[JaxBackendConfig] = None, **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend_config=jax_config or JaxBackendConfig(),
+                         **kwargs)

@@ -1,0 +1,153 @@
+"""WorkerGroup: N train-worker actors, optionally gang-placed.
+
+Reference: ``python/ray/train/_internal/worker_group.py:102``
+(``RayTrainWorker:19``). Each worker actor hosts the user train loop in a
+background thread so the actor stays responsive to result polls
+(the reference gets the same effect via a result-queue thread).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as rt
+
+
+class RayTrainWorker:
+    """Actor body. One per train worker; runs the user loop in a thread."""
+
+    def __init__(self, world_rank: int, world_size: int,
+                 env: Optional[Dict[str, str]] = None):
+        import os
+
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self._thread: Optional[threading.Thread] = None
+        self._session = None
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+
+    def execute(self, fn, *args, **kwargs):
+        """Run an arbitrary function in the actor (backend hooks)."""
+        return fn(*args, **kwargs)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       session_kwargs: Dict[str, Any]):
+        from . import session as S
+
+        self._session = S.init_session(
+            world_rank=self.world_rank, world_size=self.world_size,
+            **session_kwargs)
+        sess = self._session
+
+        def runner():
+            try:
+                train_fn(config) if _wants_arg(train_fn) else train_fn()
+            except BaseException as e:  # noqa: BLE001 — forwarded to driver
+                sess.error = e
+            finally:
+                sess.finished.set()
+
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="train-loop")
+        self._thread.start()
+        return True
+
+    def poll(self, max_items: int = 16):
+        """Drain queued reports; returns (items, finished, error_repr)."""
+        import queue as Q
+
+        sess = self._session
+        if sess is None:
+            return [], True, None
+        items = []
+        for _ in range(max_items):
+            try:
+                items.append(sess.result_queue.get_nowait())
+            except Q.Empty:
+                break
+        err = None
+        if sess.finished.is_set() and sess.error is not None:
+            import traceback
+
+            err = "".join(traceback.format_exception(sess.error))
+        done = sess.finished.is_set() and sess.result_queue.empty()
+        return items, done, err
+
+    def shutdown_session(self):
+        from . import session as S
+
+        S.shutdown_session()
+        return True
+
+
+def _wants_arg(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return len([p for p in sig.parameters.values()
+                if p.default is p.empty
+                and p.kind in (p.POSITIONAL_ONLY,
+                               p.POSITIONAL_OR_KEYWORD)]) >= 1
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_group=None,
+                 env: Optional[Dict[str, str]] = None):
+        self.num_workers = num_workers
+        self.resources_per_worker = resources_per_worker
+        self.placement_group = placement_group
+        self.env = env or {}
+        self.workers: List[Any] = []
+
+    def start(self, timeout: float = 60.0):
+        opts: Dict[str, Any] = {
+            "num_cpus": self.resources_per_worker.get("CPU", 1),
+        }
+        tpus = self.resources_per_worker.get("TPU", 0)
+        if tpus:
+            opts["num_tpus"] = int(tpus)
+        extra = {k: v for k, v in self.resources_per_worker.items()
+                 if k not in ("CPU", "TPU")}
+        if extra:
+            opts["resources"] = extra
+        cls = rt.remote(RayTrainWorker)
+        for rank in range(self.num_workers):
+            o = dict(opts)
+            if self.placement_group is not None:
+                o["scheduling_strategy"] = rt.PlacementGroupSchedulingStrategy(
+                    self.placement_group, placement_group_bundle_index=rank)
+            self.workers.append(
+                cls.options(**o).remote(rank, self.num_workers,
+                                        env=self.env))
+        # Barrier: every actor constructed and reachable.
+        rt.get([w.execute.remote(lambda: True) for w in self.workers],
+               timeout=timeout)
+        return self
+
+    def execute(self, fn, *args, **kwargs) -> List[Any]:
+        return rt.get(self.execute_async(fn, *args, **kwargs), timeout=120)
+
+    def execute_async(self, fn, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, rank: int, fn, *args, **kwargs):
+        return rt.get(self.workers[rank].execute.remote(fn, *args, **kwargs),
+                      timeout=120)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+
+    def __len__(self):
+        return len(self.workers)
