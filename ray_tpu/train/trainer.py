@@ -247,7 +247,10 @@ class JaxTrainer(DataParallelTrainer):
     of the reference's ``TorchTrainer``, ``torch/torch_trainer.py:11``)."""
 
     def __init__(self, train_loop_per_worker: Callable, *,
-                 jax_config: Optional[JaxBackendConfig] = None, **kwargs):
-        super().__init__(train_loop_per_worker,
-                         backend_config=jax_config or JaxBackendConfig(),
-                         **kwargs)
+                 jax_config: Optional[JaxBackendConfig] = None,
+                 backend_config=None, **kwargs):
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=jax_config or backend_config
+            or JaxBackendConfig(),
+            **kwargs)

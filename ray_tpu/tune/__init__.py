@@ -1,0 +1,37 @@
+"""ray_tpu.tune — hyperparameter search & trial orchestration.
+
+Reference surface: ``python/ray/tune/`` (SURVEY.md §2.6): Tuner, search
+space DSL, BasicVariant/random searchers, ASHA / median-stopping / PBT
+schedulers, experiment state snapshots. ``report`` shares the train
+session, so one worker-actor body serves both libraries.
+"""
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    report,
+)
+from .controller import TuneController  # noqa: F401
+from .schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    Categorical,
+    ConcurrencyLimiter,
+    Domain,
+    GridSearch,
+    RandomSearch,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .tuner import ResultGrid, TuneConfig, Tuner, run  # noqa: F401

@@ -1,0 +1,269 @@
+"""Trial lifecycle controller (reference
+``python/ray/tune/execution/tune_controller.py:68`` — ``step:666``).
+
+Each trial runs a function trainable inside a worker actor
+(:class:`ray_tpu.train.worker_group.RayTrainWorker` — the same actor body
+Train uses, so ``train.report``/``tune.report`` share one session). The
+controller is a polling event loop: fill free slots from the searcher,
+drain report queues, feed scheduler/searcher, kill actors on STOP,
+handle PBT exploit-restarts, snapshot experiment state.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.worker_group import RayTrainWorker
+
+from .schedulers import (STOP, FIFOScheduler, PopulationBasedTraining,
+                         TrialScheduler)
+from .search import (BasicVariantGenerator, PENDING_SUGGESTION, Searcher)
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+STOPPED = "STOPPED"
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any],
+                 exp_dir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = PENDING
+        self.metrics_history: List[Dict[str, Any]] = []
+        self.last_result: Dict[str, Any] = {}
+        self.checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[str] = None
+        self.actor = None
+        self.iteration = 0
+        self.dir = os.path.join(exp_dir, trial_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def to_json(self) -> dict:
+        return {
+            "trial_id": self.trial_id, "config": _jsonable(self.config),
+            "status": self.status, "last_result": _jsonable(self.last_result),
+            "iteration": self.iteration,
+            "checkpoint": self.checkpoint.path if self.checkpoint else None,
+            "error": self.error,
+        }
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+class TuneController:
+    def __init__(self, trainable, param_space: Dict[str, Any],
+                 searcher: Optional[Searcher] = None,
+                 scheduler: Optional[TrialScheduler] = None,
+                 num_samples: int = 1,
+                 max_concurrent_trials: int = 4,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 exp_dir: str = "/tmp/ray_tpu_tune",
+                 time_budget_s: Optional[float] = None):
+        self.trainable = trainable
+        self.searcher = searcher or BasicVariantGenerator(
+            num_samples=num_samples)
+        self.searcher.set_search_space(param_space or {})
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent_trials
+        self.resources = resources_per_trial or {"CPU": 1}
+        self.exp_dir = exp_dir
+        os.makedirs(exp_dir, exist_ok=True)
+        self.trials: List[Trial] = []
+        self.time_budget_s = time_budget_s
+        self._exhausted = False
+
+    # ------------------------------------------------------------ actors
+    def _launch(self, trial: Trial,
+                resume_checkpoint: Optional[Checkpoint] = None):
+        opts = {"num_cpus": self.resources.get("CPU", 1)}
+        if self.resources.get("TPU"):
+            opts["num_tpus"] = int(self.resources["TPU"])
+        cls = rt.remote(RayTrainWorker)
+        trial.actor = cls.options(**opts).remote(0, 1)
+        session_kwargs = {
+            "experiment_name": trial.trial_id,
+            "storage_dir": os.path.join(trial.dir, "staging"),
+            "latest_checkpoint": resume_checkpoint,
+            "trial_info": {"trial_id": trial.trial_id,
+                           "trial_dir": trial.dir},
+        }
+        rt.get(trial.actor.start_training.remote(
+            self.trainable, trial.config, session_kwargs), timeout=60)
+        trial.status = RUNNING
+
+    def _stop_actor(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                rt.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    # ------------------------------------------------------------- loop
+    def run(self) -> List[Trial]:
+        start = time.time()
+        while True:
+            if self.time_budget_s and time.time() - start > \
+                    self.time_budget_s:
+                for t in self.trials:
+                    if t.status == RUNNING:
+                        self._stop_actor(t)
+                        t.status = STOPPED
+                break
+            self._fill_slots()
+            progressed = self._poll_running()
+            if self._all_done():
+                break
+            if not progressed:
+                time.sleep(0.05)
+        self.save_state()
+        return self.trials
+
+    def _running(self) -> List[Trial]:
+        return [t for t in self.trials if t.status == RUNNING]
+
+    def _all_done(self) -> bool:
+        if self._running():
+            return False
+        if self._exhausted:
+            return True
+        return False
+
+    def _fill_slots(self):
+        while len(self._running()) < self.max_concurrent and \
+                not self._exhausted:
+            trial_id = f"trial_{len(self.trials):04d}_{uuid.uuid4().hex[:6]}"
+            cfg = self.searcher.suggest(trial_id)
+            if cfg is None:
+                self._exhausted = True
+                return
+            if cfg == PENDING_SUGGESTION:
+                return
+            trial = Trial(trial_id, cfg, self.exp_dir)
+            self.trials.append(trial)
+            self._launch(trial)
+
+    def _poll_running(self) -> bool:
+        progressed = False
+        for trial in self._running():
+            try:
+                items, done, err = rt.get(trial.actor.poll.remote(),
+                                          timeout=30)
+            except Exception as e:
+                trial.status = ERROR
+                trial.error = f"actor failure: {e!r}"
+                self._stop_actor(trial)
+                self.searcher.on_trial_complete(trial.trial_id, error=True)
+                continue
+            relaunched = False
+            for item in items:
+                progressed = True
+                decision = self._process_result(trial, item)
+                if decision == STOP:
+                    self._stop_actor(trial)
+                    trial.status = STOPPED
+                    self.searcher.on_trial_complete(
+                        trial.trial_id, trial.last_result)
+                    break
+                donor_id = getattr(trial, "_pbt_exploit", None)
+                if donor_id:
+                    trial._pbt_exploit = None
+                    relaunched = self._exploit(trial, donor_id)
+                    if relaunched:
+                        # remaining items belong to the killed incarnation
+                        break
+            if trial.status != RUNNING or relaunched:
+                # done/err below describe the OLD actor — not the fresh
+                # incarnation an exploit just launched
+                continue
+            if err:
+                trial.status = ERROR
+                trial.error = err
+                self._stop_actor(trial)
+                self.searcher.on_trial_complete(trial.trial_id, error=True)
+                progressed = True
+            elif done:
+                trial.status = TERMINATED
+                self._stop_actor(trial)
+                self.scheduler.on_trial_complete(trial, trial.last_result)
+                self.searcher.on_trial_complete(
+                    trial.trial_id, trial.last_result)
+                progressed = True
+        return progressed
+
+    def _process_result(self, trial: Trial, item: dict) -> str:
+        trial.iteration += 1
+        result = dict(item["metrics"])
+        result.setdefault("training_iteration", trial.iteration)
+        result["trial_id"] = trial.trial_id
+        ckpt_meta = item.get("checkpoint")
+        if ckpt_meta:
+            dst = os.path.join(trial.dir,
+                               f"checkpoint_{trial.iteration:06d}")
+            if os.path.abspath(ckpt_meta["path"]) != dst:
+                if os.path.exists(dst):
+                    shutil.rmtree(dst)
+                shutil.move(ckpt_meta["path"], dst)
+            # keep only the latest per trial (trial-level top-k is the
+            # CheckpointConfig's job at the experiment level)
+            if trial.checkpoint and os.path.exists(trial.checkpoint.path):
+                shutil.rmtree(trial.checkpoint.path, ignore_errors=True)
+            trial.checkpoint = Checkpoint(dst)
+            result["checkpoint_path"] = dst
+        trial.metrics_history.append(result)
+        trial.last_result = result
+        self.searcher.on_trial_result(trial.trial_id, result)
+        return self.scheduler.on_trial_result(trial, result)
+
+    def _exploit(self, trial: Trial, donor_id: str) -> bool:
+        """PBT: restart this trial from the donor's checkpoint with a
+        perturbed config (reference ``pbt.py`` exploit/explore).
+
+        Returns True if the trial was relaunched."""
+        donor = next((t for t in self.trials if t.trial_id == donor_id),
+                     None)
+        if donor is None or donor.checkpoint is None:
+            return False
+        assert isinstance(self.scheduler, PopulationBasedTraining)
+        new_cfg = self.scheduler.explore(
+            {**trial.config, **donor.config})
+        # Snapshot the donor checkpoint into THIS trial's dir: the donor
+        # prunes its own checkpoints as it keeps training, which would
+        # race with the clone's asynchronous restore.
+        snap = os.path.join(trial.dir,
+                            f"exploit_{trial.iteration:06d}")
+        if os.path.exists(snap):
+            shutil.rmtree(snap)
+        shutil.copytree(donor.checkpoint.path, snap)
+        self._stop_actor(trial)
+        trial.config = new_cfg
+        self._launch(trial, resume_checkpoint=Checkpoint(snap))
+        return True
+
+    # ------------------------------------------------------------- state
+    def save_state(self):
+        path = os.path.join(self.exp_dir, "experiment_state.json")
+        with open(path, "w") as f:
+            json.dump({"trials": [t.to_json() for t in self.trials],
+                       "timestamp": time.time()}, f, indent=1)
+
+    @staticmethod
+    def load_state(exp_dir: str) -> List[dict]:
+        path = os.path.join(exp_dir, "experiment_state.json")
+        with open(path) as f:
+            return json.load(f)["trials"]

@@ -1,0 +1,186 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Reference: ``python/ray/tune/schedulers/`` — ``async_hyperband.py`` (ASHA),
+``pbt.py``. Decisions are made per reported result; stopping a function
+trainable kills its actor (same observable behavior as the reference).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict]) -> None:
+        pass
+
+    def choose_trial_to_run(self, pending: List) -> Optional[Any]:
+        return pending[0] if pending else None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: successive-halving brackets, asynchronous promotion.
+
+    At each rung (time_attr crossing ``grace_period * reduction_factor^k``)
+    a trial continues only if its metric is in the top ``1/reduction_factor``
+    of completed rung entries (reference ``async_hyperband.py``).
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100):
+        assert mode in ("min", "max")
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung value -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = {}
+        self._milestones = self._compute_milestones()
+
+    def _compute_milestones(self) -> List[int]:
+        ms, t = [], self.grace
+        while t < self.max_t:
+            ms.append(int(t))
+            t *= self.rf
+        return ms
+
+    def _norm(self, v: float) -> float:
+        return -v if self.mode == "min" else v
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in self._milestones:
+            if t == rung or (t > rung and rung not in getattr(
+                    trial, "_rungs_passed", set())):
+                passed = getattr(trial, "_rungs_passed", set())
+                passed.add(rung)
+                trial._rungs_passed = passed
+                vals = self._rungs.setdefault(rung, [])
+                vals.append(self._norm(float(metric)))
+                k = max(1, int(len(vals) / self.rf))
+                cutoff = sorted(vals, reverse=True)[k - 1]
+                if self._norm(float(metric)) < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of running
+    averages (reference ``median_stopping_rule.py``)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self._avgs: Dict[str, List[float]] = {}
+
+    def _norm(self, v):
+        return -v if self.mode == "min" else v
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return CONTINUE
+        hist = self._avgs.setdefault(trial.trial_id, [])
+        hist.append(self._norm(float(v)))
+        if t < self.grace or len(self._avgs) < 3:
+            return CONTINUE
+        my_avg = sum(hist) / len(hist)
+        others = [sum(h) / len(h) for tid, h in self._avgs.items()
+                  if tid != trial.trial_id]
+        others.sort()
+        median = others[len(others) // 2]
+        return STOP if my_avg < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: bottom-quantile trials exploit a top-quantile donor's
+    checkpoint and explore a perturbed config (reference ``pbt.py``).
+
+    The controller performs the actual stop/clone-restart; this class
+    records the decision on ``trial._pbt_exploit``.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self._last: Dict[str, Dict] = {}       # trial_id -> last result
+        self._last_perturb: Dict[str, int] = {}
+
+    def _norm(self, v):
+        return -v if self.mode == "min" else v
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return CONTINUE
+        self._last[trial.trial_id] = dict(result)
+        if t - self._last_perturb.get(trial.trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        ranked = sorted(self._last.items(),
+                        key=lambda kv: self._norm(
+                            float(kv[1].get(self.metric, -math.inf))))
+        n = len(ranked)
+        if n < 2:
+            return CONTINUE
+        k = max(1, int(n * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom:
+            donor = self.rng.choice(top)
+            if donor != trial.trial_id:
+                trial._pbt_exploit = donor
+        return CONTINUE
+
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_p or key not in out:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self.rng)
+                elif isinstance(spec, list):
+                    out[key] = self.rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                cur = out[key]
+                if isinstance(cur, (int, float)):
+                    out[key] = cur * self.rng.choice([0.8, 1.2])
+        return out

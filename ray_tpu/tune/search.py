@@ -1,0 +1,212 @@
+"""Search-space DSL + search algorithms.
+
+Reference: ``python/ray/tune/search/`` — ``sample.py`` (domain DSL),
+``basic_variant.py`` (grid/random), ``concurrency_limiter.py``. Rebuilt
+fresh: domains are small sampler objects; grid_search expands to a
+cartesian product crossed with ``num_samples``.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, low: float, high: float, log: bool = False):
+        self.low, self.high, self.log = low, high, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            return math.exp(rng.uniform(math.log(self.low),
+                                        math.log(self.high)))
+        return rng.uniform(self.low, self.high)
+
+
+class Integer(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randint(self.low, self.high - 1)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Float:
+    return Float(low, high)
+
+
+def loguniform(low: float, high: float) -> Float:
+    return Float(low, high, log=True)
+
+
+def randint(low: int, high: int) -> Integer:
+    return Integer(low, high)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn) -> "Function":
+    return Function(fn)
+
+
+class Function(Domain):
+    """Callable domain; accepts zero-arg or one-arg (spec) callables."""
+
+    def __init__(self, fn):
+        import inspect
+
+        self.fn = fn
+        try:
+            self._arity = len(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            self._arity = 1
+
+    def sample(self, rng):
+        return self.fn() if self._arity == 0 else self.fn(None)
+
+
+# Returned by a limited searcher when no slot is free yet (vs None = the
+# search space is exhausted). Shared protocol with the controller.
+PENDING_SUGGESTION = "__PENDING__"
+
+
+# ------------------------------------------------------------- searchers
+class Searcher:
+    """Suggest configs; learn from results (reference ``search/searcher.py``)."""
+
+    def set_search_space(self, param_space: Dict[str, Any]) -> None:
+        self.param_space = param_space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid axes fully expanded, random axes sampled ``num_samples`` times
+    (reference ``search/basic_variant.py``)."""
+
+    def __init__(self, num_samples: int = 1, seed: Optional[int] = None):
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants: Optional[Iterator[Dict[str, Any]]] = None
+        self._total = 0
+
+    def set_search_space(self, param_space):
+        super().set_search_space(param_space)
+        expanded = self._expand()
+        self._total = len(expanded)
+        self._variants = iter(expanded)
+
+    def _expand(self) -> List[Dict[str, Any]]:
+        grid_keys, grid_vals = [], []
+
+        def walk(prefix, space, grids):
+            for k, v in space.items():
+                path = prefix + (k,)
+                if isinstance(v, GridSearch):
+                    grids.append((path, v.values))
+                elif isinstance(v, dict):
+                    walk(path, v, grids)
+
+        grids: List = []
+        walk((), self.param_space, grids)
+        combos = list(itertools.product(*[vals for _, vals in grids])) or [()]
+        out = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                cfg = self._sample_tree(self.param_space)
+                for (path, _), val in zip(grids, combo):
+                    node = cfg
+                    for p in path[:-1]:
+                        node = node[p]
+                    node[path[-1]] = val
+                out.append(cfg)
+        return out
+
+    def _sample_tree(self, space: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = {}
+        for k, v in space.items():
+            if isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            elif isinstance(v, GridSearch):
+                cfg[k] = None  # filled by the grid combo
+            elif isinstance(v, dict):
+                cfg[k] = self._sample_tree(v)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def suggest(self, trial_id):
+        try:
+            return next(self._variants)
+        except StopIteration:
+            return None
+
+    @property
+    def total_variants(self) -> int:
+        return self._total
+
+
+class RandomSearch(BasicVariantGenerator):
+    pass
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference ``concurrency_limiter.py``)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_space(self, param_space):
+        self.searcher.set_search_space(param_space)
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return PENDING_SUGGESTION
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != PENDING_SUGGESTION:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

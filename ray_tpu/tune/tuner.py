@@ -1,0 +1,153 @@
+"""Tuner / ResultGrid — public entry (reference ``python/ray/tune/tuner.py``).
+
+``Trainer.fit`` integration mirrors the reference's layering
+(``base_trainer.py:567``): a Trainer passed as the trainable is converted
+with ``as_trainable()`` and runs as trials.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.config import RunConfig
+
+from .controller import Trial, TuneController
+from .schedulers import TrialScheduler
+from .search import Searcher
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    metric: Optional[str] = None
+    mode: str = "min"
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    time_budget_s: Optional[float] = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+
+
+class TrialResult:
+    def __init__(self, trial: Trial):
+        self.trial_id = trial.trial_id
+        self.config = trial.config
+        self.metrics = trial.last_result
+        self.metrics_history = trial.metrics_history
+        self.checkpoint = trial.checkpoint
+        self.error = trial.error
+        self.status = trial.status
+        self.path = trial.dir
+
+    def __repr__(self):
+        return (f"TrialResult({self.trial_id}, status={self.status}, "
+                f"metrics={self.metrics})")
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str, path: str):
+        self.results = [TrialResult(t) for t in trials]
+        self._metric = metric
+        self._mode = mode
+        self.experiment_path = path
+
+    def __len__(self):
+        return len(self.results)
+
+    def __getitem__(self, i):
+        return self.results[i]
+
+    @property
+    def errors(self):
+        return [r for r in self.results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (none set in TuneConfig)")
+        scored = [r for r in self.results
+                  if r.metrics.get(metric) is not None]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: float(r.metrics[metric])  # noqa: E731
+        return (max if mode == "max" else min)(scored, key=key)
+
+    def get_dataframe(self):
+        rows = []
+        for r in self.results:
+            row = {"trial_id": r.trial_id, "status": r.status}
+            row.update({f"config/{k}": v for k, v in r.config.items()
+                        if not isinstance(v, dict)})
+            row.update(r.metrics)
+            rows.append(row)
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        # Trainer objects become function trainables, exactly like the
+        # reference wraps Trainers into Tune trials (base_trainer.py:567).
+        as_trainable = getattr(trainable, "as_trainable", None)
+        self.trainable = as_trainable() if callable(as_trainable) \
+            else trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu as rt
+
+        if not rt.is_initialized():
+            rt.init(ignore_reinit_error=True)
+        name = self.run_config.name or \
+            f"tune_{getattr(self.trainable, '__name__', 'exp')}_" \
+            f"{uuid.uuid4().hex[:8]}"
+        exp_dir = os.path.join(self.run_config.resolved_storage_path(),
+                               name)
+        tc = self.tune_config
+        controller = TuneController(
+            self.trainable, self.param_space,
+            searcher=tc.search_alg,
+            scheduler=tc.scheduler,
+            num_samples=tc.num_samples,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            resources_per_trial=tc.resources_per_trial,
+            exp_dir=exp_dir,
+            time_budget_s=tc.time_budget_s)
+        trials = controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode, exp_dir)
+
+
+def run(trainable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: Optional[str] = None,
+        mode: str = "min", scheduler=None, search_alg=None,
+        storage_path: Optional[str] = None,
+        max_concurrent_trials: int = 4,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        time_budget_s: Optional[float] = None,
+        name: Optional[str] = None) -> ResultGrid:
+    """``tune.run`` compatibility entry (reference ``tune/tune.py``)."""
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(num_samples=num_samples, metric=metric,
+                               mode=mode, scheduler=scheduler,
+                               search_alg=search_alg,
+                               max_concurrent_trials=max_concurrent_trials,
+                               resources_per_trial=resources_per_trial,
+                               time_budget_s=time_budget_s),
+        run_config=RunConfig(storage_path=storage_path, name=name),
+    ).fit()

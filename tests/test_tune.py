@@ -1,0 +1,189 @@
+"""Tune: search DSL, Tuner.fit, ASHA early stopping, PBT, Trainer-on-Tune."""
+import os
+
+import numpy as np
+import pytest
+
+
+def test_search_space_expansion():
+    from ray_tpu import tune
+    from ray_tpu.tune import BasicVariantGenerator
+
+    gen = BasicVariantGenerator(num_samples=2, seed=0)
+    gen.set_search_space({
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "size": tune.grid_search([16, 32, 64]),
+        "nested": {"k": tune.choice(["a", "b"])},
+    })
+    cfgs = []
+    while True:
+        c = gen.suggest(f"t{len(cfgs)}")
+        if c is None:
+            break
+        cfgs.append(c)
+    assert len(cfgs) == 6  # 3 grid × 2 samples
+    assert {c["size"] for c in cfgs} == {16, 32, 64}
+    for c in cfgs:
+        assert 1e-4 <= c["lr"] <= 1e-1
+        assert c["nested"]["k"] in ("a", "b")
+
+
+def test_tuner_grid(rt_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        tune.report({"score": config["x"] ** 2})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="min",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 4
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 1
+    assert grid.get_best_result(mode="max").config["x"] == 4
+    # experiment state snapshot written
+    assert os.path.exists(os.path.join(grid.experiment_path,
+                                       "experiment_state.json"))
+
+
+def test_tuner_trial_error_isolated(rt_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        if config["x"] == 2:
+            raise ValueError("boom")
+        tune.report({"score": config["x"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert "boom" in grid.errors[0].error
+    assert grid.get_best_result().config["x"] == 3
+
+
+def test_asha_early_stops(rt_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    def objective(config):
+        import time
+
+        for i in range(20):
+            # bad trials plateau high; good trials descend
+            loss = config["base"] - (i * 0.1 if config["base"] < 5 else 0)
+            tune.report({"loss": loss, "training_iteration": i + 1})
+            time.sleep(0.005)
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"base": tune.grid_search([1.0, 2.0, 9.0, 10.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=4,
+            scheduler=tune.AsyncHyperBandScheduler(
+                metric="loss", mode="min", grace_period=2,
+                reduction_factor=2, max_t=20)),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    def last_iter(r):
+        return r.metrics.get("training_iteration", 0)
+
+    good = [r for r in grid.results if r.config["base"] < 5]
+    bad = [r for r in grid.results if r.config["base"] > 5]
+    # good trials run to (or near) max_t; at least one bad trial is cut early
+    assert max(last_iter(r) for r in good) >= 10
+    assert min(last_iter(r) for r in bad) < 10, \
+        [(r.config["base"], last_iter(r)) for r in grid.results]
+    best = grid.get_best_result()
+    assert best.config["base"] < 5
+
+
+def test_pbt_exploits(rt_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import Checkpoint, RunConfig
+
+    sync_dir = tmp_path / "sync"
+    sync_dir.mkdir()
+
+    def objective(config):
+        import os
+        import time
+
+        import numpy as np
+
+        from ray_tpu import train
+
+        # barrier: don't start iterating until BOTH trials are alive, so
+        # PBT's ranking sees two trials at every perturbation interval
+        open(os.path.join(config["sync"], f"up_{config['lr']}"), "w")
+        deadline = time.time() + 20
+        while len(os.listdir(config["sync"])) < 2:
+            if time.time() > deadline:
+                raise TimeoutError("peer trial never started")
+            time.sleep(0.01)
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = int(ckpt.load_state()[0]) + 1
+        for i in range(start, 12):
+            score = i * config["lr"]
+            tune.report(
+                {"score": score, "training_iteration": i + 1},
+                checkpoint=Checkpoint.from_state(np.int64(i)))
+            time.sleep(0.03)  # pace reports so trials interleave in polls
+
+    sched = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": tune.uniform(0.5, 2.0)}, seed=0)
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.01, 1.5]),
+                     "sync": str(sync_dir)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert not grid.errors
+    # the weak trial must have been perturbed away from lr=0.01 by exploit
+    weak = [r for r in grid.results
+            if r.metrics_history
+            and r.metrics_history[0].get("score", 1) == 0]
+    assert weak and weak[0].config["lr"] != 0.01, \
+        [(r.config, len(r.metrics_history)) for r in grid.results]
+    best = grid.get_best_result()
+    assert best.metrics["score"] > 10 * 0.5  # exploited/continued trial
+
+
+def test_trainer_on_tune(rt_cluster, tmp_path):
+    """Train mounts on Tune exactly like the reference (base_trainer:567)."""
+    from ray_tpu import train, tune
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        lr = config.get("lr", 0.1)
+        train.report({"final_loss": 1.0 / lr})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "inner")))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": tune.grid_search([0.5, 2.0])}},
+        tune_config=tune.TuneConfig(metric="final_loss", mode="min",
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert not grid.errors, grid.errors[0].error if grid.errors else None
+    assert grid.get_best_result().metrics["final_loss"] == pytest.approx(0.5)
