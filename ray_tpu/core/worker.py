@@ -417,7 +417,9 @@ class CoreWorker:
                     self.memory_store.put(oid, None)
                     out.append(("ref", (oid.binary(), self.sock_path)))
                 else:
-                    out.append(("inline", frames))
+                    # materialize out-of-band buffers: inline frames ride
+                    # the pickled payload, which can't carry memoryviews
+                    out.append(("inline", [bytes(f) for f in frames]))
         return out, kw_keys
 
     def submit_task(self, fn_key: str, args, kwargs, *, num_returns=1,
@@ -639,7 +641,11 @@ class CoreWorker:
                 "get_actor", {"actor_id": actor_id.hex()}), timeout)
             if meta["state"] == "DEAD":
                 raise ActorDiedError(meta.get("death_cause", ""))
-            st = {"state": meta["state"], "address": meta["address"],
+            # The head assigns a worker before the constructor finishes;
+            # only an ALIVE actor's address is safe to push to — a PENDING
+            # address races the instance registration on the worker.
+            addr = meta["address"] if meta["state"] == "ALIVE" else None
+            st = {"state": meta["state"], "address": addr,
                   "error": None, "event": threading.Event()}
             st["event"].set()
             self._actor_state[actor_id.binary()] = st
@@ -887,7 +893,10 @@ class CoreWorker:
         actor_id_b = meta["actor_id"]
         instance = self._actors_local.get(actor_id_b)
         if instance is None:
-            raise rpc.RpcError("actor instance not on this worker")
+            local = [ActorID(a).hex()[:12] for a in self._actors_local]
+            raise rpc.RpcError(
+                f"actor {ActorID(actor_id_b).hex()[:12]} not on worker "
+                f"{self.sock_path} (hosts: {local})")
         order = self._actor_order[actor_id_b]
         seq = meta["seq_no"]
         loop = asyncio.get_running_loop()

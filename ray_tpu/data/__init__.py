@@ -1,0 +1,20 @@
+"""ray_tpu.data — streaming datasets feeding distributed training.
+
+Reference surface: ``python/ray/data/`` (SURVEY.md §2.4): lazy Dataset
+plans, fused stateless transforms over remote tasks, actor-pool
+map_batches, streaming_split for per-worker shard iterators.
+"""
+from .block import Block  # noqa: F401
+from .dataset import Dataset, GroupedData  # noqa: F401
+from .datasource import (  # noqa: F401
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+from .executor import ActorPoolStrategy, DataIterator  # noqa: F401

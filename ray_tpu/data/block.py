@@ -1,0 +1,145 @@
+"""Blocks: the unit of data movement (reference ``python/ray/data/block.py``).
+
+A block is either a list of rows (``simple``) or a dict of equal-length
+numpy columns (``tabular``) — the tabular form feeds TPU input pipelines
+zero-copy through the object store's buffer path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+
+Row = Dict[str, Any]
+Block = Union[List[Any], Dict[str, np.ndarray]]
+
+
+def is_tabular(block: Block) -> bool:
+    return isinstance(block, dict)
+
+
+def block_len(block: Block) -> int:
+    if is_tabular(block):
+        if not block:
+            return 0
+        return len(next(iter(block.values())))
+    return len(block)
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    if is_tabular(block):
+        return {k: v[start:end] for k, v in block.items()}
+    return block[start:end]
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_len(b)]
+    if not blocks:
+        return []
+    if is_tabular(blocks[0]):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out: List[Any] = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def iter_rows(block: Block) -> Iterator[Any]:
+    if is_tabular(block):
+        keys = list(block.keys())
+        for i in range(block_len(block)):
+            yield {k: block[k][i] for k in keys}
+    else:
+        yield from block
+
+
+def rows_to_block(rows: List[Any]) -> Block:
+    """Build a tabular block when rows are uniform dicts, else simple."""
+    if rows and all(isinstance(r, dict) for r in rows):
+        keys = list(rows[0].keys())
+        if all(list(r.keys()) == keys for r in rows):
+            try:
+                return {k: np.asarray([r[k] for r in rows]) for k in keys}
+            except Exception:
+                pass
+    return list(rows)
+
+
+def to_batch_format(block: Block, batch_format: str):
+    """Convert a block to the requested batch format."""
+    if batch_format in ("default", "numpy"):
+        if is_tabular(block):
+            return block
+        if block and all(isinstance(r, dict) for r in block):
+            return rows_to_block(block)
+        return np.asarray(block)
+    if batch_format == "pandas":
+        import pandas as pd
+
+        if is_tabular(block):
+            return pd.DataFrame({k: list(v) for k, v in block.items()})
+        return pd.DataFrame(block)
+    if batch_format == "rows":
+        return list(iter_rows(block))
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def from_batch(batch) -> Block:
+    """Normalize a user-function return value back into a block."""
+    import pandas as pd
+
+    if isinstance(batch, pd.DataFrame):
+        return {c: batch[c].to_numpy() for c in batch.columns}
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    if isinstance(batch, np.ndarray):
+        return list(batch)
+    if isinstance(batch, list):
+        return batch
+    raise TypeError(f"cannot convert {type(batch)} to a block")
+
+
+def batcher(block_iter: Iterable[Block], batch_size: Optional[int],
+            batch_format: str = "numpy") -> Iterator[Any]:
+    """Re-chunk a stream of blocks into exact-size batches.
+
+    Blocks are consumed with a (block, offset) cursor — a batch concats
+    only the slices it needs, so a large block is copied once total, not
+    once per emitted batch.
+    """
+    if batch_size is None:
+        for b in block_iter:
+            if block_len(b):
+                yield to_batch_format(b, batch_format)
+        return
+    buf: List[Block] = []          # pending blocks; buf[0] starts at `off`
+    off = 0
+    buffered = 0
+    for b in block_iter:
+        n = block_len(b)
+        if not n:
+            continue
+        buf.append(b)
+        buffered += n
+        while buffered >= batch_size:
+            need = batch_size
+            parts: List[Block] = []
+            while need:
+                first_len = block_len(buf[0]) - off
+                take = min(first_len, need)
+                parts.append(slice_block(buf[0], off, off + take))
+                need -= take
+                off += take
+                if off == block_len(buf[0]):
+                    buf.pop(0)
+                    off = 0
+            buffered -= batch_size
+            yield to_batch_format(
+                parts[0] if len(parts) == 1 else concat_blocks(parts),
+                batch_format)
+    if buffered:
+        parts = [slice_block(buf[0], off, block_len(buf[0]))] + buf[1:]
+        yield to_batch_format(
+            parts[0] if len(parts) == 1 else concat_blocks(parts),
+            batch_format)

@@ -1,0 +1,137 @@
+"""Read APIs / datasources (reference ``python/ray/data/read_api.py`` and
+``datasource/``): range, from_items/numpy/pandas, csv/json/parquet."""
+from __future__ import annotations
+
+import glob as _glob
+import os
+from builtins import range as _range
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from . import block as B
+from .dataset import Dataset, _Op
+
+DEFAULT_BLOCK_SIZE = 1000
+
+
+def _blocks_from_rows(rows: List[Any], block_size: int) -> Iterator[B.Block]:
+    for i in _range(0, len(rows), block_size):
+        yield B.rows_to_block(rows[i:i + block_size])
+
+
+def range(n: int, *, block_size: int = DEFAULT_BLOCK_SIZE) -> Dataset:  # noqa: A001
+    def make():
+        for lo in _range(0, n, block_size):
+            hi = min(lo + block_size, n)
+            yield {"id": np.arange(lo, hi)}
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def range_tensor(n: int, *, shape=(1,),
+                 block_size: int = DEFAULT_BLOCK_SIZE) -> Dataset:
+    def make():
+        for lo in _range(0, n, block_size):
+            hi = min(lo + block_size, n)
+            base = np.arange(lo, hi).reshape((-1,) + (1,) * len(shape))
+            yield {"data": np.broadcast_to(
+                base, (hi - lo,) + tuple(shape)).copy()}
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def from_items(items: List[Any], *,
+               block_size: int = DEFAULT_BLOCK_SIZE) -> Dataset:
+    items = list(items)
+    return Dataset([_Op("read",
+                        make_blocks=lambda: _blocks_from_rows(
+                            items, block_size))])
+
+
+def from_numpy(arr: np.ndarray, column: str = "data",
+               block_size: int = DEFAULT_BLOCK_SIZE) -> Dataset:
+    arr = np.asarray(arr)
+
+    def make():
+        for lo in _range(0, len(arr), block_size):
+            yield {column: arr[lo:lo + block_size]}
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def from_pandas(df) -> Dataset:
+    blk = {c: df[c].to_numpy() for c in df.columns}
+    return Dataset([_Op("read", make_blocks=lambda: iter([blk]))])
+
+
+def _expand_paths(path: str, ext: str) -> List[str]:
+    if os.path.isdir(path):
+        return sorted(_glob.glob(os.path.join(path, f"*{ext}")))
+    return sorted(_glob.glob(path)) or [path]
+
+
+def read_json(path: str) -> Dataset:
+    def make():
+        import json
+
+        for p in _expand_paths(path, ".json"):
+            rows = []
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+            if rows:
+                yield B.rows_to_block(rows)
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def read_csv(path: str) -> Dataset:
+    def make():
+        import csv
+
+        for p in _expand_paths(path, ".csv"):
+            with open(p, newline="") as f:
+                rows = [dict(r) for r in csv.DictReader(f)]
+            if not rows:
+                continue
+            # type-coerce per COLUMN — a column converts only if every
+            # value converts, so mixed columns stay strings instead of
+            # silently stringifying the numeric entries
+            for col in rows[0]:
+                for conv in (int, float):
+                    try:
+                        converted = [conv(r[col]) for r in rows]
+                    except (TypeError, ValueError):
+                        continue
+                    for r, v in zip(rows, converted):
+                        r[col] = v
+                    break
+            yield B.rows_to_block(rows)
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def read_parquet(path: str, columns: Optional[List[str]] = None) -> Dataset:
+    def make():
+        import pyarrow.parquet as pq
+
+        for p in _expand_paths(path, ".parquet"):
+            table = pq.read_table(p, columns=columns)
+            yield {c: table[c].to_numpy(zero_copy_only=False)
+                   for c in table.column_names}
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def read_text(path: str) -> Dataset:
+    def make():
+        for p in _expand_paths(path, ".txt"):
+            with open(p) as f:
+                lines = [{"text": ln.rstrip("\n")} for ln in f]
+            if lines:
+                yield B.rows_to_block(lines)
+
+    return Dataset([_Op("read", make_blocks=make)])

@@ -1,0 +1,202 @@
+"""Streaming execution: windowed task pipeline + actor pools + splits.
+
+Reference: ``python/ray/data/_internal/execution/streaming_executor.py:48``
+and ``operators/`` (TaskPoolMapOperator, ActorPoolMapOperator,
+``output_splitter.py``). Rebuilt as a pull-based pipeline: a stage turns an
+iterator of input block refs into an iterator of output block refs, keeping
+at most ``max_in_flight`` tasks outstanding — that window IS the
+backpressure (blocks stay in the object store, the driver never holds more
+than the window).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, List, Optional
+
+import ray_tpu as rt
+
+
+class ActorPoolStrategy:
+    """compute= argument for stateful map_batches (reference
+    ``ActorPoolMapOperator``)."""
+
+    def __init__(self, size: int = 2, num_cpus: float = 1,
+                 num_tpus: int = 0):
+        self.size = size
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+
+
+def task_pool_stage(ref_iter: Iterator, transform: Callable,
+                    max_in_flight: int = 8,
+                    num_cpus: float = 1) -> Iterator:
+    """Apply ``transform(block) -> block`` to each block via remote tasks,
+    with a bounded in-flight window; yields refs in order."""
+    remote_fn = rt.remote(transform) if not hasattr(
+        transform, "remote") else transform
+    remote_fn = remote_fn.options(num_cpus=num_cpus)
+    pending: List = []
+    for ref in ref_iter:
+        pending.append(remote_fn.remote(ref))
+        if len(pending) >= max_in_flight:
+            yield pending.pop(0)
+    yield from pending
+
+
+def actor_pool_stage(ref_iter: Iterator, fn_constructor: Callable,
+                     transform: Callable, pool: ActorPoolStrategy,
+                     max_in_flight_per_actor: int = 2) -> Iterator:
+    """Stateful transform over a fixed actor pool; round-robin dispatch
+    with per-actor in-flight caps; yields refs in submission order."""
+
+    class _MapWorker:
+        def __init__(self):
+            self.state = fn_constructor() if fn_constructor else None
+
+        def apply(self, block):
+            return transform(self.state, block)
+
+    cls = rt.remote(_MapWorker)
+    opts = {"num_cpus": pool.num_cpus}
+    if pool.num_tpus:
+        opts["num_tpus"] = pool.num_tpus
+    actors = [cls.options(**opts).remote() for _ in range(pool.size)]
+    try:
+        pending: List = []
+        rr = 0
+        window = pool.size * max_in_flight_per_actor
+        for ref in ref_iter:
+            actor = actors[rr % len(actors)]
+            rr += 1
+            pending.append(actor.apply.remote(ref))
+            if len(pending) >= window:
+                yield pending.pop(0)
+        yield from pending
+    finally:
+        for a in actors:
+            try:
+                rt.kill(a)
+            except Exception:
+                pass
+
+
+class SplitCoordinator:
+    """Actor distributing one block stream across N consumers (reference
+    ``output_splitter.py`` behind ``Dataset.streaming_split:1225``).
+
+    ``equal=False``: first-come-first-served (fast consumers get more).
+    ``equal=True``: row-level fair distribution — every split receives
+    EXACTLY the same row count (the last incomplete round of rows is
+    dropped), which is what SPMD training steps require. Blocks are
+    re-sliced so global row ``i`` goes to split ``i % n``.
+    """
+
+    def __init__(self, plan_blob: bytes, n: int, equal: bool = False):
+        import cloudpickle
+
+        make_iter = cloudpickle.loads(plan_blob)
+        self._iter = make_iter()
+        self._n = n
+        self._equal = equal
+        self._lock = threading.Lock()
+        self._queues: List[queue.Queue] = [queue.Queue() for _ in range(n)]
+        self._done = False
+        self._rr = 0
+        self._carry = None  # equal mode: rows not yet forming a full round
+
+    def _pump_one(self) -> bool:
+        """Pull one block from the plan; route it. Returns False at EOS."""
+        from . import block as B
+
+        try:
+            block = next(self._iter)
+        except StopIteration:
+            self._done = True
+            # equal mode: the carried partial round (< n rows) is dropped
+            # so every split ends with identical counts.
+            return False
+        if not self._equal:
+            q = self._queues[self._rr % self._n]
+            self._rr += 1
+            q.put(block)
+            return True
+        if self._carry is not None:
+            block = B.concat_blocks([self._carry, block])
+            self._carry = None
+        total = B.block_len(block)
+        rounds = total // self._n
+        if rounds == 0:
+            self._carry = block
+            return True
+        cut = rounds * self._n
+        body, self._carry = (B.slice_block(block, 0, cut),
+                             B.slice_block(block, cut, total))
+        if B.block_len(self._carry) == 0:
+            self._carry = None
+        import numpy as np
+
+        for k in range(self._n):
+            idx = np.arange(k, cut, self._n)
+            if B.is_tabular(body):
+                sub = {col: v[idx] for col, v in body.items()}
+            else:
+                sub = [body[i] for i in idx]
+            self._queues[k].put(sub)
+        return True
+
+    def next_block(self, split_idx: int):
+        """Returns (block, eos)."""
+        q = self._queues[split_idx]
+        while True:
+            try:
+                return q.get_nowait(), False
+            except queue.Empty:
+                pass
+            with self._lock:
+                try:
+                    return q.get_nowait(), False
+                except queue.Empty:
+                    pass
+                if self._done:
+                    return None, True
+                if not self._equal:
+                    # FCFS: serve the caller directly
+                    try:
+                        block = next(self._iter)
+                    except StopIteration:
+                        self._done = True
+                        return None, True
+                    return block, False
+                self._pump_one()
+
+
+class DataIterator:
+    """Per-consumer handle over a split (reference ``DataIterator``)."""
+
+    def __init__(self, coordinator, split_idx: int):
+        self._coord = coordinator
+        self._idx = split_idx
+
+    def iter_blocks(self) -> Iterator:
+        while True:
+            block, eos = rt.get(
+                self._coord.next_block.remote(self._idx), timeout=300)
+            if eos:
+                return
+            yield block
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy") -> Iterator:
+        from .block import batcher
+
+        return batcher(self.iter_blocks(), batch_size, batch_format)
+
+    def iter_rows(self) -> Iterator:
+        from .block import iter_rows
+
+        for b in self.iter_blocks():
+            yield from iter_rows(b)
+
+    def __iter__(self):
+        return self.iter_rows()

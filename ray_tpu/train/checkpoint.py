@@ -109,14 +109,12 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> Checkpoint:
-        """Move the checkpoint dir under storage and apply retention."""
-        dst = os.path.join(self.storage_dir,
-                           f"checkpoint_{self._counter:06d}")
-        if os.path.abspath(checkpoint.path) != dst:
-            if os.path.exists(dst):
-                shutil.rmtree(dst)
-            shutil.move(checkpoint.path, dst)
-        tracked = _TrackedCheckpoint(Checkpoint(dst), metrics, self._counter)
+        """Adopt the checkpoint IN PLACE and apply retention.
+
+        The dir is never moved — the reporting worker's session may still
+        hand the same path out via ``get_checkpoint()``; retention prunes
+        old entries (never the most recent) by deleting their dirs."""
+        tracked = _TrackedCheckpoint(checkpoint, metrics, self._counter)
         self._counter += 1
         self._tracked.append(tracked)
         self._apply_retention()

@@ -39,6 +39,7 @@ class _TrainSession:
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self._report_idx = 0
+        self._own_ckpts: list = []
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
@@ -48,13 +49,16 @@ class _TrainSession:
             "rank": self.world_rank,
         }
         if checkpoint is not None:
-            # Persist the checkpoint under storage from the worker itself —
-            # the driver only ever sees the path (reference storage.py flow).
+            # Persist to the checkpoint's FINAL immutable location from the
+            # worker itself — the driver only tracks paths, never moves
+            # them (reference storage.py flow), so get_checkpoint() stays
+            # valid for the whole run.
             if self.storage_dir:
                 os.makedirs(self.storage_dir, exist_ok=True)
                 dst = os.path.join(
                     self.storage_dir,
-                    f"pending_rank{self.world_rank}_{self._report_idx:06d}")
+                    f"checkpoint_rank{self.world_rank}_"
+                    f"{self._report_idx:06d}")
                 if os.path.abspath(checkpoint.path) != dst:
                     if os.path.exists(dst):
                         shutil.rmtree(dst)
@@ -62,6 +66,14 @@ class _TrainSession:
                 checkpoint = Checkpoint(dst)
             payload["checkpoint"] = checkpoint.to_dict()
             self.latest_checkpoint = checkpoint
+            # Non-lead ranks own their GC (the driver tracks only rank 0's
+            # checkpoints): keep the two most recent so a concurrent
+            # get_checkpoint() never races a deletion.
+            if self.world_rank != 0 and self.storage_dir:
+                self._own_ckpts.append(checkpoint.path)
+                while len(self._own_ckpts) > 2:
+                    shutil.rmtree(self._own_ckpts.pop(0),
+                                  ignore_errors=True)
         self._report_idx += 1
         self.result_queue.put(payload)
 

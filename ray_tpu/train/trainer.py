@@ -81,25 +81,16 @@ class TrainingIterator:
             time.sleep(self.poll_interval)
 
     def _aggregate(self, by_rank: Dict[int, dict]) -> Dict[str, Any]:
-        """Rank-0's metrics win (reference semantics); register rank-0
-        checkpoint if present; drop other ranks' staged copies."""
-        import shutil
-
+        """Rank-0's metrics win (reference semantics); register rank-0's
+        checkpoint. Non-lead ranks GC their own checkpoints worker-side."""
         lead = by_rank.get(min(by_rank))
         metrics = dict(lead["metrics"])
-        for rank, item in by_rank.items():
-            meta = item.get("checkpoint")
-            if not meta:
-                continue
-            if item is lead:
-                ckpt = self.ckpt_manager.register(
-                    Checkpoint(meta["path"]), metrics)
-                self.executor.set_latest_checkpoint(ckpt)
-                metrics["checkpoint_path"] = ckpt.path
-            else:
-                # staged by a non-lead rank and never registered — delete
-                # or worker_staging grows without bound
-                shutil.rmtree(meta["path"], ignore_errors=True)
+        meta = lead.get("checkpoint")
+        if meta:
+            ckpt = self.ckpt_manager.register(Checkpoint(meta["path"]),
+                                              metrics)
+            self.executor.set_latest_checkpoint(ckpt)
+            metrics["checkpoint_path"] = ckpt.path
         return metrics
 
 
@@ -188,7 +179,9 @@ class DataParallelTrainer(BaseTrainer):
         for rank in range(self.scaling_config.num_workers):
             session_kwargs.append({
                 "experiment_name": self.run_config.name or "train",
-                "storage_dir": os.path.join(exp_dir, "worker_staging"),
+                # final checkpoint home — workers write here directly and
+                # the manager adopts paths in place (no driver-side moves)
+                "storage_dir": os.path.join(exp_dir, "checkpoints"),
                 "latest_checkpoint": self.resume_from_checkpoint,
                 "dataset_shards": shards_per_rank[rank],
             })
@@ -221,7 +214,9 @@ class DataParallelTrainer(BaseTrainer):
         for name, ds in self.datasets.items():
             split = getattr(ds, "streaming_split", None)
             if callable(split):
-                for rank, it in enumerate(split(n)):
+                # equal=True: every worker sees the same number of rows —
+                # required for SPMD steps (reference DataConfig default).
+                for rank, it in enumerate(split(n, equal=True)):
                     shards[rank][name] = it
             else:
                 for rank in range(n):

@@ -73,7 +73,8 @@ class TuneController:
                  max_concurrent_trials: int = 4,
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  exp_dir: str = "/tmp/ray_tpu_tune",
-                 time_budget_s: Optional[float] = None):
+                 time_budget_s: Optional[float] = None,
+                 trial_start_timeout_s: float = 120.0):
         self.trainable = trainable
         self.searcher = searcher or BasicVariantGenerator(
             num_samples=num_samples)
@@ -85,6 +86,7 @@ class TuneController:
         os.makedirs(exp_dir, exist_ok=True)
         self.trials: List[Trial] = []
         self.time_budget_s = time_budget_s
+        self.trial_start_timeout_s = trial_start_timeout_s
         self._exhausted = False
 
     # ------------------------------------------------------------ actors
@@ -97,13 +99,16 @@ class TuneController:
         trial.actor = cls.options(**opts).remote(0, 1)
         session_kwargs = {
             "experiment_name": trial.trial_id,
-            "storage_dir": os.path.join(trial.dir, "staging"),
+            "storage_dir": trial.dir,  # final home; adopted in place
             "latest_checkpoint": resume_checkpoint,
             "trial_info": {"trial_id": trial.trial_id,
                            "trial_dir": trial.dir},
         }
-        rt.get(trial.actor.start_training.remote(
-            self.trainable, trial.config, session_kwargs), timeout=60)
+        # Non-blocking: the ack is polled in _poll_running so one
+        # unplaceable trial can't stall the whole experiment loop.
+        trial._start_ref = trial.actor.start_training.remote(
+            self.trainable, trial.config, session_kwargs)
+        trial._start_deadline = time.time() + self.trial_start_timeout_s
         trial.status = RUNNING
 
     def _stop_actor(self, trial: Trial):
@@ -161,6 +166,33 @@ class TuneController:
     def _poll_running(self) -> bool:
         progressed = False
         for trial in self._running():
+            # trial still launching? (actor placement / start ack pending)
+            start_ref = getattr(trial, "_start_ref", None)
+            if start_ref is not None:
+                ready, _ = rt.wait([start_ref], timeout=0)
+                if not ready:
+                    if time.time() > trial._start_deadline:
+                        trial.status = ERROR
+                        trial.error = (
+                            f"trial did not start within "
+                            f"{self.trial_start_timeout_s}s (unplaceable "
+                            f"resources {self.resources}?)")
+                        self._stop_actor(trial)
+                        self.searcher.on_trial_complete(trial.trial_id,
+                                                        error=True)
+                        progressed = True
+                    continue
+                trial._start_ref = None
+                try:
+                    rt.get(start_ref, timeout=5)
+                except Exception as e:
+                    trial.status = ERROR
+                    trial.error = f"start_training failed: {e!r}"
+                    self._stop_actor(trial)
+                    self.searcher.on_trial_complete(trial.trial_id,
+                                                    error=True)
+                    progressed = True
+                    continue
             try:
                 items, done, err = rt.get(trial.actor.poll.remote(),
                                           timeout=30)
@@ -213,18 +245,14 @@ class TuneController:
         result["trial_id"] = trial.trial_id
         ckpt_meta = item.get("checkpoint")
         if ckpt_meta:
-            dst = os.path.join(trial.dir,
-                               f"checkpoint_{trial.iteration:06d}")
-            if os.path.abspath(ckpt_meta["path"]) != dst:
-                if os.path.exists(dst):
-                    shutil.rmtree(dst)
-                shutil.move(ckpt_meta["path"], dst)
-            # keep only the latest per trial (trial-level top-k is the
-            # CheckpointConfig's job at the experiment level)
-            if trial.checkpoint and os.path.exists(trial.checkpoint.path):
-                shutil.rmtree(trial.checkpoint.path, ignore_errors=True)
-            trial.checkpoint = Checkpoint(dst)
-            result["checkpoint_path"] = dst
+            # adopt in place (the worker session still hands this path out
+            # via get_checkpoint); keep only the latest per trial
+            prev = trial.checkpoint
+            trial.checkpoint = Checkpoint(ckpt_meta["path"])
+            if prev and prev.path != trial.checkpoint.path and \
+                    os.path.exists(prev.path):
+                shutil.rmtree(prev.path, ignore_errors=True)
+            result["checkpoint_path"] = trial.checkpoint.path
         trial.metrics_history.append(result)
         trial.last_result = result
         self.searcher.on_trial_result(trial.trial_id, result)
