@@ -1,0 +1,15 @@
+"""ray_tpu.rllib — reinforcement learning (reference: ``rllib/``, new API
+stack, SURVEY.md §2.8): AlgorithmConfig → Algorithm with EnvRunnerGroup
+(CPU sampling actors, numpy inference) and jax LearnerGroup (jitted
+losses, mesh-sharded batches). PPO (sync on-policy) and IMPALA (async).
+"""
+from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .env_runner import (  # noqa: F401
+    EnvRunnerGroup,
+    SampleBatch,
+    SingleAgentEnvRunner,
+)
+from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .learner import LearnerGroup, PPOLearner, compute_gae  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401
+from .rl_module import DiscreteMLPModule, RLModuleSpec  # noqa: F401

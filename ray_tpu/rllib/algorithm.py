@@ -1,0 +1,194 @@
+"""Algorithm + AlgorithmConfig (reference ``rllib/algorithms/algorithm.py:213``
+and ``algorithm_config.py``): sample → learn → sync-weights iterations,
+runnable standalone or as a Tune trainable.
+"""
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .env_runner import EnvRunnerGroup, SampleBatch
+from .learner import LearnerGroup, PPOLearner, compute_gae
+from .rl_module import RLModuleSpec
+
+
+class AlgorithmConfig:
+    """Fluent config (reference ``algorithm_config.py`` builder pattern)."""
+
+    def __init__(self):
+        self.env: Optional[str] = None
+        self.env_creator: Optional[Callable] = None
+        self.num_env_runners = 0
+        self.num_envs_per_runner = 1
+        self.rollout_fragment_length = 200
+        self.num_learners = 0
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 8
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 0.5
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.mesh = None
+
+    # fluent sections, reference-style
+    def environment(self, env: Optional[str] = None, *,
+                    env_creator: Optional[Callable] = None):
+        self.env = env
+        self.env_creator = env_creator
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def make_env_creator(self) -> Callable:
+        if self.env_creator is not None:
+            return self.env_creator
+        env_name = self.env
+
+        def create():
+            import gymnasium
+
+            return gymnasium.make(env_name)
+
+        return create
+
+    def module_spec(self) -> RLModuleSpec:
+        env = self.make_env_creator()()
+        spec = RLModuleSpec(
+            obs_dim=int(np.prod(env.observation_space.shape)),
+            num_actions=int(env.action_space.n),
+            hidden=self.hidden)
+        env.close() if hasattr(env, "close") else None
+        return spec
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)  # type: ignore[attr-defined]
+
+
+class Algorithm:
+    """sample → learn → sync loop (reference ``Algorithm.step:818``)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import ray_tpu as rt
+
+        if (config.num_env_runners or config.num_learners) and \
+                not rt.is_initialized():
+            rt.init(ignore_reinit_error=True)
+        self.config = config
+        self.module_spec = config.module_spec()
+        self.env_runner_group = EnvRunnerGroup(
+            config.make_env_creator(), self.module_spec,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed)
+        self.learner_group = self._build_learner_group()
+        self.iteration = 0
+        self._timesteps = 0
+        # initial weight sync so rollouts start from learner weights
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def _build_learner_group(self) -> LearnerGroup:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        metrics = self.training_step()
+        self.iteration += 1
+        metrics.update(self.env_runner_group.get_metrics())
+        metrics["training_iteration"] = self.iteration
+        metrics["num_env_steps_sampled_lifetime"] = self._timesteps
+        metrics["time_this_iter_s"] = time.time() - t0
+        return metrics
+
+    # ------------------------------------------------- checkpointing
+    def save_to_path(self, path: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algo_state.pkl"), "wb") as f:
+            pickle.dump({"learner": self.learner_group.get_state(),
+                         "iteration": self.iteration,
+                         "timesteps": self._timesteps}, f)
+        return path
+
+    def restore_from_path(self, path: str):
+        import os
+        import pickle
+
+        with open(os.path.join(path, "algo_state.pkl"), "rb") as f:
+            st = pickle.load(f)
+        self.learner_group.set_state(st["learner"])
+        self.iteration = st["iteration"]
+        self._timesteps = st["timesteps"]
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def stop(self):
+        self.env_runner_group.stop()
+        self.learner_group.stop()
+
+    # ------------------------------------------------- tune integration
+    @classmethod
+    def as_trainable(cls, config: AlgorithmConfig,
+                     stop_iters: int = 50,
+                     stop_reward: Optional[float] = None) -> Callable:
+        def _trainable(overrides: Dict[str, Any]):
+            from ray_tpu import tune
+
+            cfg = config.copy()
+            for k, v in overrides.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            algo = cfg.build()
+            try:
+                for _ in range(stop_iters):
+                    m = algo.train()
+                    tune.report(m)
+                    if stop_reward is not None and \
+                            m.get("episode_return_mean", 0) >= stop_reward:
+                        break
+            finally:
+                algo.stop()
+
+        return _trainable

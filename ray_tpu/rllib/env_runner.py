@@ -1,0 +1,196 @@
+"""Env runners: vectorized gym sampling with RLModule inference.
+
+Reference: ``rllib/env/single_agent_env_runner.py:49`` (``sample:124``) and
+``env_runner_group.py:66``. Runners are CPU actors — inference uses the
+module's numpy path so no accelerator is touched during sampling.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu as rt
+
+from .rl_module import DiscreteMLPModule, RLModuleSpec
+
+
+class SampleBatch:
+    """Flat rollout fragment (time-major concat of all vector envs)."""
+
+    KEYS = ("obs", "actions", "rewards", "dones", "truncateds",
+            "logp", "values", "next_values")
+
+    def __init__(self, **cols):
+        self.cols = cols
+
+    def __getitem__(self, k):
+        return self.cols[k]
+
+    def __len__(self):
+        return len(self.cols["obs"])
+
+    @staticmethod
+    def concat(batches: List["SampleBatch"]) -> "SampleBatch":
+        return SampleBatch(**{
+            k: np.concatenate([b.cols[k] for b in batches])
+            for k in batches[0].cols
+        })
+
+
+class SingleAgentEnvRunner:
+    """Steps ``num_envs`` copies of a gymnasium env for T steps per call."""
+
+    def __init__(self, env_creator: Callable, module_spec: RLModuleSpec,
+                 num_envs: int = 1, rollout_fragment_length: int = 200,
+                 seed: int = 0):
+        self.envs = [env_creator() for _ in range(num_envs)]
+        self.module = module_spec.build(seed)
+        self.T = rollout_fragment_length
+        self.rng = np.random.default_rng(seed)
+        self.obs = np.stack([e.reset(seed=seed + i)[0]
+                             for i, e in enumerate(self.envs)])
+        self.episode_returns = [0.0] * num_envs
+        self.completed_returns: List[float] = []
+
+    def set_weights(self, weights):
+        self.module.set_weights(weights)
+
+    def sample(self) -> SampleBatch:
+        N, T = len(self.envs), self.T
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), bool)
+        trunc_buf = np.zeros((T, N), bool)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        # true successor obs at truncation points (see bootstrap below)
+        final_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+
+        for t in range(T):
+            actions, logp, values = self.module.forward_exploration(
+                self.obs, self.rng)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            val_buf[t] = values
+            for i, env in enumerate(self.envs):
+                o, r, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                done_buf[t, i] = term
+                trunc_buf[t, i] = trunc
+                self.episode_returns[i] += r
+                if term or trunc:
+                    if trunc and not term:
+                        # truncation bootstraps V(true successor state),
+                        # which is NOT the reset obs that replaces it
+                        final_buf[t, i] = np.asarray(o, np.float32)
+                    self.completed_returns.append(self.episode_returns[i])
+                    self.episode_returns[i] = 0.0
+                    o = env.reset()[0]
+                self.obs[i] = o
+
+        # bootstrap values for the step AFTER each transition
+        from .rl_module import mlp_forward
+
+        _, next_vals_last = mlp_forward(self.module.params, self.obs, np)
+        next_val_buf = np.zeros((T, N), np.float32)
+        next_val_buf[:-1] = val_buf[1:]
+        next_val_buf[-1] = next_vals_last
+        # truncated (not terminated) transitions bootstrap V of the TRUE
+        # successor, not of the reset obs that follows in the buffer
+        trunc_only = trunc_buf & ~done_buf
+        if trunc_only.any():
+            _, v_fin = mlp_forward(self.module.params,
+                                   final_buf[trunc_only], np)
+            next_val_buf[trunc_only] = v_fin
+        # terminated states bootstrap 0
+        next_val_buf[done_buf] = 0.0
+
+        def flat(x):
+            return x.reshape((T * N,) + x.shape[2:])
+
+        return SampleBatch(
+            obs=flat(obs_buf), actions=flat(act_buf), rewards=flat(rew_buf),
+            dones=flat(done_buf), truncateds=flat(trunc_buf),
+            logp=flat(logp_buf), values=flat(val_buf),
+            next_values=flat(next_val_buf),
+            # episode boundaries for GAE: time-major layout preserved
+            _shape=np.array([T, N]),
+        )
+
+    def sample_with_len(self):
+        return self.sample()
+
+    def get_metrics(self) -> Dict[str, Any]:
+        recent = self.completed_returns[-100:]
+        out = {
+            "num_episodes": len(self.completed_returns),
+            "episode_return_mean": float(np.mean(recent)) if recent else 0.0,
+            "episode_return_max": float(np.max(recent)) if recent else 0.0,
+        }
+        return out
+
+
+class EnvRunnerGroup:
+    """Remote env-runner actors (reference ``EnvRunnerGroup.foreach_worker``).
+
+    ``num_env_runners == 0`` → a single local runner (debug mode, like the
+    reference's local worker)."""
+
+    def __init__(self, env_creator, module_spec: RLModuleSpec,
+                 num_env_runners: int = 0, num_envs_per_runner: int = 1,
+                 rollout_fragment_length: int = 200, seed: int = 0):
+        self.local: Optional[SingleAgentEnvRunner] = None
+        self.remote: List[Any] = []
+        if num_env_runners == 0:
+            self.local = SingleAgentEnvRunner(
+                env_creator, module_spec, num_envs_per_runner,
+                rollout_fragment_length, seed)
+        else:
+            cls = rt.remote(SingleAgentEnvRunner)
+            self.remote = [
+                cls.options(num_cpus=1).remote(
+                    env_creator, module_spec, num_envs_per_runner,
+                    rollout_fragment_length, seed + 1000 * (i + 1))
+                for i in range(num_env_runners)
+            ]
+
+    def sync_weights(self, weights):
+        if self.local:
+            self.local.set_weights(weights)
+        if self.remote:
+            rt.get([r.set_weights.remote(weights) for r in self.remote],
+                   timeout=60)
+
+    def sample(self) -> List[SampleBatch]:
+        if self.local:
+            return [self.local.sample()]
+        return rt.get([r.sample.remote() for r in self.remote], timeout=300)
+
+    def sample_async_refs(self):
+        """Submit sample() on every runner, return refs (IMPALA path)."""
+        return [(r, r.sample.remote()) for r in self.remote]
+
+    def get_metrics(self) -> Dict[str, Any]:
+        if self.local:
+            return self.local.get_metrics()
+        ms = rt.get([r.get_metrics.remote() for r in self.remote],
+                    timeout=60)
+        total = sum(m["num_episodes"] for m in ms)
+        means = [m["episode_return_mean"] for m in ms
+                 if m["num_episodes"] > 0]
+        return {
+            "num_episodes": total,
+            "episode_return_mean": float(np.mean(means)) if means else 0.0,
+            "episode_return_max": max((m["episode_return_max"]
+                                       for m in ms), default=0.0),
+        }
+
+    def stop(self):
+        for r in self.remote:
+            try:
+                rt.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,123 @@
+"""IMPALA (reference ``rllib/algorithms/impala/impala.py``): asynchronous
+sampling decoupled from learning via in-flight sample refs, importance-
+corrected V-trace-style off-policy updates, throttled weight broadcast
+(``broadcast_interval``, ``impala.py:260``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .learner import LearnerGroup, PPOLearner, compute_gae
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = IMPALA
+        self.broadcast_interval = 1     # learner steps between syncs
+        self.max_requests_in_flight = 2  # per env runner
+        self.vtrace_rho_clip = 1.0
+
+
+class IMPALA(Algorithm):
+    """Async: keep every runner busy with queued sample() calls; the
+    learner trains on whatever arrives (off-policy by a bounded lag)."""
+
+    def __init__(self, config: "IMPALAConfig"):
+        super().__init__(config)
+        if not self.env_runner_group.remote:
+            raise ValueError("IMPALA requires num_env_runners >= 1 "
+                             "(async sampling needs remote runners)")
+        self._inflight: Dict[Any, List] = {}  # ref -> runner
+        self._since_broadcast = 0
+
+    def _build_learner_group(self) -> LearnerGroup:
+        cfg = self.config
+        spec = self.module_spec
+
+        def factory():
+            return PPOLearner(
+                spec, lr=cfg.lr, clip_param=cfg.clip_param,
+                vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff,
+                grad_clip=cfg.grad_clip, mesh=cfg.mesh, seed=cfg.seed)
+
+        return LearnerGroup(factory, num_learners=cfg.num_learners)
+
+    def _fill_sample_pipeline(self):
+        import ray_tpu as rt
+
+        per_runner: Dict[int, int] = {}
+        for ref, runner in self._inflight.items():
+            per_runner[id(runner)] = per_runner.get(id(runner), 0) + 1
+        for runner in self.env_runner_group.remote:
+            while per_runner.get(id(runner), 0) < \
+                    self.config.max_requests_in_flight:
+                ref = runner.sample.remote()
+                self._inflight[ref] = runner
+                per_runner[id(runner)] = per_runner.get(id(runner), 0) + 1
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu as rt
+
+        cfg: IMPALAConfig = self.config
+        self._fill_sample_pipeline()
+
+        # harvest whatever fragments are ready (block for at least one)
+        refs = list(self._inflight.keys())
+        ready, _ = rt.wait(refs, num_returns=1, timeout=60)
+        # opportunistically grab more that are already done
+        more, _ = rt.wait(refs, num_returns=len(refs), timeout=0)
+        ready = list(dict.fromkeys(ready + more))
+        fragments = []
+        for ref in ready:
+            self._inflight.pop(ref, None)
+            fragments.append(rt.get(ref, timeout=60))
+        self._fill_sample_pipeline()
+
+        collected = sum(len(f) for f in fragments)
+        self._timesteps += collected
+
+        # V-trace-style off-policy correction: ρ = π_cur/π_behavior,
+        # clipped at vtrace_rho_clip, weights the GAE deltas; behavior
+        # logp came from the (stale) sampling weights.
+        from .rl_module import mlp_forward
+
+        cur_w = self.learner_group.get_weights()
+        cols = {k: [] for k in ("obs", "actions", "logp_old",
+                                "advantages", "value_targets")}
+        for frag in fragments:
+            logits, _ = mlp_forward(cur_w, frag["obs"], np)
+            z = logits - logits.max(-1, keepdims=True)
+            logp_all = z - np.log(np.exp(z).sum(-1, keepdims=True))
+            logp_cur = logp_all[np.arange(len(frag["actions"])),
+                                frag["actions"]]
+            rho = np.clip(np.exp(logp_cur - frag["logp"]), None,
+                          cfg.vtrace_rho_clip).astype(np.float32)
+            adv, vtarg = compute_gae(
+                frag["rewards"], frag["values"], frag["next_values"],
+                frag["dones"], frag["truncateds"], frag["_shape"],
+                gamma=cfg.gamma, lam=cfg.lam, rho=rho)
+            cols["obs"].append(frag["obs"])
+            cols["actions"].append(frag["actions"])
+            cols["logp_old"].append(frag["logp"])
+            cols["advantages"].append(adv)
+            cols["value_targets"].append(vtarg)
+        train_batch = {k: np.concatenate(v).astype(
+            np.int64 if k == "actions" else np.float32)
+            for k, v in cols.items()}
+
+        metrics = self.learner_group.update(
+            train_batch, minibatch_size=cfg.minibatch_size,
+            num_epochs=1, shuffle_seed=self.iteration)
+
+        self._since_broadcast += 1
+        if self._since_broadcast >= cfg.broadcast_interval:
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights())
+            self._since_broadcast = 0
+        metrics["num_env_steps_trained"] = collected
+        metrics["num_fragments"] = len(fragments)
+        return metrics

@@ -1,0 +1,263 @@
+"""Learner: jitted gradient updates on an RLModule (reference
+``rllib/core/learner/learner.py:108`` + ``torch_learner.py:52``).
+
+The torch-DDP data path becomes a jax mesh: a Learner jits its loss and
+shards the train batch over the mesh's ``dp`` axis (XLA inserts the
+gradient psum the reference got from DDP/NCCL). A LearnerGroup of one
+in-process learner is the single-chip mode; remote learner actors over
+the train BackendExecutor give the multi-chip layout
+(``learner_group.py:158-175``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .rl_module import RLModuleSpec, mlp_forward
+
+
+def compute_gae(rewards, values, next_values, dones, truncateds, shape,
+                gamma: float = 0.99, lam: float = 0.95, rho=None):
+    """Generalized advantage estimation over time-major fragments.
+
+    All inputs are flat [T*N]; ``shape=[T, N]``. Episode ends (done OR
+    truncated) cut the recursion; terminated states bootstrap with 0 via
+    next_values (runner zeroed them), truncated ones with V(s').
+
+    ``rho`` (optional, flat [T*N]): clipped importance ratios
+    π_cur(a|s)/π_behavior(a|s) for off-policy correction — V-trace-style:
+    delta is weighted by ρ_t and the trace decays with c_t = λ·min(ρ_t, 1)
+    (IMPALA, reference ``impala.py``).
+    """
+    T, N = int(shape[0]), int(shape[1])
+    r = rewards.reshape(T, N)
+    v = values.reshape(T, N)
+    nv = next_values.reshape(T, N)
+    cut = (dones | truncateds).reshape(T, N)
+    rho_m = None if rho is None else rho.reshape(T, N)
+    adv = np.zeros((T, N), np.float32)
+    last = np.zeros((N,), np.float32)
+    for t in range(T - 1, -1, -1):
+        delta = r[t] + gamma * nv[t] - v[t]
+        if rho_m is not None:
+            delta = rho_m[t] * delta
+            c = lam * np.minimum(rho_m[t], 1.0)
+        else:
+            c = lam
+        last = delta + gamma * c * last * (~cut[t])
+        adv[t] = last
+    vtarg = adv + v
+    return adv.reshape(-1), vtarg.reshape(-1)
+
+
+class PPOLearner:
+    """Clipped-surrogate PPO with value + entropy terms, jit-compiled."""
+
+    def __init__(self, module_spec: RLModuleSpec, *,
+                 lr: float = 3e-4, clip_param: float = 0.2,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 grad_clip: float = 0.5, mesh=None, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = module_spec
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.adam(lr))
+        module = module_spec.build(seed)
+        self.params = module.params
+        self.opt_state = self.optimizer.init(self.params)
+        self.clip_param = clip_param
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.mesh = mesh
+        self._step = self._build_step()
+
+    def _build_step(self) -> Callable:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        clip, vfc, entc = (self.clip_param, self.vf_coeff,
+                           self.entropy_coeff)
+        optimizer = self.optimizer
+
+        def loss_fn(params, batch):
+            logits, value = mlp_forward(params, batch["obs"], jnp)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            policy_loss = -surr.mean()
+            vf_loss = jnp.square(value - batch["value_targets"]).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = policy_loss + vfc * vf_loss - entc * entropy
+            return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                           "entropy": entropy, "total_loss": total}
+
+        def step(params, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        if self.mesh is not None:
+            # dp-shard the minibatch; params/opt replicated. XLA inserts
+            # the gradient psum over ICI — the DDP-allreduce sibling.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.parallel import batch_sharding
+
+            rep = NamedSharding(self.mesh, P())
+            return jax.jit(step, in_shardings=(rep, rep,
+                                               batch_sharding(self.mesh)),
+                           out_shardings=(rep, rep, None))
+        return jax.jit(step)
+
+    def update(self, batch: Dict[str, np.ndarray], *,
+               minibatch_size: Optional[int] = None,
+               num_epochs: int = 1,
+               shuffle_seed: int = 0) -> Dict[str, float]:
+        import jax
+
+        n = len(batch["obs"])
+        minibatch_size = minibatch_size or n
+        rng = np.random.default_rng(shuffle_seed)
+        # advantage normalization (standard PPO practice)
+        adv = batch["advantages"]
+        batch = dict(batch)
+        batch["advantages"] = ((adv - adv.mean())
+                               / (adv.std() + 1e-8)).astype(np.float32)
+        metrics = {}
+        for _ in range(num_epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n, minibatch_size):
+                idx = perm[lo:lo + minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                self.params, self.opt_state, metrics = self._step(
+                    self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = weights
+
+    def get_state(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state)}
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+class LearnerGroup:
+    """One local learner or N remote learner actors with gradient-mean
+    semantics (reference ``learner_group.py:69``)."""
+
+    def __init__(self, learner_factory: Callable[[], PPOLearner],
+                 num_learners: int = 0):
+        import ray_tpu as rt
+
+        self.local: Optional[PPOLearner] = None
+        self.remote: List[Any] = []
+        if num_learners == 0:
+            self.local = learner_factory()
+        else:
+            class _LearnerActor:
+                def __init__(self):
+                    self.learner = learner_factory()
+
+                def update(self, batch, **kw):
+                    return self.learner.update(batch, **kw)
+
+                def get_weights(self):
+                    return self.learner.get_weights()
+
+                def set_weights(self, w):
+                    return self.learner.set_weights(w)
+
+                def get_state(self):
+                    return self.learner.get_state()
+
+                def set_state(self, s):
+                    return self.learner.set_state(s)
+
+            cls = rt.remote(_LearnerActor)
+            self.remote = [cls.options(num_cpus=1).remote()
+                           for _ in range(num_learners)]
+            # identical init: broadcast learner 0's weights
+            w = rt.get(self.remote[0].get_weights.remote(), timeout=60)
+            rt.get([r.set_weights.remote(w) for r in self.remote[1:]],
+                   timeout=60)
+
+    def update(self, batch: Dict[str, np.ndarray], **kw) -> Dict[str, float]:
+        import ray_tpu as rt
+
+        if self.local is not None:
+            return self.local.update(batch, **kw)
+        # shard the batch across learners; average resulting weights
+        # (equivalent to synchronized data-parallel SGD for equal shards)
+        n = len(batch["obs"])
+        k = len(self.remote)
+        per = n // k
+        refs = []
+        for i, r in enumerate(self.remote):
+            lo, hi = i * per, ((i + 1) * per if i < k - 1 else n)
+            shard = {key: v[lo:hi] for key, v in batch.items()}
+            refs.append(r.update.remote(shard, **kw))
+        metrics = rt.get(refs, timeout=300)
+        ws = rt.get([r.get_weights.remote() for r in self.remote],
+                    timeout=60)
+        import jax
+
+        mean_w = jax.tree.map(
+            lambda *xs: np.mean(np.stack(xs), axis=0), *ws)
+        rt.get([r.set_weights.remote(mean_w) for r in self.remote],
+               timeout=60)
+        out = {k2: float(np.mean([m[k2] for m in metrics]))
+               for k2 in metrics[0]}
+        return out
+
+    def get_weights(self):
+        import ray_tpu as rt
+
+        if self.local is not None:
+            return self.local.get_weights()
+        return rt.get(self.remote[0].get_weights.remote(), timeout=60)
+
+    def get_state(self):
+        import ray_tpu as rt
+
+        if self.local is not None:
+            return self.local.get_state()
+        return rt.get(self.remote[0].get_state.remote(), timeout=60)
+
+    def set_state(self, state):
+        import ray_tpu as rt
+
+        if self.local is not None:
+            return self.local.set_state(state)
+        rt.get([r.set_state.remote(state) for r in self.remote],
+               timeout=60)
+
+    def stop(self):
+        import ray_tpu as rt
+
+        for r in self.remote:
+            try:
+                rt.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,91 @@
+"""RLModule: policy+value network with a dual numpy/jax forward.
+
+Reference: ``rllib/core/rl_module/rl_module.py`` (forward_exploration /
+forward_inference / forward_train). TPU-split design: env-runner actors do
+rollout inference with the NUMPY path (no accelerator, no jax import in
+sampling processes — the chips belong to the learners), while learners run
+the identical math under jit. One parameter pytree serves both.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+class RLModuleSpec:
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def build(self, seed: int = 0) -> "DiscreteMLPModule":
+        return DiscreteMLPModule(self, seed)
+
+
+def _init_mlp(spec: RLModuleSpec, seed: int) -> Params:
+    rng = np.random.default_rng(seed)
+
+    def dense(fan_in, fan_out, scale=None):
+        s = scale if scale is not None else np.sqrt(2.0 / fan_in)
+        return {"w": (rng.standard_normal((fan_in, fan_out)) * s
+                      ).astype(np.float32),
+                "b": np.zeros((fan_out,), np.float32)}
+
+    sizes = (spec.obs_dim,) + spec.hidden
+    # SEPARATE policy and value trunks: a shared trunk lets the large
+    # unnormalized value loss swamp the policy features (observed as
+    # entropy pinned near-uniform while greedy eval is already perfect).
+    return {
+        "pi_hidden": [dense(sizes[i], sizes[i + 1])
+                      for i in range(len(sizes) - 1)],
+        "vf_hidden": [dense(sizes[i], sizes[i + 1])
+                      for i in range(len(sizes) - 1)],
+        "logits": dense(sizes[-1], spec.num_actions, scale=0.01),
+        "value": dense(sizes[-1], 1, scale=1.0),
+    }
+
+
+def mlp_forward(params: Params, obs, xp=np):
+    """(logits, value) — ``xp`` is numpy (rollouts) or jax.numpy (learner)."""
+    h = obs
+    for layer in params["pi_hidden"]:
+        h = xp.tanh(h @ layer["w"] + layer["b"])
+    logits = h @ params["logits"]["w"] + params["logits"]["b"]
+    hv = obs
+    for layer in params["vf_hidden"]:
+        hv = xp.tanh(hv @ layer["w"] + layer["b"])
+    value = (hv @ params["value"]["w"] + params["value"]["b"])[..., 0]
+    return logits, value
+
+
+class DiscreteMLPModule:
+    """Categorical-action module (CartPole-class tasks + Atari-on-MLP)."""
+
+    def __init__(self, spec: RLModuleSpec, seed: int = 0):
+        self.spec = spec
+        self.params: Params = _init_mlp(spec, seed)
+
+    # ------------------------------------------------- rollout (numpy)
+    def forward_exploration(self, obs: np.ndarray, rng: np.random.Generator):
+        logits, value = mlp_forward(self.params, obs, np)
+        z = logits - logits.max(-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(-1, keepdims=True)
+        actions = np.array([rng.choice(len(row), p=row) for row in p])
+        logp = np.log(p[np.arange(len(actions)), actions] + 1e-20)
+        return actions, logp, value
+
+    def forward_inference(self, obs: np.ndarray):
+        logits, _ = mlp_forward(self.params, obs, np)
+        return logits.argmax(-1)
+
+    # ------------------------------------------------------- weights
+    def get_weights(self) -> Params:
+        return self.params
+
+    def set_weights(self, params: Params):
+        self.params = params

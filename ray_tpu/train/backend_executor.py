@@ -179,6 +179,8 @@ class BackendExecutor:
         self.worker_group.start()
         self.backend.on_start(self.worker_group, self.backend_config)
         train_fn, config, session_kwargs = self._train_args
+        for kw in session_kwargs:
+            kw["incarnation"] = kw.get("incarnation", 0) + 1
         self.start_training(train_fn, config, session_kwargs)
 
     def shutdown(self):

@@ -26,7 +26,8 @@ class _TrainSession:
                  storage_dir: Optional[str] = None,
                  latest_checkpoint: Optional[Checkpoint] = None,
                  dataset_shards: Optional[Dict[str, Any]] = None,
-                 trial_info: Optional[Dict[str, Any]] = None):
+                 trial_info: Optional[Dict[str, Any]] = None,
+                 incarnation: int = 0):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -40,6 +41,7 @@ class _TrainSession:
         self.error: Optional[BaseException] = None
         self._report_idx = 0
         self._own_ckpts: list = []
+        self.incarnation = incarnation
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
@@ -55,10 +57,12 @@ class _TrainSession:
             # valid for the whole run.
             if self.storage_dir:
                 os.makedirs(self.storage_dir, exist_ok=True)
+                # incarnation in the name: a restarted group's indices
+                # begin at 0 again and must not overwrite tracked dirs
                 dst = os.path.join(
                     self.storage_dir,
                     f"checkpoint_rank{self.world_rank}_"
-                    f"{self._report_idx:06d}")
+                    f"i{self.incarnation}_{self._report_idx:06d}")
                 if os.path.abspath(checkpoint.path) != dst:
                     if os.path.exists(dst):
                         shutil.rmtree(dst)

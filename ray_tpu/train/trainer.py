@@ -86,6 +86,11 @@ class TrainingIterator:
         lead = by_rank.get(min(by_rank))
         metrics = dict(lead["metrics"])
         meta = lead.get("checkpoint")
+        # only rank 0's checkpoints are registrable: other ranks GC their
+        # own dirs (keep-2), so a flushed partial index led by rank>0
+        # could hand the manager an already-deleted path
+        if min(by_rank) != 0:
+            meta = None
         if meta:
             ckpt = self.ckpt_manager.register(Checkpoint(meta["path"]),
                                               metrics)
