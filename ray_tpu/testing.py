@@ -13,19 +13,42 @@ import os
 def force_host_devices(n: int = 8) -> None:
     """Force this process (and children) onto N virtual CPU devices.
 
-    Must be called before the first jax backend use in this process.
-    Also scrubs env so spawned worker processes inherit the CPU platform
-    (any vendor PJRT plugin registered by sitecustomize is bypassed).
+    Ideally called before the first jax backend use in this process; if a
+    vendor PJRT backend already initialized, it is torn down so the CPU
+    platform (with ``n`` virtual devices) takes over. Also scrubs env so
+    spawned worker processes inherit the CPU platform.
     """
+    import sys
+
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}").strip()
+    already_imported = "jax" in sys.modules
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if already_imported:
+        devs = jax.devices()
+        if devs[0].platform == "cpu" and len(devs) >= n:
+            return  # already on a big-enough CPU platform; keep jit caches
+        # A backend (possibly a vendor plugin with 1 device) is live — and
+        # XLA_FLAGS has already been parsed, so the env var alone cannot
+        # grow the CPU device count. Tear the backends down, then set the
+        # device count via config (only legal while no backend is live).
+        import logging
+
+        import jax.extend as jex
+
+        try:
+            jex.backend.clear_backends()
+            jax.config.update("jax_num_cpu_devices", n)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "force_host_devices(%d): backend teardown failed; "
+                "jax may still report the wrong device count", n)
 
 
 def assert_device_count(n: int) -> None:

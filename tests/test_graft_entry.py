@@ -1,0 +1,61 @@
+"""Driver-gate regression tests: run __graft_entry__ in fresh subprocesses.
+
+Round 1 shipped a ``dryrun_multichip`` that passed CI (conftest forces the
+8-CPU platform process-wide) yet failed the driver gate, which runs it in a
+bare process where the vendor PJRT plugin sees one chip. These tests spawn
+fresh interpreters with the *driver's* environment — no ``JAX_PLATFORMS``,
+no ``XLA_FLAGS`` — so the entry points must do their own platform setup.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _driver_env():
+    """Env a driver process would have: no test-harness jax overrides."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env.pop("RT_DRYRUN_REAL_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_dryrun_multichip_fresh_subprocess():
+    code = "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=_driver_env(), cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"dryrun_multichip(8) failed in a fresh subprocess:\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}")
+    assert "dryrun_multichip ok" in proc.stdout
+
+
+def test_dryrun_multichip_after_jax_import():
+    """Even if jax initialized a 1-device backend first, the dryrun recovers."""
+    code = (
+        "import jax; jax.devices();"
+        "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=_driver_env(), cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"dryrun after jax import failed:\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr[-4000:]}")
+
+
+def test_entry_compiles_single_chip():
+    code = (
+        "import jax; from __graft_entry__ import entry;"
+        "fn, args = entry(); out = jax.jit(fn)(*args);"
+        "jax.block_until_ready(out); print('entry ok', out.shape)")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=_driver_env(), cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"entry() compile failed:\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr[-4000:]}")
+    assert "entry ok" in proc.stdout
