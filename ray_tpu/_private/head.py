@@ -57,6 +57,8 @@ class ActorInfo:
     restarts_used: int = 0
     creation_spec_meta: Any = None  # for restarts
     death_cause: str = ""
+    registered_at: float = 0.0
+    creation_started: bool = False
 
 
 @dataclass
@@ -152,6 +154,17 @@ class HeadService:
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None:
                     await self._on_worker_death(w, f"exit code {w.proc.returncode}")
+            # Registered-but-never-created actors (client died between the
+            # register and create RPCs) would otherwise pin their name
+            # forever; expire them after the lease timeout.
+            ttl = self.config.worker_lease_timeout_s
+            now = time.time()
+            for a in list(self.actors.values()):
+                if (a.state == "PENDING" and not a.creation_started
+                        and a.registered_at
+                        and now - a.registered_at > ttl):
+                    self._mark_actor_dead(a, "registration expired: "
+                                             "creation never requested")
 
     async def _on_worker_death(self, w: WorkerInfo, cause: str):
         self.workers.pop(w.worker_id, None)
@@ -424,27 +437,44 @@ class HeadService:
         self._pump_leases()
         return {}
 
-    async def _rpc_create_actor(self, payload, bufs):
+    def _register_actor(self, payload) -> ActorInfo:
+        """Record actor metadata + name (state PENDING). Mirrors the sync
+        half of the reference's split (``gcs_actor_manager.cc:311``
+        RegisterActor vs :340 CreateActor)."""
         actor_id = ActorID.from_hex(payload["actor_id"])
+        existing = self.actors.get(actor_id)
+        if existing is not None and existing.state != "DEAD":
+            return existing
+        # DEAD records (e.g. a failed earlier placement) are rebuilt so a
+        # retried create re-registers the name it lost in _mark_actor_dead.
         name = payload.get("name") or ""
         if name and name in self.named_actors:
             raise rpc.RpcError(f"actor name '{name}' already taken")
+        actor = ActorInfo(
+            actor_id=actor_id, name=name, state="PENDING", worker=None,
+            resources=payload.get("resources") or {},
+            max_restarts=payload.get("max_restarts", 0),
+            creation_spec_meta=payload["spec_meta"],
+            registered_at=time.time(),
+        )
+        self.actors[actor_id] = actor
+        if name:
+            self.named_actors[name] = actor_id
+        return actor
+
+    async def _rpc_register_actor(self, payload, bufs):
+        self._register_actor(payload)
+        return {}
+
+    async def _rpc_create_actor(self, payload, bufs):
+        actor = self._register_actor(payload)
+        actor.creation_started = True
         req = payload.get("resources") or {}
         strategy = payload.get("strategy") or {}
         pg_meta = None
         if strategy.get("kind") == "PLACEMENT_GROUP":
             pg_meta = (PlacementGroupID.from_hex(strategy["pg_id"]),
                        strategy.get("bundle_index", -1))
-        # Register first (so state queries see PENDING), then wait for
-        # resources — actors hold them for life.
-        actor = ActorInfo(
-            actor_id=actor_id, name=name, state="PENDING", worker=None,
-            resources=req, max_restarts=payload.get("max_restarts", 0),
-            creation_spec_meta=payload["spec_meta"],
-        )
-        self.actors[actor_id] = actor
-        if name:
-            self.named_actors[name] = actor_id
         deadline = time.time() + self.config.worker_lease_timeout_s
         while not self._try_grant(req, pg_meta):
             if time.time() > deadline:

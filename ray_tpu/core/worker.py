@@ -609,11 +609,27 @@ class CoreWorker:
         st = {"state": "PENDING", "address": None, "error": None,
               "event": threading.Event()}
         self._actor_state[actor_id.binary()] = st
+        registered = threading.Event()
+        reg_err: list = []
 
         async def _create():
             try:
                 await self._head.call_simple(
                     "subscribe", {"topic": f"actor:{actor_id.hex()}"})
+                # Synchronous registration (reference: RegisterActor is a
+                # blocking GCS call, gcs_actor_manager.cc:311) so named
+                # actors and list_actors see the actor as soon as
+                # .remote() returns; placement stays async.
+                await self._head.call_simple("register_actor", payload)
+            except Exception as e:  # noqa: BLE001
+                reg_err.append(e)
+                st["state"] = "DEAD"
+                st["error"] = str(e)
+                st["event"].set()
+                registered.set()
+                return
+            registered.set()
+            try:
                 meta = await self._head.call_simple("create_actor", payload)
                 st["address"] = meta["address"]
                 st["state"] = "ALIVE"
@@ -624,6 +640,11 @@ class CoreWorker:
                 st["event"].set()
 
         asyncio.run_coroutine_threadsafe(_create(), self._loop)
+        if not registered.wait(timeout=30):
+            raise ActorDiedError("actor registration timed out (head "
+                                 "unresponsive for 30s)")
+        if reg_err:
+            raise ActorDiedError(f"actor registration failed: {reg_err[0]}")
         return actor_id
 
     def wait_actor_ready(self, actor_id: ActorID, timeout=None):

@@ -40,8 +40,8 @@ class GPTConfig:
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16        # activation/matmul dtype
     param_dtype: Any = jnp.float32   # master params
-    remat: bool = True
-    attn_backend: str = "xla"        # xla | flash | ring
+    remat: Any = "dots"              # none|dots|full (bool accepted)
+    attn_backend: str = "auto"       # auto | xla | flash | ring
     sp_axis: Optional[str] = None    # mesh axis for ring attention
 
     @property
@@ -128,15 +128,28 @@ def _attention_xla(q, k, v, cfg: GPTConfig):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
+def _resolve_attn_backend(cfg: GPTConfig, seq: int) -> str:
+    """auto → flash on TPU when the Pallas kernel's constraints hold."""
+    if cfg.attn_backend != "auto":
+        return cfg.attn_backend
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and seq >= 512 and seq % 256 == 0 and cfg.head_dim % 8 == 0:
+        return "flash"
+    return "xla"
+
+
 def _attention(q, k, v, cfg: GPTConfig):
-    if cfg.attn_backend == "flash":
+    backend = _resolve_attn_backend(cfg, q.shape[1])
+    if backend == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=True)
-    if cfg.attn_backend == "ring":
+    if backend == "ring":
         from ray_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+    if backend != "xla":
+        raise ValueError(f"unknown attn_backend {backend!r}")
     return _attention_xla(q, k, v, cfg)
 
 
@@ -164,9 +177,20 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
     x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
     x = x + params["pos_embed"][:S].astype(cfg.dtype)[None]
 
+    # Remat policy: "full" recomputes everything (max HBM savings, +1 fwd
+    # of FLOPs); "dots" keeps matmul outputs and recomputes only cheap
+    # elementwise ops; "none" saves all activations (fastest when the
+    # model fits — GPT-2-small at bench shapes trivially does).
+    remat = {True: "full", False: "none"}.get(cfg.remat, cfg.remat)
     block_fn = _block
-    if cfg.remat:
+    if remat == "full":
         block_fn = jax.checkpoint(_block, static_argnums=(2,))
+    elif remat == "dots":
+        block_fn = jax.checkpoint(
+            _block, static_argnums=(2,),
+            policy=jax.checkpoint_policies.checkpoint_dots)
+    elif remat != "none":
+        raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
     def scan_body(carry, layer_params):
         return block_fn(carry, layer_params, cfg), None
