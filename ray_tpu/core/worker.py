@@ -639,10 +639,23 @@ class CoreWorker:
             finally:
                 st["event"].set()
 
-        asyncio.run_coroutine_threadsafe(_create(), self._loop)
-        if not registered.wait(timeout=30):
-            raise ActorDiedError("actor registration timed out (head "
-                                 "unresponsive for 30s)")
+        create_fut = asyncio.run_coroutine_threadsafe(_create(), self._loop)
+        timeout = self.config.worker_lease_timeout_s
+        if not registered.wait(timeout=timeout):
+            # Cancel the in-flight coroutine and best-effort kill so a
+            # merely-slow head cannot later create an orphan actor that
+            # pins its name and resources with no live handle.
+            create_fut.cancel()
+            st["state"] = "DEAD"
+            st["error"] = "registration timed out"
+            st["event"].set()
+            try:
+                self.kill_actor(actor_id)
+            except Exception:
+                pass
+            raise ActorDiedError(
+                f"actor registration timed out (head unresponsive for "
+                f"{timeout}s)")
         if reg_err:
             raise ActorDiedError(f"actor registration failed: {reg_err[0]}")
         return actor_id

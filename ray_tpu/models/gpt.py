@@ -138,22 +138,67 @@ def _resolve_attn_backend(cfg: GPTConfig, seq: int) -> str:
     return "xla"
 
 
-def _attention(q, k, v, cfg: GPTConfig):
+def _sp_shard_map(fn, cfg: GPTConfig, mesh):
+    """Wrap a per-device SP attention fn in shard_map over the mesh.
+
+    Activations are [B, S, H, hd]: batch over (dp, fsdp), seq over the sp
+    axis, heads over tp — matching LM_RULES' qkv column sharding.
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+    bt = tuple(a for a in ("dp", "fsdp") if a in names) or None
+    tp = "tp" if "tp" in names else None
+    spec = P(bt, cfg.sp_axis, tp, None)
+    inner = functools.partial(fn, axis_name=cfg.sp_axis, causal=True,
+                              axis_size=mesh.shape[cfg.sp_axis])
+    return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)
+
+
+def _attention(q, k, v, cfg: GPTConfig, mesh=None):
     backend = _resolve_attn_backend(cfg, q.shape[1])
     if backend == "flash":
+        import functools
+
         from ray_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
-    if backend == "ring":
-        from ray_tpu.ops.ring_attention import ring_attention
+        fn = functools.partial(flash_attention, causal=True)
+        if mesh is not None and mesh.size > 1:
+            # GSPMD cannot auto-partition Mosaic kernels; on a multi-device
+            # mesh the kernel must run per-device under shard_map (batch
+            # over dp/fsdp, heads over tp, sequence unsharded).
+            from jax.sharding import PartitionSpec as P
 
-        return ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+            names = set(mesh.axis_names)
+            bt = tuple(a for a in ("dp", "fsdp") if a in names) or None
+            tp = "tp" if "tp" in names else None
+            spec = P(bt, None, tp, None)
+            # check_vma=False: pallas_call's out_shape carries no vma
+            # annotation, which strict shard_map rejects.
+            return jax.shard_map(lambda q, k, v: fn(q, k, v), mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False)(q, k, v)
+        return fn(q, k, v)
+    if backend in ("ring", "ulysses"):
+        from ray_tpu.ops import ring_attention as ra
+
+        if mesh is None or not cfg.sp_axis or cfg.sp_axis not in set(
+                mesh.axis_names):
+            raise ValueError(
+                f"attn_backend={backend!r} needs a mesh with the sp axis "
+                f"{cfg.sp_axis!r}; pass mesh via make_train_step")
+        fn = (ra.ring_attention if backend == "ring"
+              else ra.ulysses_attention)
+        return _sp_shard_map(fn, cfg, mesh)(q, k, v)
     if backend != "xla":
         raise ValueError(f"unknown attn_backend {backend!r}")
     return _attention_xla(q, k, v, cfg)
 
 
-def _block(x, layer_params, cfg: GPTConfig):
+def _block(x, layer_params, cfg: GPTConfig, mesh=None):
     """One transformer block; ``layer_params`` leaves have no layer dim."""
     B, S, d = x.shape
     H, hd = cfg.n_head, cfg.head_dim
@@ -162,7 +207,7 @@ def _block(x, layer_params, cfg: GPTConfig):
     q = _mm(h, p["wq"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
     k = _mm(h, p["wk"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
     v = _mm(h, p["wv"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
-    att = _attention(q, k, v, cfg).reshape(B, S, d)
+    att = _attention(q, k, v, cfg, mesh).reshape(B, S, d)
     x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
     h = _rmsnorm(x, p["ln2_scale"])
     h = _mm(h, p["w1"]["kernel"], cfg.dtype)
@@ -171,8 +216,13 @@ def _block(x, layer_params, cfg: GPTConfig):
     return x
 
 
-def forward(params: Params, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, vocab] float32."""
+def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
+            mesh=None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32.
+
+    ``mesh`` is only needed for shard_map attention backends (ring,
+    ulysses); GSPMD backends (xla, flash) ignore it.
+    """
     B, S = tokens.shape
     x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
     x = x + params["pos_embed"][:S].astype(cfg.dtype)[None]
@@ -184,16 +234,16 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
     remat = {True: "full", False: "none"}.get(cfg.remat, cfg.remat)
     block_fn = _block
     if remat == "full":
-        block_fn = jax.checkpoint(_block, static_argnums=(2,))
+        block_fn = jax.checkpoint(_block, static_argnums=(2, 3))
     elif remat == "dots":
         block_fn = jax.checkpoint(
-            _block, static_argnums=(2,),
+            _block, static_argnums=(2, 3),
             policy=jax.checkpoint_policies.checkpoint_dots)
     elif remat != "none":
         raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
     def scan_body(carry, layer_params):
-        return block_fn(carry, layer_params, cfg), None
+        return block_fn(carry, layer_params, cfg, mesh), None
 
     x, _ = lax.scan(scan_body, x, params["block"])
     x = _rmsnorm(x, params["ln_f_scale"])
@@ -204,13 +254,14 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array],
-            cfg: GPTConfig) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            cfg: GPTConfig, mesh=None
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy. batch: tokens [B, S+1] (or tokens+targets)."""
     if "targets" in batch:
         tokens, targets = batch["tokens"], batch["targets"]
     else:
         tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    logits = forward(params, tokens, cfg)
+    logits = forward(params, tokens, cfg, mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     loss = -jnp.mean(ll)
@@ -254,13 +305,16 @@ def make_train_step(cfg: GPTConfig, mesh, optimizer=None, *,
         "opt": shr.tree_shardings(abstract["opt"], mesh, rules),
         "step": NamedSharding(mesh, P()),
     }
+    # Tokens stay [B, S+1] (S+1 rarely divides the sp axis); the attention
+    # shard_map's in_specs pull activations onto the sp axis and GSPMD
+    # propagates that sharding through the surrounding ops.
     batch_sh = shr.batch_sharding(mesh)
 
     init_jit = jax.jit(init, out_shardings=state_sh)
 
     def step(state, batch):
         (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state["params"], batch, cfg)
+            loss_fn, has_aux=True)(state["params"], batch, cfg, mesh)
         updates, new_opt = optimizer.update(grads, state["opt"],
                                             state["params"])
         new_params = optax.apply_updates(state["params"], updates)

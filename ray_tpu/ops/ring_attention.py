@@ -1,0 +1,142 @@
+"""Sequence-parallel attention: ring (ppermute) and Ulysses (all-to-all).
+
+The reference has **no** native sequence/context parallelism — SURVEY.md §5
+records zero hits for ring-attention/Ulysses across the tree; long-context
+scaling is delegated to integrations. Here it is first-class: both
+strategies operate on sequence-sharded activations ``[B, S/n, H, hd]``
+inside a ``jax.shard_map`` region over a mesh axis (the TPU-native
+replacement for the reference's NCCL process groups; collectives ride ICI).
+
+**Ring** (`ring_attention`): K/V chunks rotate around the ring via
+``lax.ppermute`` while each device accumulates an online softmax over its
+local queries — attention memory stays O(S_local²) per device regardless
+of global sequence length. Causality is enforced per source chunk: chunks
+from later ranks are skipped entirely (``lax.cond`` — no FLOPs burned on
+fully-masked blocks), the self chunk gets the triangular mask, earlier
+chunks are attended in full.
+
+**Ulysses** (`ulysses_attention`): two ``lax.all_to_all``s swap the
+sequence shard for a head shard so each device computes full-sequence
+attention for ``H/n`` heads. Cheaper collectives than ring for moderate
+S, but requires ``n_heads % axis_size == 0``.
+
+Both are pure differentiable JAX (ppermute/all_to_all have transpose
+rules), so they compose with grads, remat, and the rest of GSPMD.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _axis_size(axis_name: str, axis_size: Optional[int]) -> int:
+    if axis_size is not None:
+        return axis_size
+    return lax.axis_size(axis_name)
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                   sm_scale: Optional[float] = None,
+                   axis_size: Optional[int] = None) -> jax.Array:
+    """Ring attention over a mesh axis. Call inside ``jax.shard_map``.
+
+    q, k, v: local chunks ``[B, S_loc, H, hd]`` (sequence sharded over
+    ``axis_name``). Returns local output ``[B, S_loc, H, hd]``.
+    """
+    n = _axis_size(axis_name, axis_size)
+    r = lax.axis_index(axis_name)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    B, Sq, H, hd = q.shape
+    Sk = k.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    tril = jnp.tril(jnp.ones((Sq, Sk), jnp.bool_))
+
+    # Keep einsum operands in the input dtype (bf16 on TPU — MXU-native;
+    # an f32 cast forces a multi-pass matmul ~4x slower). Accumulation is
+    # f32 via preferred_element_type; only the softmax state is f32.
+    qf = (q * jnp.asarray(sm_scale, q.dtype))
+
+    def attend(carry_o, m, l, kc, vc, src):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kc,
+                            preferred_element_type=jnp.float32)
+        if causal:
+            allowed = (src < r) | (tril & (src == r))
+            logits = jnp.where(allowed, logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))       # [B,H,Sq]
+        p = jnp.exp(logits - m_new[..., None])
+        alpha = jnp.exp(m - m_new)                             # [B,H,Sq]
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
+                        preferred_element_type=jnp.float32)
+        o_new = carry_o * jnp.transpose(alpha, (0, 2, 1))[..., None] + pv
+        return o_new, m_new, l_new
+
+    def step(carry, t):
+        o, m, l, kc, vc = carry
+        src = (r - t) % n
+        if causal:
+            o, m, l = lax.cond(
+                src <= r,
+                lambda args: attend(*args),
+                lambda args: (args[0], args[1], args[2]),
+                (o, m, l, kc, vc, src))
+        else:
+            o, m, l = attend(o, m, l, kc, vc, src)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    # The accumulators must carry the same varying-across-mesh type as the
+    # attend() outputs for shard_map's cond VMA check, whatever axes the
+    # surrounding shard_map spans. Deriving them from q (times zero — XLA
+    # folds it) inherits exactly q's vma.
+    zero = jnp.sum(qf.astype(jnp.float32) * 0.0, axis=-1)  # vma of q
+    zero_t = jnp.transpose(zero, (0, 2, 1))          # [B, H, Sq] f32
+    init = (qf.astype(jnp.float32) * 0.0,
+            zero_t + NEG_INF,
+            zero_t,
+            k, v)
+    (o, m, l, _, _), _ = lax.scan(step, init, jnp.arange(n))
+    o = o / jnp.transpose(l, (0, 2, 1))[..., None]
+    return o.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      axis_size: Optional[int] = None) -> jax.Array:
+    """Ulysses attention: all-to-all head/seq swap. Call inside shard_map.
+
+    q, k, v: local chunks ``[B, S_loc, H, hd]``; requires ``H % n == 0``.
+    """
+    n = _axis_size(axis_name, axis_size)
+    H = q.shape[2]
+    assert H % n == 0, f"ulysses needs n_head ({H}) % axis size ({n}) == 0"
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def gather_seq(x):  # [B, S/n, H, hd] -> [B, S, H/n, hd]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = gather_seq(q), gather_seq(k), gather_seq(v)
+    S = qg.shape[1]
+    # bf16 einsum operands, f32 accumulation (see ring_attention note).
+    logits = jnp.einsum("bqhd,bkhd->bhqk",
+                        qg * jnp.asarray(sm_scale, q.dtype), kg,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vg.dtype), vg,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    # [B, S, H/n, hd] -> [B, S/n, H, hd]
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

@@ -69,6 +69,29 @@ def test_flash_uneven_blocks():
     assert float(jnp.max(jnp.abs(out - ref))) < 1e-3
 
 
+def test_gpt_trains_with_flash_backend_multidevice_mesh():
+    """flash backend on a multi-device mesh routes through shard_map
+    (GSPMD cannot partition Mosaic kernels; regression for the auto
+    backend on real multi-chip slices)."""
+    import dataclasses
+
+    import numpy as np
+
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel import create_mesh
+
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    cfg = dataclasses.replace(
+        gpt.CONFIGS["nano"], attn_backend="flash", max_seq=256)
+    init, step, _, batch_sh = gpt.make_train_step(cfg, mesh)
+    state = init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (8, 257)).astype(np.int32), batch_sh)
+    state, metrics = step(state, {"tokens": toks})
+    assert jnp.isfinite(metrics["loss"])
+
+
 def test_gpt_trains_with_flash_backend():
     """nano GPT trains a step with attn_backend='flash' on the CPU mesh."""
     import dataclasses

@@ -1,0 +1,110 @@
+"""Sequence-parallel attention (ring + Ulysses) vs single-device XLA.
+
+Runs on the virtual 8-device CPU mesh from conftest. These are the
+equivalence tests VERDICT round 1 asked for: the sp-sharded result must
+match the unsharded einsum attention bit-for-tolerance.
+"""
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.gpt import GPTConfig, _attention_xla
+from ray_tpu.ops.ring_attention import ring_attention, ulysses_attention
+from ray_tpu.parallel import create_mesh
+
+
+def _qkv(key, B, S, H, hd):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (B, S, H, hd), jnp.float32)
+                 for k in ks)
+
+
+def _run_sp(fn, mesh, axis, q, k, v):
+    spec = P(None, axis, None, None)
+    inner = functools.partial(fn, axis_name=axis, causal=True,
+                              axis_size=mesh.shape[axis])
+    sharded = jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+    return sharded(q, k, v)
+
+
+@pytest.mark.parametrize("fn", [ring_attention, ulysses_attention],
+                         ids=["ring", "ulysses"])
+def test_sp_attention_matches_xla(fn):
+    B, S, H, hd = 2, 128, 4, 32
+    cfg = GPTConfig(n_head=H, d_model=H * hd)
+    q, k, v = _qkv(jax.random.PRNGKey(0), B, S, H, hd)
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    out = _run_sp(fn, mesh, "sp", q, k, v)
+    ref = _attention_xla(q, k, v, cfg)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < 1e-4, err
+
+
+def test_ring_gradients_match_xla():
+    B, S, H, hd = 1, 64, 2, 16
+    cfg = GPTConfig(n_head=H, d_model=H * hd)
+    q, k, v = _qkv(jax.random.PRNGKey(1), B, S, H, hd)
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    spec = P(None, "sp", None, None)
+    inner = functools.partial(ring_attention, axis_name="sp", causal=True,
+                              axis_size=4)
+    sp_fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(sp_fn(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_xla(q, k, v, cfg) ** 2)
+
+    gs = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gs, gr):
+        rel = float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(b)) + 1e-9))
+        assert rel < 1e-4, (name, rel)
+
+
+@pytest.mark.parametrize("backend", ["ring", "ulysses"])
+def test_gpt_trains_on_dp_sp_mesh(backend):
+    """nano GPT trains one step with SP attention on a {dp, sp} mesh."""
+    from ray_tpu.models import gpt
+
+    # ulysses needs n_head (2 for nano) divisible by the sp size
+    sp = 4 if backend == "ring" else 2
+    mesh = create_mesh({"dp": 8 // sp, "sp": sp})
+    cfg = dataclasses.replace(gpt.CONFIGS["nano"], attn_backend=backend,
+                              sp_axis="sp")
+    init, step, _, batch_sh = gpt.make_train_step(cfg, mesh)
+    state = init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (8, 65)).astype(np.int32), batch_sh)
+    state, metrics = step(state, {"tokens": toks})
+    loss1 = float(metrics["loss"])
+    state, metrics = step(state, {"tokens": toks})
+    loss2 = float(metrics["loss"])
+    assert np.isfinite(loss1) and np.isfinite(loss2)
+    assert loss2 < loss1  # it learns the (tiny, memorizable) batch
+
+
+def test_ring_matches_gspmd_xla_model_level():
+    """Full nano forward: ring backend == xla backend on the same mesh."""
+    from ray_tpu.models import gpt
+
+    mesh = create_mesh({"dp": 2, "sp": 4})
+    cfg_x = dataclasses.replace(gpt.CONFIGS["nano"], attn_backend="xla",
+                                dtype=jnp.float32)
+    cfg_r = dataclasses.replace(cfg_x, attn_backend="ring", sp_axis="sp")
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg_x)
+    toks = jnp.asarray(
+        np.random.randint(0, cfg_x.vocab_size, (4, 64), np.int32))
+    lx = jax.jit(lambda p, t: gpt.forward(p, t, cfg_x))(params, toks)
+    lr = jax.jit(lambda p, t: gpt.forward(p, t, cfg_r, mesh))(params, toks)
+    err = float(jnp.max(jnp.abs(lx - lr)))
+    assert err < 1e-3, err
