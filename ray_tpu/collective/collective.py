@@ -112,8 +112,19 @@ class XlaMeshGroup(BaseGroup):
                 return jax.lax.all_gather(x, axis, tiled=True)
             in_spec, out_spec = P(axis), P()
         elif kind == "reducescatter":
-            def f(x):
-                return jax.lax.psum_scatter(x, axis, tiled=True)
+            if op == "sum":
+                def f(x):
+                    return jax.lax.psum_scatter(x, axis, tiled=True)
+            else:
+                # No pmax/pmin-scatter primitive: reduce across the axis,
+                # then every rank keeps only its tile of dim 0.
+                def f(x):
+                    red = reduce_map[op](x, axis)
+                    n = self.mesh.shape[axis]
+                    chunk = red.shape[0] // n
+                    i = jax.lax.axis_index(axis)
+                    return jax.lax.dynamic_slice_in_dim(
+                        red, i * chunk, chunk, 0)
             in_spec, out_spec = P(), P(axis)
         elif kind == "alltoall":
             # Global [world, world, ...]: row i of rank i's payload lands on

@@ -202,7 +202,10 @@ class Dataset:
         ds = Dataset(list(self._ops))
         blob = cloudpickle.dumps(lambda: ds._exec_blocks())
         coord_cls = rt.remote(SplitCoordinator)
-        coord = coord_cls.remote(blob, n, equal=equal)
+        # One concurrency slot per consumer: a pumping consumer may block on
+        # a peer's bounded queue, and that peer must still be able to drain.
+        coord = coord_cls.options(max_concurrency=n + 1).remote(
+            blob, n, equal=equal)
         return [DataIterator(coord, i) for i in range(n)]
 
     def split(self, n: int) -> List["Dataset"]:

@@ -92,6 +92,12 @@ class SplitCoordinator:
     re-sliced so global row ``i`` goes to split ``i % n``.
     """
 
+    # Max buffered blocks per split: a fast consumer pumping rounds for
+    # everyone blocks once any peer's queue is this deep, so a slow split
+    # backpressures the upstream stream instead of buffering the dataset
+    # (reference output_splitter has the same bounded-buffer semantics).
+    MAX_QUEUED_BLOCKS = 32
+
     def __init__(self, plan_blob: bytes, n: int, equal: bool = False):
         import cloudpickle
 
@@ -100,7 +106,8 @@ class SplitCoordinator:
         self._n = n
         self._equal = equal
         self._lock = threading.Lock()
-        self._queues: List[queue.Queue] = [queue.Queue() for _ in range(n)]
+        self._queues: List[queue.Queue] = [
+            queue.Queue(maxsize=self.MAX_QUEUED_BLOCKS) for _ in range(n)]
         self._done = False
         self._rr = 0
         self._carry = None  # equal mode: rows not yet forming a full round

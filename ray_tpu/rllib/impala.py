@@ -65,17 +65,20 @@ class IMPALA(Algorithm):
         cfg: IMPALAConfig = self.config
         self._fill_sample_pipeline()
 
-        # harvest whatever fragments are ready (block for at least one)
-        refs = list(self._inflight.keys())
-        ready, _ = rt.wait(refs, num_returns=1, timeout=60)
-        # opportunistically grab more that are already done
-        more, _ = rt.wait(refs, num_returns=len(refs), timeout=0)
-        ready = list(dict.fromkeys(ready + more))
+        # harvest whatever fragments are ready (block until at least one —
+        # a timed-out wait with zero ready refs just retries rather than
+        # crashing the step on np.concatenate([]))
         fragments = []
-        for ref in ready:
-            self._inflight.pop(ref, None)
-            fragments.append(rt.get(ref, timeout=60))
-        self._fill_sample_pipeline()
+        while not fragments:
+            refs = list(self._inflight.keys())
+            ready, _ = rt.wait(refs, num_returns=1, timeout=60)
+            # opportunistically grab more that are already done
+            more, _ = rt.wait(refs, num_returns=len(refs), timeout=0)
+            ready = list(dict.fromkeys(ready + more))
+            for ref in ready:
+                self._inflight.pop(ref, None)
+                fragments.append(rt.get(ref, timeout=60))
+            self._fill_sample_pipeline()
 
         collected = sum(len(f) for f in fragments)
         self._timesteps += collected

@@ -208,8 +208,8 @@ class LearnerGroup:
 
         if self.local is not None:
             return self.local.update(batch, **kw)
-        # shard the batch across learners; average resulting weights
-        # (equivalent to synchronized data-parallel SGD for equal shards)
+        # shard the batch across learners; average the resulting learner
+        # states (params + optimizer moments) after the step
         n = len(batch["obs"])
         k = len(self.remote)
         per = n // k
@@ -219,13 +219,19 @@ class LearnerGroup:
             shard = {key: v[lo:hi] for key, v in batch.items()}
             refs.append(r.update.remote(shard, **kw))
         metrics = rt.get(refs, timeout=300)
-        ws = rt.get([r.get_weights.remote() for r in self.remote],
-                    timeout=60)
+        states = rt.get([r.get_state.remote() for r in self.remote],
+                        timeout=60)
         import jax
 
-        mean_w = jax.tree.map(
-            lambda *xs: np.mean(np.stack(xs), axis=0), *ws)
-        rt.get([r.set_weights.remote(mean_w) for r in self.remote],
+        # Average the FULL learner state — params AND optimizer moments —
+        # so Adam's moments stay consistent with the averaged weights
+        # (weight-only averaging lets moments drift against diverging
+        # per-learner trajectories). Integer leaves (optax step counts) are
+        # identical across learners; the dtype-preserving mean keeps them.
+        mean_state = jax.tree.map(
+            lambda *xs: np.mean(np.stack(xs), axis=0).astype(
+                np.asarray(xs[0]).dtype), *states)
+        rt.get([r.set_state.remote(mean_state) for r in self.remote],
                timeout=60)
         out = {k2: float(np.mean([m[k2] for m in metrics]))
                for k2 in metrics[0]}

@@ -70,6 +70,11 @@ def test_xla_collective_group(mesh8):
     # global view of the scatter: row r (rank r's shard) = sum across ranks
     rs = np.asarray(g.reducescatter(np.ones((8, 4), np.float32)))
     assert rs.shape == (8, 4) and np.allclose(rs, 8.0)
+    # non-sum reductions must honor ``op`` (every rank holds the same
+    # replicated input, so max/min across ranks is the input itself)
+    y = np.arange(32, dtype=np.float32).reshape(8, 4)
+    assert np.allclose(np.asarray(g.reducescatter(y, "max")), y)
+    assert np.allclose(np.asarray(g.reducescatter(y, "min")), y)
     m = np.arange(64, dtype=np.float32).reshape(8, 8)
     assert np.allclose(np.asarray(g.alltoall(m)), m.T)
     g.barrier()
