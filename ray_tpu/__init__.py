@@ -12,6 +12,7 @@ jax loads lazily with the numeric subpackages.
 from .api import (  # noqa: F401
     ActorClass,
     ActorHandle,
+    NodeAffinitySchedulingStrategy,
     PlacementGroup,
     PlacementGroupSchedulingStrategy,
     available_resources,
@@ -22,6 +23,7 @@ from .api import (  # noqa: F401
     is_initialized,
     kill,
     list_actors,
+    nodes,
     placement_group,
     put,
     remote,

@@ -1,25 +1,32 @@
-"""Head service: cluster control plane (GCS + raylet equivalent, single daemon).
+"""Head service: cluster control plane (GCS + per-node raylet equivalent).
 
 Capability parity with the reference's GCS server (actor/node/job/KV/PG
-managers — reference: ``src/ray/gcs/gcs_server/gcs_server.cc:138-236``) and
-the raylet's worker pool + lease protocol (reference:
-``src/ray/raylet/worker_pool.h:83``, ``node_manager.cc:1780``), re-designed
-as one asyncio daemon per cluster for this runtime. Multi-host clusters
-attach remote node daemons over TCP with the same protocol.
+managers — reference: ``src/ray/gcs/gcs_server/gcs_server.cc:138-236``), the
+raylet's worker pool + lease protocol (reference:
+``src/ray/raylet/worker_pool.h:83``, ``node_manager.cc:1780``), and the
+cluster scheduling policies (reference:
+``src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:50``,
+``bundle_scheduling_policy.h:82-106``), re-designed as one asyncio daemon
+for this runtime. The head owns all resource accounting (so placement-group
+"two-phase commit" degenerates to one atomic multi-node reservation), while
+remote **node daemons** (``_private/node.py``) attach over TCP, spawn
+workers on their host, and report worker deaths.
 
 Responsibilities:
-- worker pool: spawn/reuse/kill worker processes, prestart
-- leases: resource-aware worker leases for normal tasks (hybrid policy)
+- node registry: head-local node + TCP-attached remote nodes, health
+- worker pool: spawn/reuse/kill worker processes per node, prestart
+- leases: resource-aware worker leases (hybrid/spread/affinity policies)
 - actors: dedicated-worker placement, restarts, named actor registry
-- placement groups: bundle reservation with PACK/SPREAD/STRICT_* semantics
+- placement groups: multi-node bundle placement with PACK/SPREAD/STRICT_*
 - KV store: function exports, library checkpoints
 - pubsub: topic fan-out to subscriber connections
-- health: worker process liveness -> actor death notifications
+- health: worker/node liveness -> actor death notifications
 """
 from __future__ import annotations
 
 import asyncio
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -30,20 +37,42 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from . import rpc
 from .config import Config
 from .ids import ActorID, NodeID, PlacementGroupID, WorkerID
+from .utils import spawn_env_with_pkg_root
 
 
 @dataclass
 class WorkerInfo:
     worker_id: WorkerID
-    address: str
+    address: Any  # UDS path (local) or (host, port) tuple (remote)
     pid: int
+    node: str = ""  # node_id hex
     proc: Optional[subprocess.Popen] = None
     conn: Optional[rpc.Connection] = None
     # None = idle pool worker; "lease" = leased for normal tasks;
     # ActorID = dedicated actor worker.
     assignment: Any = None
-    resources: Dict[str, float] = field(default_factory=dict)
+    # charge tuple: ("node", node_hex, req) | ("pg", pg_id, idx, req) | None
+    charge: Any = None
     started_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class NodeInfo:
+    node_id: str  # hex
+    hostname: str
+    total: Dict[str, float]
+    available: Dict[str, float]
+    address: Any = None  # remote daemon address, None for head-local
+    conn: Optional[rpc.Connection] = None  # daemon conn (remote only)
+    idle: deque = field(default_factory=deque)
+    state: str = "ALIVE"  # ALIVE | DEAD
+    is_head: bool = False
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def utilization(self) -> float:
+        fracs = [1.0 - self.available.get(k, 0.0) / v
+                 for k, v in self.total.items() if v > 0]
+        return max(fracs) if fracs else 0.0
 
 
 @dataclass
@@ -56,6 +85,7 @@ class ActorInfo:
     max_restarts: int
     restarts_used: int = 0
     creation_spec_meta: Any = None  # for restarts
+    strategy: Any = None  # for restarts on another node
     death_cause: str = ""
     registered_at: float = 0.0
     creation_started: bool = False
@@ -72,10 +102,12 @@ class PlacementGroupInfo:
     pg_id: PlacementGroupID
     bundles: List[Bundle]
     strategy: str
-    state: str  # PENDING | CREATED | REMOVED
+    state: str  # PENDING | CREATED | RESCHEDULING | REMOVED
     name: str = ""
     # per-bundle remaining capacity
     remaining: List[Dict[str, float]] = field(default_factory=list)
+    # per-bundle node assignment (node_id hex, or None while lost)
+    bundle_nodes: List[Optional[str]] = field(default_factory=list)
 
 
 class HeadService:
@@ -84,31 +116,30 @@ class HeadService:
         self.session_dir = session_dir
         self.config = config
         self.node_id = NodeID.from_random()
-        self.total_resources = dict(resources)
-        self.available = dict(resources)
         self.sock_path = os.path.join(session_dir, "head.sock")
         self._server: Optional[rpc.RpcServer] = None
+        self._tcp_server: Optional[rpc.RpcServer] = None
+        local = NodeInfo(node_id=self.node_id.hex(),
+                         hostname=socket.gethostname(),
+                         total=dict(resources), available=dict(resources),
+                         is_head=True)
+        self.nodes: Dict[str, NodeInfo] = {local.node_id: local}
+        self.local_node = local
         self.workers: Dict[WorkerID, WorkerInfo] = {}
-        self.idle: deque = deque()  # WorkerInfo, reusable pool
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[str, ActorID] = {}
         self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
-        self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)  # namespace->k->v
-        self._pending_leases: deque = deque()  # (resources, future)
+        self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)
+        self._pending_leases: deque = deque()  # (req, pg_meta, strategy, fut)
         self._registration_waiters: Dict[WorkerID, asyncio.Future] = {}
         self._subs: Dict[str, List[rpc.Connection]] = defaultdict(list)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._reaper_task = None
         self.job_counter = 0
-        self._spawn_env = dict(os.environ)
+        self._spread_rr = 0
         # Workers must be able to import ray_tpu no matter the driver's cwd
         # (the driver may have put the package on sys.path manually).
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        pp = self._spawn_env.get("PYTHONPATH", "")
-        if pkg_root not in pp.split(os.pathsep):
-            self._spawn_env["PYTHONPATH"] = (
-                pkg_root + (os.pathsep + pp if pp else ""))
+        self._spawn_env = spawn_env_with_pkg_root()
         self.task_events: deque = deque(maxlen=100_000)
         self._shutting_down = False
 
@@ -119,8 +150,16 @@ class HeadService:
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self._server = rpc.RpcServer(self._handle, path=self.sock_path)
         await self._server.start()
+        # TCP listener for remote node daemons / workers / drivers
+        # (reference: GCS listens on a TCP port for raylet registration).
+        self._tcp_server = rpc.RpcServer(self._handle, host="0.0.0.0")
+        await self._tcp_server.start()
         self._reaper_task = self._loop.create_task(self._reap_loop())
         return self
+
+    @property
+    def tcp_address(self) -> Tuple[str, int]:
+        return ("127.0.0.1", self._tcp_server._port)
 
     async def stop(self):
         self._shutting_down = True
@@ -130,6 +169,11 @@ class HeadService:
             if w.proc is not None:
                 try:
                     w.proc.terminate()
+                except Exception:
+                    pass
+            elif w.conn is not None:
+                try:
+                    w.conn.push("shutdown", {})
                 except Exception:
                     pass
         # Give children a moment, then hard-kill.
@@ -146,6 +190,8 @@ class HeadService:
                     pass
         if self._server:
             await self._server.stop()
+        if self._tcp_server:
+            await self._tcp_server.stop()
 
     async def _reap_loop(self):
         period = self.config.health_check_period_s
@@ -153,7 +199,8 @@ class HeadService:
             await asyncio.sleep(period)
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None:
-                    await self._on_worker_death(w, f"exit code {w.proc.returncode}")
+                    await self._on_worker_death(
+                        w, f"exit code {w.proc.returncode}")
             # Registered-but-never-created actors (client died between the
             # register and create RPCs) would otherwise pin their name
             # forever; expire them after the lease timeout.
@@ -166,14 +213,86 @@ class HeadService:
                     self._mark_actor_dead(a, "registration expired: "
                                              "creation never requested")
 
-    async def _on_worker_death(self, w: WorkerInfo, cause: str):
+    # ------------------------------------------------------------- nodes
+    async def _on_node_death(self, node: NodeInfo, cause: str):
+        """A node daemon's connection dropped: everything on it is gone
+        (reference: ``gcs_node_manager.cc`` OnNodeFailure ->
+        ``gcs_actor_manager.cc`` OnNodeDead)."""
+        if node.state == "DEAD":
+            return
+        node.state = "DEAD"
+        self.nodes.pop(node.node_id, None)
+        self.publish("nodes", {"event": "DEAD", "node_id": node.node_id,
+                               "cause": cause})
+        for w in list(self.workers.values()):
+            if w.node == node.node_id:
+                await self._on_worker_death(w, f"node died: {cause}",
+                                            node_dead=True)
+        # Bundles placed on the dead node are lost; try to re-place them
+        # (reference: gcs_placement_group_manager reschedules bundles).
+        for pg in self.pgs.values():
+            if pg.state != "CREATED":
+                continue
+            for i, nid in enumerate(pg.bundle_nodes):
+                if nid == node.node_id:
+                    pg.bundle_nodes[i] = None
+                    pg.remaining[i] = {}
+                    pg.state = "RESCHEDULING"
+        self._replace_lost_bundles()
+        self._pump_leases()
+
+    def _replace_lost_bundles(self):
+        for pg in self.pgs.values():
+            if pg.state != "RESCHEDULING":
+                continue
+            lost = [i for i, nid in enumerate(pg.bundle_nodes) if nid is None]
+            ok = True
+            survivors = {nid for nid in pg.bundle_nodes if nid}
+            for i in lost:
+                b = pg.bundles[i]
+                cands = [n for n in self._alive_nodes()
+                         if self._node_fits(n, b.resources)]
+                if pg.strategy == "STRICT_SPREAD":
+                    cands = [n for n in cands if n.node_id not in survivors]
+                elif pg.strategy == "STRICT_PACK":
+                    # Colocation guarantee: lost bundles may only rejoin the
+                    # node hosting the surviving bundles (or, if everything
+                    # was lost, any single node that fits them all).
+                    if survivors:
+                        cands = [n for n in cands if n.node_id in survivors]
+                    else:
+                        need = self._sum_bundles([pg.bundles[j] for j in lost])
+                        cands = [n for n in cands
+                                 if self._node_fits(n, need)]
+                elif pg.strategy == "PACK" and survivors:
+                    packed = [n for n in cands if n.node_id in survivors]
+                    if packed:
+                        cands = packed
+                if not cands:
+                    ok = False
+                    continue
+                n = min(cands, key=lambda n: n.utilization())
+                self._node_acquire(n, b.resources)
+                pg.bundle_nodes[i] = n.node_id
+                pg.remaining[i] = dict(b.resources)
+                survivors.add(n.node_id)
+            if ok and all(nid is not None for nid in pg.bundle_nodes):
+                pg.state = "CREATED"
+
+    def _alive_nodes(self) -> List[NodeInfo]:
+        return [n for n in self.nodes.values() if n.state == "ALIVE"]
+
+    async def _on_worker_death(self, w: WorkerInfo, cause: str,
+                               node_dead: bool = False):
         self.workers.pop(w.worker_id, None)
-        try:
-            self.idle.remove(w)
-        except ValueError:
-            pass
-        self._release_charged(w.resources)
-        w.resources = {}
+        node = self.nodes.get(w.node)
+        if node is not None:
+            try:
+                node.idle.remove(w)
+            except ValueError:
+                pass
+        self._release_charged(w.charge)
+        w.charge = None
         if isinstance(w.assignment, ActorID):
             actor = self.actors.get(w.assignment)
             if actor and actor.state != "DEAD":
@@ -187,7 +306,7 @@ class HeadService:
             self.publish(f"actor:{actor.actor_id.hex()}",
                          {"state": "RESTARTING", "cause": cause})
             try:
-                await self._place_actor(actor)
+                await self._restart_actor(actor)
                 self.publish(f"actor:{actor.actor_id.hex()}",
                              {"state": "ALIVE",
                               "address": actor.worker.address,
@@ -196,6 +315,32 @@ class HeadService:
                 self._mark_actor_dead(actor, f"restart failed: {e}")
         else:
             self._mark_actor_dead(actor, cause)
+
+    async def _restart_actor(self, actor: ActorInfo):
+        req = actor.resources
+        strategy = actor.strategy or {}
+        pg_meta = None
+        if strategy.get("kind") == "PLACEMENT_GROUP":
+            # Restart back into the actor's own bundle, not raw node
+            # resources (the bundle charge was released on worker death).
+            pg_meta = (PlacementGroupID.from_hex(strategy["pg_id"]),
+                       strategy.get("bundle_index", -1))
+        deadline = time.time() + self.config.worker_lease_timeout_s
+        while True:
+            found = self._find_grant(req, pg_meta, strategy)
+            if found is not None:
+                break
+            if time.time() > deadline:
+                raise RuntimeError("no node can host the restarted actor")
+            await asyncio.sleep(0.02)
+        node, charge = found
+        self._apply_charge(charge)
+        try:
+            w = await self._place_actor(actor, node)
+        except Exception:
+            self._release_charged(charge)
+            raise
+        w.charge = charge
 
     def _mark_actor_dead(self, actor: ActorInfo, cause: str):
         actor.state = "DEAD"
@@ -207,130 +352,219 @@ class HeadService:
                      {"state": "DEAD", "cause": cause})
 
     # ------------------------------------------------------------- resources
-    def _can_fit(self, req: Dict[str, float]) -> bool:
-        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+    @staticmethod
+    def _node_fits(node: NodeInfo, req: Dict[str, float]) -> bool:
+        return all(node.available.get(k, 0.0) + 1e-9 >= v
+                   for k, v in req.items())
 
-    def _acquire_resources(self, req: Dict[str, float]):
+    @staticmethod
+    def _node_acquire(node: NodeInfo, req: Dict[str, float]):
         for k, v in req.items():
-            self.available[k] = self.available.get(k, 0.0) - v
+            node.available[k] = node.available.get(k, 0.0) - v
 
-    def _release_resources(self, req: Dict[str, float]):
+    @staticmethod
+    def _node_release(node: NodeInfo, req: Dict[str, float]):
         for k, v in req.items():
-            self.available[k] = self.available.get(k, 0.0) + v
+            node.available[k] = node.available.get(k, 0.0) + v
 
-    def _release_charged(self, charged: Dict[str, Any]):
-        """Release either node resources or a placement-group bundle charge."""
-        if not charged:
+    def _release_charged(self, charge):
+        """Release a node-resource or placement-group bundle charge."""
+        if not charge:
             return
-        if "__pg__" in charged:
-            pg_id, idx, req = charged["__pg__"]
+        kind = charge[0]
+        if kind == "pg":
+            _, pg_id, idx, req = charge
             pg = self.pgs.get(pg_id)
-            if pg is not None and pg.state == "CREATED":
+            if pg is not None and pg.state in ("CREATED", "RESCHEDULING"):
                 rem = pg.remaining[idx]
                 for k, v in req.items():
                     rem[k] = rem.get(k, 0.0) + v
-        else:
-            self._release_resources(charged)
+        else:  # ("node", node_hex, req)
+            _, node_hex, req = charge
+            node = self.nodes.get(node_hex)
+            if node is not None:
+                self._node_release(node, req)
+
+    # ------------------------------------------------------- scheduling policy
+    def _pick_node(self, req: Dict[str, float], strategy) -> Optional[NodeInfo]:
+        """Choose a node for a lease/actor under the given strategy.
+
+        - DEFAULT: hybrid — prefer the head-local node while its utilization
+          stays under ``scheduler_spread_threshold``, then least-utilized
+          (reference: ``hybrid_scheduling_policy.h:50``).
+        - SPREAD: round-robin over feasible nodes
+          (reference: ``spread_scheduling_policy.h``).
+        - NODE_AFFINITY: the named node; ``soft`` falls back to hybrid
+          (reference: ``node_affinity_scheduling_policy.h``).
+        """
+        kind = (strategy or {}).get("kind", "DEFAULT") if isinstance(
+            strategy, dict) else "DEFAULT"
+        nodes = self._alive_nodes()
+        fitting = [n for n in nodes if self._node_fits(n, req)]
+        if not fitting:
+            return None
+        if kind == "NODE_AFFINITY":
+            want = strategy.get("node_id")
+            target = self.nodes.get(want)
+            if target is not None and target.state == "ALIVE" and \
+                    self._node_fits(target, req):
+                return target
+            if not strategy.get("soft"):
+                return None
+            kind = "DEFAULT"
+        if kind == "SPREAD":
+            self._spread_rr += 1
+            order = sorted(fitting, key=lambda n: n.node_id)
+            return order[self._spread_rr % len(order)]
+        # DEFAULT hybrid
+        threshold = getattr(self.config, "scheduler_spread_threshold", 0.5)
+        local = self.nodes.get(self.node_id.hex())
+        if (local is not None and local in fitting
+                and local.utilization() < threshold):
+            return local
+        return min(fitting, key=lambda n: n.utilization())
 
     # ------------------------------------------------------------- workers
-    async def _spawn_worker(self) -> WorkerInfo:
+    async def _spawn_worker(self, node: NodeInfo) -> WorkerInfo:
         worker_id = WorkerID.from_random()
-        log = open(os.path.join(self.session_dir, "logs",
-                                f"worker-{worker_id.hex()[:12]}.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--session-dir", self.session_dir,
-             "--worker-id", worker_id.hex(),
-             "--head-sock", self.sock_path],
-            stdout=log, stderr=subprocess.STDOUT,
-            env=self._spawn_env,
-            cwd=os.getcwd(),
-        )
         fut = self._loop.create_future()
         self._registration_waiters[worker_id] = fut
+        proc = None
         try:
+            if node.is_head:
+                log = open(os.path.join(self.session_dir, "logs",
+                                        f"worker-{worker_id.hex()[:12]}.log"),
+                           "ab")
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_main",
+                     "--session-dir", self.session_dir,
+                     "--worker-id", worker_id.hex(),
+                     "--head-sock", self.sock_path],
+                    stdout=log, stderr=subprocess.STDOUT,
+                    env=self._spawn_env,
+                    cwd=os.getcwd(),
+                )
+            else:
+                await node.conn.call_simple(
+                    "spawn_worker", {"worker_id": worker_id.hex()})
             info: WorkerInfo = await asyncio.wait_for(
                 fut, timeout=self.config.worker_lease_timeout_s
             )
         except asyncio.TimeoutError:
-            proc.kill()
+            if proc is not None:
+                proc.kill()
+            elif node.conn is not None:
+                # Remote spawn: tell the node daemon to reap the stuck
+                # process so it doesn't linger unregistered.
+                try:
+                    node.conn.push("kill_worker",
+                                   {"worker_id": worker_id.hex()})
+                except Exception:
+                    pass
             raise RuntimeError("worker failed to register in time")
         finally:
             self._registration_waiters.pop(worker_id, None)
         info.proc = proc
         return info
 
-    async def _get_worker(self) -> WorkerInfo:
-        while self.idle:
-            w = self.idle.popleft()
+    async def _get_worker(self, node: NodeInfo) -> WorkerInfo:
+        while node.idle:
+            w = node.idle.popleft()
             if w.worker_id in self.workers:
                 return w
-        return await self._spawn_worker()
+        return await self._spawn_worker(node)
 
     def _return_worker(self, w: WorkerInfo):
         if w.worker_id in self.workers:
             w.assignment = None
-            self.idle.append(w)
+            node = self.nodes.get(w.node)
+            if node is not None and node.state == "ALIVE":
+                node.idle.append(w)
+
+    def _kill_worker(self, w: WorkerInfo):
+        if w.proc is not None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        else:
+            # Remote worker: tell it to exit; its node daemon reaps it.
+            try:
+                if w.conn is not None:
+                    w.conn.push("shutdown", {})
+            except Exception:
+                pass
+        self.workers.pop(w.worker_id, None)
 
     # ------------------------------------------------------------- leases
-    def _try_grant(self, req: Dict[str, float], pg_meta) -> bool:
+    def _find_grant(self, req: Dict[str, float], pg_meta, strategy
+                    ) -> Optional[Tuple[NodeInfo, Any]]:
+        """Find (node, charge) for a request, or None if infeasible now."""
         if pg_meta is not None:
             pg_id, bundle_index = pg_meta
             pg = self.pgs.get(pg_id)
             if pg is None or pg.state != "CREATED":
-                return False
-            return self._bundle_can_fit(pg, bundle_index, req)
-        return self._can_fit(req)
+                return None
+            idxs = ([bundle_index] if bundle_index >= 0
+                    else range(len(pg.bundles)))
+            for i in idxs:
+                rem = pg.remaining[i]
+                nid = pg.bundle_nodes[i]
+                node = self.nodes.get(nid) if nid else None
+                if node is None or node.state != "ALIVE":
+                    continue
+                if all(rem.get(k, 0.0) + 1e-9 >= v for k, v in req.items()):
+                    return node, ("pg", pg_id, i, dict(req))
+            return None
+        node = self._pick_node(req, strategy)
+        if node is None:
+            return None
+        return node, ("node", node.node_id, dict(req))
 
-    def _bundle_can_fit(self, pg: PlacementGroupInfo, bundle_index: int,
-                        req: Dict[str, float]) -> bool:
-        idxs = [bundle_index] if bundle_index >= 0 else range(len(pg.bundles))
-        for i in idxs:
-            rem = pg.remaining[i]
-            if all(rem.get(k, 0.0) + 1e-9 >= v for k, v in req.items()):
-                return True
-        return False
-
-    def _bundle_acquire(self, pg: PlacementGroupInfo, bundle_index: int,
-                        req: Dict[str, float]) -> int:
-        idxs = [bundle_index] if bundle_index >= 0 else range(len(pg.bundles))
-        for i in idxs:
-            rem = pg.remaining[i]
-            if all(rem.get(k, 0.0) + 1e-9 >= v for k, v in req.items()):
-                for k, v in req.items():
-                    rem[k] = rem.get(k, 0.0) - v
-                return i
-        raise RuntimeError("bundle cannot fit request")
-
-    async def _grant_lease(self, req: Dict[str, float], pg_meta) -> dict:
-        if pg_meta is not None:
-            pg = self.pgs[pg_meta[0]]
-            idx = self._bundle_acquire(pg, pg_meta[1], req)
-            charged = {"__pg__": (pg.pg_id, idx, dict(req))}
+    def _apply_charge(self, charge):
+        if charge[0] == "pg":
+            _, pg_id, idx, req = charge
+            rem = self.pgs[pg_id].remaining[idx]
+            for k, v in req.items():
+                rem[k] = rem.get(k, 0.0) - v
         else:
-            self._acquire_resources(req)
-            charged = dict(req)
-        w = await self._get_worker()
+            _, node_hex, req = charge
+            self._node_acquire(self.nodes[node_hex], req)
+
+    async def _grant_lease(self, node: NodeInfo, charge) -> dict:
+        """Spawn/reuse a worker for an ALREADY-APPLIED charge (callers must
+        call ``_apply_charge`` synchronously right after ``_find_grant`` so
+        concurrent grants can't double-book the same capacity)."""
+        try:
+            w = await self._get_worker(node)
+        except Exception:
+            self._release_charged(charge)
+            raise
         w.assignment = "lease"
-        w.resources = charged
+        w.charge = charge
         return {"worker_id": w.worker_id.hex(), "address": w.address}
 
     def _pump_leases(self):
         """Grant queued lease requests that now fit."""
         still = deque()
+        self._replace_lost_bundles()
         while self._pending_leases:
-            req, pg_meta, fut = self._pending_leases.popleft()
+            req, pg_meta, strategy, fut = self._pending_leases.popleft()
             if fut.done():
                 continue
-            if self._try_grant(req, pg_meta):
-                self._loop.create_task(self._grant_into(req, pg_meta, fut))
+            found = self._find_grant(req, pg_meta, strategy)
+            if found is not None:
+                node, charge = found
+                self._apply_charge(charge)
+                self._loop.create_task(
+                    self._grant_into(node, charge, fut))
             else:
-                still.append((req, pg_meta, fut))
+                still.append((req, pg_meta, strategy, fut))
         self._pending_leases = still
 
-    async def _grant_into(self, req, pg_meta, fut):
+    async def _grant_into(self, node, charge, fut):
         try:
-            res = await self._grant_lease(req, pg_meta)
+            res = await self._grant_lease(node, charge)
             if not fut.done():
                 fut.set_result(res)
         except Exception as e:  # noqa: BLE001
@@ -338,8 +572,8 @@ class HeadService:
                 fut.set_exception(e)
 
     # ------------------------------------------------------------- actors
-    async def _place_actor(self, actor: ActorInfo):
-        w = await self._get_worker()
+    async def _place_actor(self, actor: ActorInfo, node: NodeInfo):
+        w = await self._get_worker(node)
         w.assignment = actor.actor_id
         actor.worker = w
         # Ask the worker to instantiate the actor.
@@ -378,25 +612,60 @@ class HeadService:
         if method == "publish":
             self.publish(payload["topic"], payload["msg"])
             return {}
+        if method == "register_node":
+            return await self._register_node(payload, conn)
         fn = getattr(self, "_rpc_" + method, None)
         if fn is None:
             raise rpc.RpcError(f"head: unknown method {method}")
         return await fn(payload, bufs)
 
+    async def _register_node(self, payload, conn: rpc.Connection):
+        """A node daemon attached over TCP; its connection IS its liveness
+        (reference: raylet registration + health checks,
+        ``gcs_node_manager.cc`` HandleRegisterNode)."""
+        node = NodeInfo(
+            node_id=payload["node_id"],
+            hostname=payload.get("hostname") or "?",
+            total=dict(payload["resources"]),
+            available=dict(payload["resources"]),
+            conn=conn,
+            labels=dict(payload.get("labels") or {}),
+        )
+        self.nodes[node.node_id] = node
+        prev_close = conn.on_close
+
+        def _closed():
+            if prev_close:
+                prev_close()
+            self._loop.create_task(
+                self._on_node_death(node, "node connection lost"))
+
+        conn.on_close = _closed
+        self.publish("nodes", {"event": "ALIVE", "node_id": node.node_id})
+        self._pump_leases()
+        return {"node_id": node.node_id, "config": self.config.to_dict(),
+                "head_node_id": self.node_id.hex()}
+
     async def _rpc_register_worker(self, payload, bufs):
         worker_id = WorkerID.from_hex(payload["worker_id"])
-        info = WorkerInfo(worker_id=worker_id, address=payload["address"],
-                          pid=payload["pid"])
+        address = payload["address"]
+        if isinstance(address, list):
+            address = tuple(address)
+        node_hex = payload.get("node_id") or self.node_id.hex()
+        info = WorkerInfo(worker_id=worker_id, address=address,
+                          pid=payload["pid"], node=node_hex)
         # The registering connection is the one this call arrived on; we
         # instead open a dedicated control connection to the worker.
-        info.conn = await rpc.connect(payload["address"], self._handle)
+        info.conn = await rpc.connect(address, self._handle)
         self.workers[worker_id] = info
         fut = self._registration_waiters.get(worker_id)
         if fut is not None and not fut.done():
             fut.set_result(info)
         else:
-            self.idle.append(info)  # adopted externally-started worker
-        return {"node_id": self.node_id.hex(),
+            node = self.nodes.get(node_hex)
+            if node is not None:
+                node.idle.append(info)  # adopted externally-started worker
+        return {"node_id": node_hex,
                 "config": self.config.to_dict()}
 
     async def _rpc_lease_worker(self, payload, bufs):
@@ -406,32 +675,37 @@ class HeadService:
         if strategy.get("kind") == "PLACEMENT_GROUP":
             pg_meta = (PlacementGroupID.from_hex(strategy["pg_id"]),
                        strategy.get("bundle_index", -1))
-        if self._try_grant(req, pg_meta):
-            return await self._grant_lease(req, pg_meta)
+        found = self._find_grant(req, pg_meta, strategy)
+        if found is not None:
+            node, charge = found
+            self._apply_charge(charge)
+            return await self._grant_lease(node, charge)
         fut = self._loop.create_future()
-        self._pending_leases.append((req, pg_meta, fut))
+        self._pending_leases.append((req, pg_meta, strategy, fut))
         timeout = payload.get("timeout", self.config.worker_lease_timeout_s)
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             raise rpc.RpcError(
                 f"lease timed out after {timeout}s: requested {req}, "
-                f"available {self.available}"
+                f"available {self._available_summary()}"
             )
+
+    def _available_summary(self) -> Dict[str, float]:
+        total: Dict[str, float] = defaultdict(float)
+        for n in self._alive_nodes():
+            for k, v in n.available.items():
+                total[k] += v
+        return dict(total)
 
     async def _rpc_return_lease(self, payload, bufs):
         worker_id = WorkerID.from_hex(payload["worker_id"])
         w = self.workers.get(worker_id)
         if w is not None:
-            charged = w.resources
-            w.resources = {}
-            self._release_charged(charged)
+            self._release_charged(w.charge)
+            w.charge = None
             if payload.get("kill"):
-                try:
-                    w.proc and w.proc.terminate()
-                except Exception:
-                    pass
-                self.workers.pop(worker_id, None)
+                self._kill_worker(w)
             else:
                 self._return_worker(w)
         self._pump_leases()
@@ -455,6 +729,7 @@ class HeadService:
             resources=payload.get("resources") or {},
             max_restarts=payload.get("max_restarts", 0),
             creation_spec_meta=payload["spec_meta"],
+            strategy=payload.get("strategy") or {},
             registered_at=time.time(),
         )
         self.actors[actor_id] = actor
@@ -476,27 +751,25 @@ class HeadService:
             pg_meta = (PlacementGroupID.from_hex(strategy["pg_id"]),
                        strategy.get("bundle_index", -1))
         deadline = time.time() + self.config.worker_lease_timeout_s
-        while not self._try_grant(req, pg_meta):
+        while True:
+            found = self._find_grant(req, pg_meta, strategy)
+            if found is not None:
+                break
             if time.time() > deadline:
                 self._mark_actor_dead(actor, "resources unavailable")
                 raise rpc.RpcError(
                     f"cannot place actor: requested {req}, available "
-                    f"{self.available}")
+                    f"{self._available_summary()}")
             await asyncio.sleep(0.02)
-        if pg_meta is not None:
-            pg = self.pgs[pg_meta[0]]
-            idx = self._bundle_acquire(pg, pg_meta[1], req)
-            charged = {"__pg__": (pg.pg_id, idx, dict(req))}
-        else:
-            self._acquire_resources(req)
-            charged = dict(req)
+        node, charge = found
+        self._apply_charge(charge)
         try:
-            w = await self._place_actor(actor)
+            w = await self._place_actor(actor, node)
         except Exception as e:  # noqa: BLE001
-            self._release_charged(charged)
+            self._release_charged(charge)
             self._mark_actor_dead(actor, f"creation failed: {e}")
             raise
-        w.resources = charged
+        w.charge = charge
         return {"address": w.address, "worker_id": w.worker_id.hex()}
 
     async def _rpc_get_actor(self, payload, bufs):
@@ -525,6 +798,7 @@ class HeadService:
                         "state": a.state,
                         "resources": a.resources,
                         "restarts": a.restarts_used,
+                        "node_id": a.worker.node if a.worker else None,
                         "death_cause": a.death_cause})
         return out
 
@@ -537,13 +811,9 @@ class HeadService:
         w = a.worker
         self._mark_actor_dead(a, "killed via kill_actor")
         if w is not None:
-            try:
-                w.proc and w.proc.terminate()
-            except Exception:
-                pass
-            self.workers.pop(w.worker_id, None)
-            self._release_charged(w.resources)
-            w.resources = {}
+            self._release_charged(w.charge)
+            w.charge = None
+            self._kill_worker(w)
         self._pump_leases()
         return {}
 
@@ -576,49 +846,113 @@ class HeadService:
         return [k for k in self.kv[ns] if k.startswith(prefix)]
 
     # ------------------------------------------------------------- PGs
+    def _place_bundles(self, bundles: List[Bundle], strategy: str
+                       ) -> Optional[List[str]]:
+        """Assign each bundle a node per the PG strategy, atomically
+        (reference: ``bundle_scheduling_policy.h:82-106``). Returns node ids
+        or None if infeasible right now."""
+        nodes = self._alive_nodes()
+        # Work on a scratch copy of availability so the reservation is
+        # all-or-nothing (the head is the single resource owner, so this IS
+        # the 2-phase commit: prepare on the copy, commit below).
+        scratch = {n.node_id: dict(n.available) for n in nodes}
+
+        def fits(nid, req):
+            av = scratch[nid]
+            return all(av.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+        def take(nid, req):
+            av = scratch[nid]
+            for k, v in req.items():
+                av[k] = av.get(k, 0.0) - v
+
+        assignment: List[Optional[str]] = [None] * len(bundles)
+        if strategy in ("PACK", "STRICT_PACK"):
+            # Try to fit everything on one node (least-utilized first so
+            # PACK actually packs).
+            total = self._sum_bundles(bundles)
+            for n in sorted(nodes, key=lambda n: n.utilization()):
+                if fits(n.node_id, total):
+                    return [n.node_id] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+            # PACK fallback: greedy first-fit across nodes.
+            for i, b in enumerate(bundles):
+                placed = False
+                for n in nodes:
+                    if fits(n.node_id, b.resources):
+                        take(n.node_id, b.resources)
+                        assignment[i] = n.node_id
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return assignment
+        if strategy in ("SPREAD", "STRICT_SPREAD"):
+            order = sorted(nodes, key=lambda n: n.utilization())
+            used: Set[str] = set()
+            for i, b in enumerate(bundles):
+                # distinct nodes first; SPREAD may reuse when exhausted
+                cands = [n for n in order if n.node_id not in used
+                         and fits(n.node_id, b.resources)]
+                if not cands and strategy == "SPREAD":
+                    cands = [n for n in order if fits(n.node_id, b.resources)]
+                if not cands:
+                    return None
+                n = cands[0]
+                take(n.node_id, b.resources)
+                used.add(n.node_id)
+                assignment[i] = n.node_id
+            return assignment
+        raise rpc.RpcError(f"unknown placement strategy {strategy!r}")
+
+    @staticmethod
+    def _sum_bundles(bundles: List[Bundle]) -> Dict[str, float]:
+        total: Dict[str, float] = defaultdict(float)
+        for b in bundles:
+            for k, v in b.resources.items():
+                total[k] += v
+        return dict(total)
+
     async def _rpc_create_placement_group(self, payload, bufs):
         pg_id = PlacementGroupID.from_hex(payload["pg_id"])
         bundles = [Bundle(i, dict(b)) for i, b in enumerate(payload["bundles"])]
         strategy = payload.get("strategy", "PACK")
-        total_req: Dict[str, float] = defaultdict(float)
-        for b in bundles:
-            for k, v in b.resources.items():
-                total_req[k] += v
         pg = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy,
                                 state="PENDING", name=payload.get("name", ""))
         self.pgs[pg_id] = pg
         deadline = time.time() + payload.get(
             "timeout", self.config.worker_lease_timeout_s)
-        # Single-node: STRICT_SPREAD cannot be satisfied with >1 bundle on one
-        # node; all other strategies degenerate to fitting total resources.
-        if strategy == "STRICT_SPREAD" and len(bundles) > 1:
-            # Honest failure until multi-node attach exists.
-            self.pgs.pop(pg_id)
-            raise rpc.RpcError(
-                "STRICT_SPREAD with >1 bundle requires multiple nodes")
-        while not self._can_fit(dict(total_req)):
+        while True:
+            assignment = self._place_bundles(bundles, strategy)
+            if assignment is not None:
+                break
             if time.time() > deadline or self._shutting_down:
                 self.pgs.pop(pg_id, None)
                 raise rpc.RpcError(
-                    f"placement group infeasible: need {dict(total_req)}, "
-                    f"total {self.total_resources}")
+                    f"placement group infeasible: strategy {strategy}, "
+                    f"bundles {[b.resources for b in bundles]}, "
+                    f"nodes {[(n.node_id[:8], n.available) for n in self._alive_nodes()]}")
             await asyncio.sleep(0.02)
-        self._acquire_resources(dict(total_req))
+        # Commit the reservation.
+        for b, nid in zip(bundles, assignment):
+            self._node_acquire(self.nodes[nid], b.resources)
+        pg.bundle_nodes = list(assignment)
         pg.remaining = [dict(b.resources) for b in bundles]
         pg.state = "CREATED"
-        return {"state": "CREATED"}
+        return {"state": "CREATED",
+                "bundle_nodes": list(assignment)}
 
     async def _rpc_remove_placement_group(self, payload, bufs):
         pg_id = PlacementGroupID.from_hex(payload["pg_id"])
         pg = self.pgs.get(pg_id)
         if pg is None or pg.state == "REMOVED":
             return {}
-        if pg.state == "CREATED":
-            total: Dict[str, float] = defaultdict(float)
-            for b in pg.bundles:
-                for k, v in b.resources.items():
-                    total[k] += v
-            self._release_resources(dict(total))
+        if pg.state in ("CREATED", "RESCHEDULING"):
+            for b, nid in zip(pg.bundles, pg.bundle_nodes):
+                node = self.nodes.get(nid) if nid else None
+                if node is not None:
+                    self._node_release(node, b.resources)
         pg.state = "REMOVED"
         self._pump_leases()
         return {}
@@ -626,14 +960,38 @@ class HeadService:
     async def _rpc_pg_state(self, payload, bufs):
         pg_id = PlacementGroupID.from_hex(payload["pg_id"])
         pg = self.pgs.get(pg_id)
-        return {"state": pg.state if pg else "REMOVED"}
+        return {"state": pg.state if pg else "REMOVED",
+                "bundle_nodes": pg.bundle_nodes if pg else []}
 
     # ------------------------------------------------------------- cluster
     async def _rpc_cluster_resources(self, payload, bufs):
-        return dict(self.total_resources)
+        total: Dict[str, float] = defaultdict(float)
+        for n in self._alive_nodes():
+            for k, v in n.total.items():
+                total[k] += v
+        return dict(total)
 
     async def _rpc_available_resources(self, payload, bufs):
-        return dict(self.available)
+        return self._available_summary()
+
+    async def _rpc_list_nodes(self, payload, bufs):
+        return [{"node_id": n.node_id, "hostname": n.hostname,
+                 "is_head": n.is_head, "state": n.state,
+                 "total": dict(n.total), "available": dict(n.available),
+                 "labels": dict(n.labels)}
+                for n in self.nodes.values()]
+
+    async def _rpc_get_head_tcp_address(self, payload, bufs):
+        return {"address": list(self.tcp_address)}
+
+    async def _rpc_worker_died(self, payload, bufs):
+        """Pushed by a node daemon when one of its workers exits."""
+        worker_id = WorkerID.from_hex(payload["worker_id"])
+        w = self.workers.get(worker_id)
+        if w is not None:
+            await self._on_worker_death(
+                w, payload.get("cause", "worker process exited"))
+        return {}
 
     async def _rpc_report_task_events(self, payload, bufs):
         self.task_events.extend(payload)
@@ -654,7 +1012,7 @@ class HeadService:
         n = payload.get("n", 1)
         created = []
         for _ in range(n):
-            w = await self._spawn_worker()
+            w = await self._spawn_worker(self.local_node)
             self._return_worker(w)
             created.append(w.worker_id.hex())
         return created

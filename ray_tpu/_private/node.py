@@ -1,0 +1,157 @@
+"""Node daemon: per-host agent that attaches to the head over TCP.
+
+Capability parity with the reference's raylet node manager
+(reference: ``src/ray/raylet/node_manager.cc:1780`` — local worker pool,
+resource reporting, worker liveness) re-designed for this runtime's
+head-centric resource accounting: the daemon only *spawns and reaps*
+worker processes on its host; all scheduling decisions stay at the head.
+
+Workers spawned here listen on TCP (so any node can pull objects from
+them) and register directly with the head, tagged with this node's id.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import rpc
+from .ids import NodeID, WorkerID
+from .utils import spawn_env_with_pkg_root
+
+
+class NodeService:
+    def __init__(self, head_address: Tuple[str, int], session_dir: str,
+                 resources: Dict[str, float],
+                 shm_domain: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 node_ip: Optional[str] = None):
+        self.head_address = head_address
+        self.session_dir = session_dir
+        self.resources = dict(resources)
+        self.node_id = NodeID.from_random()
+        # shm_domain: workers on the same domain exchange large objects via
+        # host shared memory; across domains they ship bytes over TCP. Tests
+        # set a synthetic domain per node to exercise the cross-node path on
+        # one machine.
+        self.shm_domain = shm_domain or socket.gethostname()
+        self.labels = dict(labels or {})
+        # The IP other nodes dial to reach workers on this host. Must be
+        # routable cluster-wide on a real multi-host deployment.
+        self.node_ip = node_ip or os.environ.get("RT_NODE_IP") or \
+            _detect_node_ip(head_address)
+        self._conn: Optional[rpc.Connection] = None
+        self._procs: Dict[str, subprocess.Popen] = {}  # worker hex -> proc
+        self._reap_task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self._spawn_env = spawn_env_with_pkg_root(
+            {"RT_NODE_IP": self.node_ip})
+
+    async def start(self):
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self._conn = await rpc.connect(self.head_address, self._handle)
+        await self._conn.call_simple("register_node", {
+            "node_id": self.node_id.hex(),
+            "hostname": self.shm_domain,
+            "resources": self.resources,
+            "labels": self.labels,
+        })
+        self._reap_task = asyncio.get_running_loop().create_task(
+            self._reap_loop())
+        return self
+
+    async def stop(self):
+        self._stopping = True
+        if self._reap_task:
+            self._reap_task.cancel()
+        for proc in self._procs.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        if self._conn:
+            await self._conn.close()
+
+    async def run_forever(self):
+        """Block until the head connection drops (then exit)."""
+        closed = asyncio.get_running_loop().create_future()
+        prev = self._conn.on_close
+        def _on_close():
+            if prev:
+                prev()
+            if not closed.done():
+                closed.set_result(None)
+        self._conn.on_close = _on_close
+        await closed
+
+    # ------------------------------------------------------------- handler
+    async def _handle(self, method: str, payload: Any, bufs: List[bytes],
+                      conn: rpc.Connection):
+        if method == "spawn_worker":
+            return await self._spawn_worker(payload["worker_id"])
+        if method == "kill_worker":
+            return self._kill_worker(payload["worker_id"])
+        if method == "ping":
+            return {"ok": True, "node_id": self.node_id.hex()}
+        if method == "pubsub":
+            return {}
+        raise rpc.RpcError(f"node daemon: unknown method {method}")
+
+    async def _spawn_worker(self, worker_hex: str):
+        log = open(os.path.join(self.session_dir, "logs",
+                                f"worker-{worker_hex[:12]}.log"), "ab")
+        host, port = self.head_address
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--session-dir", self.session_dir,
+             "--worker-id", worker_hex,
+             "--head-tcp", f"{host}:{port}",
+             "--node-id", self.node_id.hex(),
+             "--shm-domain", self.shm_domain,
+             "--tcp"],
+            stdout=log, stderr=subprocess.STDOUT,
+            env=self._spawn_env,
+            cwd=os.getcwd(),
+        )
+        self._procs[worker_hex] = proc
+        return {"pid": proc.pid}
+
+    def _kill_worker(self, worker_hex: str):
+        proc = self._procs.pop(worker_hex, None)
+        if proc is not None:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        return {}
+
+    async def _reap_loop(self):
+        while not self._stopping:
+            await asyncio.sleep(0.2)
+            for hex_id, proc in list(self._procs.items()):
+                code = proc.poll()
+                if code is not None:
+                    self._procs.pop(hex_id, None)
+                    try:
+                        self._conn.push("worker_died", {
+                            "worker_id": hex_id,
+                            "cause": f"exit code {code}"})
+                    except Exception:
+                        pass
+
+
+def _detect_node_ip(head_address: Tuple[str, int]) -> str:
+    """The local IP used to reach the head — the address workers advertise
+    (reference: ``ray._private.services.get_node_ip_address``)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((head_address[0], head_address[1] or 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

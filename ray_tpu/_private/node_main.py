@@ -1,0 +1,62 @@
+"""Node daemon entry point (reference capability: ``ray start`` joining an
+existing cluster — ``python/ray/scripts/scripts.py`` ``ray start
+--address=...``).
+
+Run on each host of a multi-node cluster:
+
+    python -m ray_tpu._private.node_main \
+        --head 10.0.0.1:6379 --num-cpus 8 --resources '{"TPU": 4}'
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True,
+                        help="head TCP address host:port")
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--num-cpus", type=float, default=1.0)
+    parser.add_argument("--num-tpus", type=float, default=0.0)
+    parser.add_argument("--resources", default="{}",
+                        help="extra resources as JSON")
+    parser.add_argument("--shm-domain", default=None)
+    parser.add_argument("--labels", default="{}")
+    args = parser.parse_args()
+
+    from ray_tpu._private.node import NodeService
+
+    host, _, port = args.head.rpartition(":")
+    resources = {"CPU": args.num_cpus}
+    if args.num_tpus:
+        resources["TPU"] = args.num_tpus
+    resources.update(json.loads(args.resources))
+
+    async def run():
+        node = NodeService(
+            head_address=(host, int(port)),
+            session_dir=args.session_dir,
+            resources=resources,
+            shm_domain=args.shm_domain,
+            labels=json.loads(args.labels),
+        )
+        await node.start()
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+        waiter = loop.create_task(node.run_forever())
+        stopper = loop.create_task(stop.wait())
+        await asyncio.wait([waiter, stopper],
+                           return_when=asyncio.FIRST_COMPLETED)
+        await node.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Small shared helpers for process spawning."""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def spawn_env_with_pkg_root(extra: Optional[Dict[str, str]] = None
+                            ) -> Dict[str, str]:
+    """Environment for spawned daemon/worker processes: guarantees the
+    ray_tpu package root is importable regardless of the parent's cwd."""
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    pp = env.get("PYTHONPATH", "")
+    if pkg_root not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = pkg_root + (os.pathsep + pp if pp else "")
+    if extra:
+        env.update(extra)
+    return env

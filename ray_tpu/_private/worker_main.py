@@ -1,7 +1,8 @@
 """Worker process entry point (reference capability: default_worker.py).
 
-Spawned by the head's worker pool; registers back over the head socket and
-then serves ``push_task`` / ``create_actor`` RPCs until terminated.
+Spawned by the head's worker pool (UDS, head-local) or by a node daemon
+(TCP, remote node); registers back with the head and then serves
+``push_task`` / ``create_actor`` RPCs until terminated.
 """
 from __future__ import annotations
 
@@ -17,7 +18,14 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--worker-id", required=True)
-    parser.add_argument("--head-sock", required=True)
+    parser.add_argument("--head-sock", default=None,
+                        help="head UDS socket path (head-local workers)")
+    parser.add_argument("--head-tcp", default=None,
+                        help="head TCP address host:port (remote nodes)")
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--shm-domain", default=None)
+    parser.add_argument("--tcp", action="store_true",
+                        help="serve on TCP so other nodes can pull objects")
     args = parser.parse_args()
 
     # Import after arg parsing to keep failure messages clean.
@@ -25,19 +33,29 @@ def main():
     from ray_tpu._private.ids import WorkerID
     from ray_tpu.core.worker import CoreWorker
 
+    if args.head_tcp:
+        host, _, port = args.head_tcp.rpartition(":")
+        head_address = (host, int(port))
+    else:
+        head_address = args.head_sock
+
     core = CoreWorker(
         session_dir=args.session_dir,
-        head_sock=args.head_sock,
+        head_sock=head_address,
         mode="worker",
         config=Config(),
         worker_id=WorkerID.from_hex(args.worker_id),
+        listen_tcp=args.tcp,
+        node_id=args.node_id,
+        shm_domain=args.shm_domain,
     )
     core.start()
 
-    # Register with the head: announce our serving socket.
+    # Register with the head: announce our serving address + home node.
     core.head_call("register_worker", {
         "worker_id": args.worker_id,
-        "address": core.sock_path,
+        "address": core.address,
+        "node_id": args.node_id,
         "pid": os.getpid(),
     }, timeout=30)
 

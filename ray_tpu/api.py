@@ -185,6 +185,20 @@ def available_resources() -> Dict[str, float]:
     return _core().head_call("available_resources")
 
 
+def nodes() -> List[dict]:
+    """Cluster node table (reference: ``ray.nodes()``)."""
+    return _core().head_call("list_nodes")
+
+
+class NodeAffinitySchedulingStrategy:
+    """Pin a task/actor to one node (reference:
+    ``ray.util.scheduling_strategies.NodeAffinitySchedulingStrategy``)."""
+
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
 def _resources_from_options(opts: Dict[str, Any]) -> Dict[str, float]:
     res = dict(opts.get("resources") or {})
     num_cpus = opts.get("num_cpus")
@@ -202,6 +216,9 @@ def _strategy_from_options(opts) -> Optional[SchedulingStrategy]:
         return SchedulingStrategy()
     if s == "SPREAD":
         return SchedulingStrategy(kind="SPREAD")
+    if isinstance(s, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(kind="NODE_AFFINITY", node_id=s.node_id,
+                                  soft=s.soft)
     if isinstance(s, PlacementGroupSchedulingStrategy):
         return SchedulingStrategy(
             kind="PLACEMENT_GROUP",

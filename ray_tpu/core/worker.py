@@ -21,6 +21,7 @@ import asyncio
 import concurrent.futures
 import hashlib
 import os
+import socket
 import threading
 import time
 import traceback
@@ -97,28 +98,44 @@ class _LeaseCache:
         extra = ()
         if strategy is not None and strategy.kind == "PLACEMENT_GROUP":
             extra = (strategy.placement_group_id.hex(), strategy.bundle_index)
+        elif strategy is not None and strategy.kind == "NODE_AFFINITY":
+            # Affinity leases must not be reused for other targets.
+            extra = ("aff", strategy.node_id, strategy.soft)
+        elif strategy is not None and strategy.kind == "SPREAD":
+            extra = ("spread",)
         return tuple(sorted(resources.items())) + extra
 
 
 class CoreWorker:
     _current: Optional["CoreWorker"] = None
 
-    def __init__(self, session_dir: str, head_sock: str, mode: str,
+    def __init__(self, session_dir: str, head_sock, mode: str,
                  config: Optional[Config] = None,
                  worker_id: Optional[WorkerID] = None,
-                 job_id: Optional[JobID] = None):
+                 job_id: Optional[JobID] = None,
+                 listen_tcp: bool = False,
+                 node_id: Optional[str] = None,
+                 shm_domain: Optional[str] = None):
         self.mode = mode  # "driver" | "worker"
         self.session_dir = session_dir
-        self.head_sock = head_sock
+        self.head_sock = head_sock  # UDS path or (host, port) tuple
         self.config = config or Config()
         self.worker_id = worker_id or WorkerID.from_random()
         self.job_id = job_id or JobID.from_random()
+        self.node_id = node_id
+        # Same shm_domain == objects exchangeable via host shared memory;
+        # different domains ship bytes over the wire (cross-node transfer).
+        self.shm_domain = shm_domain or socket.gethostname()
+        self.listen_tcp = listen_tcp
         self.memory_store = MemoryStore()
         self.shm_store = SharedMemoryStore(
             self.config.object_store_memory, self.config.spill_directory)
         self.serde = get_context()
         self.sock_path = os.path.join(
             session_dir, "workers", f"{self.worker_id.hex()[:16]}.sock")
+        # Advertised owner address: UDS path, or (host, port) once the TCP
+        # server is up (set in _async_start).
+        self.address: Any = self.sock_path
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_ready = threading.Event()
         self._io_thread: Optional[threading.Thread] = None
@@ -175,8 +192,14 @@ class CoreWorker:
             self._loop.close()
 
     async def _async_start(self):
-        self._server = rpc.RpcServer(self._handle, path=self.sock_path)
-        await self._server.start()
+        if self.listen_tcp:
+            self._server = rpc.RpcServer(self._handle, host="0.0.0.0")
+            await self._server.start()
+            self.address = (os.environ.get("RT_NODE_IP", "127.0.0.1"),
+                            self._server._port)
+        else:
+            self._server = rpc.RpcServer(self._handle, path=self.sock_path)
+            await self._server.start()
         self._head = await rpc.connect(self.head_sock, self._handle)
         self._reaper = asyncio.get_running_loop().create_task(
             self._lease_reaper())
@@ -242,7 +265,7 @@ class CoreWorker:
         object_id = ObjectID.from_random()
         frames = self.serde.serialize(value)
         self._store_frames(object_id, frames)
-        return ObjectRef(object_id, self.sock_path)
+        return ObjectRef(object_id, self.address)
 
     def _store_frames(self, object_id: ObjectID, frames: List[bytes]):
         total = sum(len(f) for f in frames)
@@ -285,7 +308,7 @@ class CoreWorker:
         frames = self._load_frames(ref.object_id)
         if frames is not None:
             return frames
-        if ref.owner_address == self.sock_path:
+        if ref.owner_address == self.address:
             # We own it; it is pending (task not finished). Block on store.
             frames = self.memory_store.get(ref.object_id, timeout)
             if frames is None and self.memory_store.contains(ref.object_id):
@@ -315,6 +338,7 @@ class CoreWorker:
         conn = await self._get_conn(ref.owner_address)
         return await conn.call("get_object",
                                {"object_id": ref.object_id.hex(),
+                                "shm_domain": self.shm_domain,
                                 "wait": True})
 
     async def _async_get_one(self, ref: ObjectRef):
@@ -322,7 +346,7 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
         frames = self._load_frames(ref.object_id)
         if frames is None:
-            if ref.owner_address == self.sock_path:
+            if ref.owner_address == self.address:
                 frames = await loop.run_in_executor(
                     None, lambda: self._wait_local(ref, None))
             else:
@@ -359,7 +383,7 @@ class CoreWorker:
             return True
         if self.shm_store.contains(ref.object_id):
             return True
-        if ref.owner_address != self.sock_path:
+        if ref.owner_address != self.address:
             try:
                 meta, bufs = self.run_sync(self._probe_remote(ref), timeout=5)
             except Exception:
@@ -374,6 +398,7 @@ class CoreWorker:
         conn = await self._get_conn(ref.owner_address)
         return await conn.call("get_object",
                                {"object_id": ref.object_id.hex(),
+                                "shm_domain": self.shm_domain,
                                 "wait": False})
 
     # ------------------------------------------------------------- functions
@@ -415,7 +440,7 @@ class CoreWorker:
                     oid = ObjectID.from_random()
                     self.shm_store.create(oid, frames)
                     self.memory_store.put(oid, None)
-                    out.append(("ref", (oid.binary(), self.sock_path)))
+                    out.append(("ref", (oid.binary(), self.address)))
                 else:
                     # materialize out-of-band buffers: inline frames ride
                     # the pickled payload, which can't carry memoryviews
@@ -435,9 +460,9 @@ class CoreWorker:
             max_retries=(self.config.task_max_retries
                          if max_retries is None else max_retries),
             scheduling_strategy=strategy or SchedulingStrategy(),
-            name=name, owner_address=self.sock_path,
+            name=name, owner_address=self.address,
         )
-        refs = [ObjectRef(oid, self.sock_path)
+        refs = [ObjectRef(oid, self.address)
                 for oid in spec.return_object_ids()]
         asyncio.run_coroutine_threadsafe(self._submit_normal(spec), self._loop)
         return refs
@@ -535,6 +560,8 @@ class CoreWorker:
                         "pg_id": strategy.placement_group_id.hex()
                         if strategy.placement_group_id else None,
                         "bundle_index": strategy.bundle_index,
+                        "node_id": strategy.node_id,
+                        "soft": strategy.soft,
                     }}
                 self._lease_requests_inflight[shape] += 1
                 try:
@@ -589,7 +616,7 @@ class CoreWorker:
             "args": ser_args,
             "kwargs_keys": kw_keys,
             "max_concurrency": max_concurrency,
-            "owner_address": self.sock_path,
+            "owner_address": self.address,
             "name": name,
         }
         strategy = strategy or SchedulingStrategy()
@@ -604,6 +631,8 @@ class CoreWorker:
                 "pg_id": strategy.placement_group_id.hex()
                 if strategy.placement_group_id else None,
                 "bundle_index": strategy.bundle_index,
+                "node_id": strategy.node_id,
+                "soft": strategy.soft,
             },
         }
         st = {"state": "PENDING", "address": None, "error": None,
@@ -718,9 +747,9 @@ class CoreWorker:
             task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
             function_ref=("method", method_name), args=ser_args,
             kwargs_keys=kw_keys, num_returns=num_returns, actor_id=actor_id,
-            method_name=method_name, seq_no=seq, owner_address=self.sock_path,
+            method_name=method_name, seq_no=seq, owner_address=self.address,
         )
-        refs = [ObjectRef(oid, self.sock_path)
+        refs = [ObjectRef(oid, self.address)
                 for oid in spec.return_object_ids()]
         asyncio.run_coroutine_threadsafe(
             self._submit_actor_task(spec), self._loop)
@@ -808,6 +837,12 @@ class CoreWorker:
 
     async def _exec_get_object(self, payload):
         oid = ObjectID.from_hex(payload["object_id"])
+        # Same shm domain (same host): answer with an attach hint so the
+        # requester maps the segment zero-copy. Cross-domain (another node):
+        # read the frames locally and ship bytes over the wire (reference:
+        # object manager chunked pull, ``object_manager.h:117``).
+        same_domain = payload.get("shm_domain", self.shm_domain) == \
+            self.shm_domain
         if payload.get("wait"):
             frames = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self.memory_store.get(oid, timeout=300))
@@ -815,7 +850,13 @@ class CoreWorker:
             frames = self.memory_store.get(oid, timeout=0)
         if frames is None:
             if self.memory_store.contains(oid) or self.shm_store.contains(oid):
-                return {"found": True, "in_shm": True}
+                if same_domain:
+                    return {"found": True, "in_shm": True}
+                frames = self.shm_store.get(oid)
+                if frames is None:
+                    return {"found": False}
+                return ({"found": True, "in_shm": False},
+                        [bytes(f) for f in frames])
             return {"found": False}
         return {"found": True, "in_shm": False}, [bytes(f) for f in frames]
 
@@ -901,7 +942,7 @@ class CoreWorker:
     def _package_returns(self, meta, values) -> Tuple[list, list]:
         """Serialize return values: small inline, large to shm."""
         returns_meta, out_bufs = [], []
-        owner_is_remote = meta["owner_address"] != self.sock_path
+        owner_is_remote = meta["owner_address"] != self.address
         for i, v in enumerate(values):
             frames = self.serde.serialize(v)
             total = sum(len(f) for f in frames)

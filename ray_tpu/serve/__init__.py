@@ -1,0 +1,23 @@
+"""ray_tpu.serve — model serving library.
+
+Capability parity with ``ray.serve`` (reference:
+``python/ray/serve/__init__.py``): deployments, applications, handles,
+an HTTP proxy, dynamic batching, and replica autoscaling — rebuilt for
+this runtime's threaded actors, with TPU-aware bucketed-padding batching
+so jitted models see a fixed set of static batch shapes.
+"""
+from .api import (Application, Deployment, delete, deployment,
+                  get_app_handle, get_deployment_handle, run, shutdown,
+                  start, status)
+from .batching import batch, default_buckets, pad_to_bucket
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .handle import DeploymentHandle, DeploymentResponse
+from .request import Request, Response
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "Request",
+    "Response", "batch", "default_buckets", "delete", "deployment",
+    "get_app_handle", "get_deployment_handle", "pad_to_bucket", "run",
+    "shutdown", "start", "status",
+]

@@ -1,0 +1,358 @@
+"""Serve controller actor: deployment state machine + autoscaler + health.
+
+Capability parity with the reference controller
+(reference: ``python/ray/serve/_private/controller.py:86`` — app/deployment
+state reconciliation; ``deployment_state.py`` — replica lifecycle;
+``autoscaling_state.py:262`` — metrics-driven target computation), rebuilt
+as a single sync actor whose reconcile loop runs on a daemon thread and
+whose RPC methods run on the actor's thread pool (this runtime's actors are
+thread-concurrent, not asyncio-concurrent).
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from .config import AutoscalingConfig, DeploymentConfig
+
+
+class ServeController:
+    RECONCILE_INTERVAL_S = 0.1
+
+    def __init__(self):
+        # Lock order: _reconcile_lock (outer, serializes every scaling /
+        # teardown mutation across the RPC threads and the loop thread)
+        # then _lock (inner, guards state reads/writes).
+        self._reconcile_lock = threading.RLock()
+        self._lock = threading.RLock()
+        self._apps: Dict[str, dict] = {}
+        self._http_info: Optional[dict] = None
+        self._replica_counter = 0
+        self._stop = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="rt-serve-ctrl")
+        self._loop_thread.start()
+
+    # -------------------------------------------------------------- deploy
+    def deploy_app(self, spec: dict) -> dict:
+        """Deploy (or redeploy) an application.
+
+        ``spec`` = {name, route_prefix, ingress,
+        deployments: [{name, payload, config: DeploymentConfig}]}.
+        Blocks until every deployment has its initial target of healthy
+        replicas (reference: ``serve.run(..., _blocking=True)``).
+        """
+        name = spec["name"]
+        with self._reconcile_lock:
+            with self._lock:
+                app = self._apps.setdefault(
+                    name, {"name": name, "route_prefix": None,
+                           "ingress": None, "deployments": {}})
+                app["route_prefix"] = spec.get("route_prefix")
+                app["ingress"] = spec["ingress"]
+                wanted = {d["name"] for d in spec["deployments"]}
+                removed = [app["deployments"].pop(dname)
+                           for dname in list(app["deployments"])
+                           if dname not in wanted]
+            for dstate in removed:
+                self._teardown_deployment(dstate)
+            # _apply_deployment only mutates state under _lock; the
+            # blocking replica RPCs it schedules (teardown of replaced
+            # deployments, reconfigure fan-out) run here, outside _lock,
+            # so status()/get_replicas() stay responsive during redeploys.
+            deferred = []
+            with self._lock:
+                for dspec in spec["deployments"]:
+                    deferred.extend(self._apply_deployment(app, dspec))
+            for action in deferred:
+                action()
+            self._reconcile_once()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if self._app_ready(name):
+                return self.status()
+            time.sleep(0.05)
+        raise TimeoutError(f"app {name!r} did not become ready")
+
+    def _apply_deployment(self, app: dict, dspec: dict) -> list:
+        """Mutate deployment state; returns deferred blocking actions for
+        the caller to run outside the state lock."""
+        dname = dspec["name"]
+        cfg: DeploymentConfig = dspec["config"]
+        cur = app["deployments"].get(dname)
+        deferred = []
+        if cur is not None and cur["payload"] == dspec["payload"]:
+            if cur["config"] != cfg:
+                cur["config"] = cfg
+                cur["target"] = cfg.initial_target()
+                replicas = list(cur["replicas"].values())
+                deferred.append(lambda: [
+                    self._call_quietly(r["handle"].reconfigure,
+                                       cfg.user_config) for r in replicas])
+                cur["version"] += 1
+            return deferred
+        if cur is not None:
+            deferred.append(lambda c=cur: self._teardown_deployment(c))
+        app["deployments"][dname] = {
+            "app": app["name"],
+            "name": dname,
+            "payload": dspec["payload"],
+            "config": cfg,
+            "target": cfg.initial_target(),
+            "version": 0,
+            "replicas": {},
+            "scale": {"desired": None, "since": 0.0, "last_metric": 0.0},
+            "last_health": 0.0,
+        }
+        return deferred
+
+    def _teardown_deployment(self, dstate: dict):
+        from .. import api as rt
+
+        with self._reconcile_lock:
+            with self._lock:
+                dstate["deleted"] = True
+                victims = list(dstate["replicas"].values())
+                dstate["replicas"] = {}
+            for r in victims:
+                self._call_quietly(
+                    r["handle"].drain,
+                    dstate["config"].graceful_shutdown_timeout_s)
+                try:
+                    rt.kill(r["handle"])
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # ------------------------------------------------------------ queries
+    def get_replicas(self, app_name: str, deployment_name: str
+                     ) -> Optional[dict]:
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return None
+            d = app["deployments"].get(deployment_name)
+            if d is None:
+                return None
+            return {"version": d["version"],
+                    "max_ongoing_requests": d["config"].max_ongoing_requests,
+                    "replicas": {rid: r["handle"]
+                                 for rid, r in d["replicas"].items()}}
+
+    def get_routes(self) -> Dict[str, dict]:
+        with self._lock:
+            out = {}
+            for name, app in self._apps.items():
+                if app["route_prefix"]:
+                    out[app["route_prefix"]] = {
+                        "app": name, "ingress": app["ingress"]}
+            return out
+
+    def get_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            app = self._apps.get(app_name)
+            return app["ingress"] if app else None
+
+    def status(self) -> dict:
+        with self._lock:
+            apps = {}
+            for name, app in self._apps.items():
+                deps = {}
+                for dname, d in app["deployments"].items():
+                    n_healthy = len(d["replicas"])
+                    deps[dname] = {
+                        "status": ("HEALTHY" if n_healthy >= d["target"]
+                                   else "UPDATING"),
+                        "replicas": n_healthy,
+                        "target": d["target"],
+                    }
+                apps[name] = {"route_prefix": app["route_prefix"],
+                              "ingress": app["ingress"],
+                              "deployments": deps}
+            return {"applications": apps, "http": self._http_info}
+
+    def set_http_info(self, info: dict):
+        self._http_info = info
+
+    def get_http_info(self) -> Optional[dict]:
+        return self._http_info
+
+    def delete_app(self, name: str) -> bool:
+        with self._lock:
+            app = self._apps.pop(name, None)
+        if app is None:
+            return False
+        for d in app["deployments"].values():
+            self._teardown_deployment(d)
+        return True
+
+    def shutdown_serve(self):
+        self._stop.set()
+        for name in list(self._apps):
+            self.delete_app(name)
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+    # --------------------------------------------------------- reconcile
+    def _app_ready(self, name: str) -> bool:
+        with self._lock:
+            app = self._apps.get(name)
+            if app is None:
+                return False
+            return all(len(d["replicas"]) >= d["target"]
+                       for d in app["deployments"].values())
+
+    def _reconcile_loop(self):
+        while not self._stop.wait(self.RECONCILE_INTERVAL_S):
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                traceback.print_exc()
+
+    def _reconcile_once(self):
+        with self._reconcile_lock:
+            with self._lock:
+                work = [(app_name, dname, d)
+                        for app_name, app in self._apps.items()
+                        for dname, d in app["deployments"].items()]
+            for app_name, dname, d in work:
+                if d.get("deleted"):
+                    continue
+                try:
+                    self._health_check(d)
+                    self._autoscale(d)
+                    self._scale_to_target(app_name, dname, d)
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+
+    def _health_check(self, d: dict):
+        from .. import api as rt
+
+        period = d["config"].health_check_period_s
+        if time.time() - d["last_health"] < period:
+            return
+        d["last_health"] = time.time()
+        with self._lock:
+            probes = [(rid, r["handle"].check_health.remote())
+                      for rid, r in d["replicas"].items()]
+        dead = []
+        for rid, ref in probes:
+            try:
+                ok = rt.get(ref, timeout=5)
+                if not ok:
+                    dead.append(rid)
+            except Exception:  # noqa: BLE001 - died or hung
+                dead.append(rid)
+        if dead:
+            with self._lock:
+                for rid in dead:
+                    r = d["replicas"].pop(rid, None)
+                    if r is not None:
+                        try:
+                            rt.kill(r["handle"])
+                        except Exception:  # noqa: BLE001
+                            pass
+                d["version"] += 1
+
+    def _autoscale(self, d: dict):
+        from .. import api as rt
+
+        ac: Optional[AutoscalingConfig] = d["config"].autoscaling_config
+        if ac is None:
+            return
+        if time.time() - d["scale"]["last_metric"] < ac.metrics_interval_s:
+            return
+        d["scale"]["last_metric"] = time.time()
+        with self._lock:
+            refs = [r["handle"].get_metrics.remote()
+                    for r in d["replicas"].values()]
+        total_ongoing = 0.0
+        for ref in refs:
+            try:
+                m = rt.get(ref, timeout=5)
+                total_ongoing += m["ongoing"]
+            except Exception:  # noqa: BLE001 - health loop reaps it
+                pass
+        cur = d["target"]
+        desired = math.ceil(total_ongoing / max(ac.target_ongoing_requests,
+                                                1e-9))
+        desired = max(ac.min_replicas, min(ac.max_replicas, desired))
+        sc = d["scale"]
+        if desired == cur:
+            sc["desired"] = None
+            return
+        if sc["desired"] != desired:
+            sc["desired"] = desired
+            sc["since"] = time.time()
+            return
+        delay = ac.upscale_delay_s if desired > cur else ac.downscale_delay_s
+        if time.time() - sc["since"] >= delay:
+            d["target"] = desired
+            sc["desired"] = None
+
+    def _scale_to_target(self, app_name: str, dname: str, d: dict):
+        from .. import api as rt
+
+        with self._lock:
+            have = len(d["replicas"])
+            target = d["target"]
+            cfg = d["config"]
+        if have < target:
+            new = [self._start_replica(app_name, dname, d)
+                   for _ in range(target - have)]
+            ok = []
+            for rid, handle in new:
+                try:
+                    handle._wait_ready(timeout=60)
+                    ok.append((rid, handle))
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+            if ok:
+                with self._lock:
+                    for rid, handle in ok:
+                        d["replicas"][rid] = {"handle": handle,
+                                              "created": time.time()}
+                    d["version"] += 1
+        elif have > target:
+            with self._lock:
+                victims = sorted(d["replicas"].items(),
+                                 key=lambda kv: kv[1]["created"],
+                                 reverse=True)[:have - target]
+                for rid, _ in victims:
+                    d["replicas"].pop(rid, None)
+                d["version"] += 1
+            for rid, r in victims:
+                self._call_quietly(r["handle"].drain,
+                                   cfg.graceful_shutdown_timeout_s)
+                try:
+                    rt.kill(r["handle"])
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _start_replica(self, app_name: str, dname: str, d: dict):
+        from .. import api as rt
+        from ._replica import Replica
+
+        cfg: DeploymentConfig = d["config"]
+        self._replica_counter += 1
+        rid = f"{dname}#{self._replica_counter}"
+        opts = dict(cfg.ray_actor_options)
+        opts.setdefault("num_cpus", 1)
+        actor_cls = rt.remote(Replica).options(
+            max_concurrency=cfg.max_ongoing_requests + 4, **opts)
+        handle = actor_cls.remote(app_name, dname, rid, d["payload"],
+                                  cfg.user_config)
+        return rid, handle
+
+    @staticmethod
+    def _call_quietly(method, *args):
+        from .. import api as rt
+
+        try:
+            rt.get(method.remote(*args), timeout=10)
+        except Exception:  # noqa: BLE001
+            pass

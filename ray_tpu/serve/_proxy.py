@@ -1,0 +1,196 @@
+"""HTTP proxy actor: routes requests to app ingress deployments.
+
+Capability parity with the reference proxy
+(reference: ``python/ray/serve/_private/proxy.py:752`` — route-prefix
+matching, per-request handle dispatch, draining), rebuilt as a minimal
+asyncio HTTP/1.1 server on a dedicated thread instead of uvicorn/ASGI
+(no server framework in this image; requests hop processes anyway).
+
+Blocking handle calls are pushed to a thread pool so the accept loop never
+stalls on a slow replica.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+from .config import SERVE_CONTROLLER_NAME
+from .handle import DeploymentHandle
+from .request import Request, Response, encode_body
+
+_MAX_BODY = 256 * 1024 * 1024
+
+
+class ProxyActor:
+    ROUTES_TTL_S = 1.0
+
+    def __init__(self):
+        self._routes: Dict[str, dict] = {}
+        self._routes_at = 0.0
+        self._routes_lock = threading.Lock()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="rt-serve-proxy")
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._port: Optional[int] = None
+        self._started = threading.Event()
+        self._request_timeout_s = 60.0
+
+    def start(self, host: str, port: int, request_timeout_s: float = 60.0
+              ) -> dict:
+        """Bind and serve on a dedicated event-loop thread; returns the
+        actual bound port (``port=0`` picks a free one)."""
+        self._request_timeout_s = request_timeout_s
+        t = threading.Thread(target=self._serve_thread, args=(host, port),
+                             daemon=True, name="rt-serve-http")
+        t.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("proxy failed to bind")
+        return {"host": host, "port": self._port}
+
+    def _serve_thread(self, host: str, port: int):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def _main():
+            server = await asyncio.start_server(self._handle_conn, host, port)
+            self._port = server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with server:
+                await server.serve_forever()
+
+        try:
+            loop.run_until_complete(_main())
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+
+    def ping(self) -> bool:
+        return True
+
+    def get_port(self) -> Optional[int]:
+        return self._port
+
+    # ------------------------------------------------------------- routing
+    def _get_routes(self) -> Dict[str, dict]:
+        now = time.monotonic()
+        with self._routes_lock:
+            if now - self._routes_at < self.ROUTES_TTL_S:
+                return self._routes
+        from .. import api as rt
+
+        try:
+            ctrl = rt.get_actor(SERVE_CONTROLLER_NAME, timeout=5)
+            routes = rt.get(ctrl.get_routes.remote(), timeout=10)
+            with self._routes_lock:
+                self._routes = routes
+                self._routes_at = now
+        except Exception:  # noqa: BLE001 - keep stale routes
+            pass
+        return self._routes
+
+    def _match(self, path: str) -> Optional[dict]:
+        routes = self._get_routes()
+        best, best_len = None, -1
+        for prefix, target in routes.items():
+            p = prefix.rstrip("/") or "/"
+            if (path == p or path.startswith(p if p == "/" else p + "/")
+                    or (p != "/" and path == p)):
+                if len(p) > best_len:
+                    best, best_len = {**target, "prefix": p}, len(p)
+        return best
+
+    # --------------------------------------------------------- HTTP server
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                status, ctype, body = await self._dispatch(req)
+                keep = req.headers.get("connection", "").lower() != "close"
+                writer.write(
+                    b"HTTP/1.1 %d %s\r\n" % (status, _reason(status)) +
+                    b"Content-Type: %s\r\n" % ctype.encode() +
+                    b"Content-Length: %d\r\n" % len(body) +
+                    (b"Connection: keep-alive\r\n" if keep
+                     else b"Connection: close\r\n") +
+                    b"\r\n" + body)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @staticmethod
+    async def _read_request(reader: asyncio.StreamReader) -> Optional[Request]:
+        try:
+            line = await reader.readline()
+        except (ConnectionError, asyncio.LimitOverrunError):
+            return None
+        if not line or line in (b"\r\n", b"\n"):
+            return None
+        try:
+            method, target, _version = line.decode().split(None, 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if not h or h in (b"\r\n", b"\n"):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", "0") or "0")
+        body = b""
+        if 0 < length <= _MAX_BODY:
+            body = await reader.readexactly(length)
+        return Request.from_target(method, target, headers, body)
+
+    async def _dispatch(self, req: Request):
+        if req.path == "/-/routes":
+            return 200, "application/json", json.dumps(
+                {p: f"{t['app']}:{t['ingress']}"
+                 for p, t in self._get_routes().items()}).encode()
+        if req.path == "/-/healthz":
+            return 200, "text/plain", b"ok"
+        target = self._match(req.path)
+        if target is None:
+            return 404, "text/plain", b"no application at this route"
+        loop = asyncio.get_running_loop()
+        try:
+            result = await asyncio.wait_for(
+                loop.run_in_executor(
+                    self._pool, self._call_app, target, req),
+                timeout=self._request_timeout_s)
+        except asyncio.TimeoutError:
+            return 504, "text/plain", b"request timed out"
+        except Exception as e:  # noqa: BLE001
+            return 500, "text/plain", (
+                f"{type(e).__name__}: {e}".encode())
+        if isinstance(result, Response):
+            status, ctype, body = result.encode()
+            return status, ctype, body
+        ctype, body = encode_body(result)
+        return 200, ctype, body
+
+    def _call_app(self, target: dict, req: Request):
+        handle = DeploymentHandle(target["app"], target["ingress"])
+        return handle.remote(req).result(timeout=self._request_timeout_s)
+
+
+def _reason(status: int) -> bytes:
+    return {200: b"OK", 404: b"Not Found", 500: b"Internal Server Error",
+            504: b"Gateway Timeout"}.get(status, b"Unknown")

@@ -1,0 +1,114 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Capability parity with the reference's replica
+(reference: ``python/ray/serve/_private/replica.py:231`` — user callable
+wrapper, ongoing-request accounting, health checks, reconfigure), rebuilt
+for this runtime's threaded actors: requests execute on the actor's
+``max_concurrency`` thread pool, ongoing counts are plain
+lock-protected integers, and metrics are pulled by the controller.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+import time
+from typing import Any, Dict
+
+import cloudpickle
+
+
+class Replica:
+    """Created by the controller with
+    ``max_concurrency = max_ongoing_requests + headroom`` so that metrics and
+    health probes still run while requests saturate the pool."""
+
+    def __init__(self, app_name: str, deployment_name: str, replica_id: str,
+                 payload: bytes, user_config: Any = None):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        callable_def, init_args, init_kwargs = cloudpickle.loads(payload)
+        init_args = _resolve_handles(app_name, init_args)
+        init_kwargs = _resolve_handles(app_name, init_kwargs)
+        if inspect.isclass(callable_def):
+            self._user = callable_def(*init_args, **init_kwargs)
+        else:
+            self._user = callable_def  # plain function deployment
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        self._start_time = time.time()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # ------------------------------------------------------------ data plane
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
+                method = self._user
+            else:
+                method = getattr(self._user, method_name)
+            out = method(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                # Per-call loop: our replicas are thread-concurrent, not
+                # loop-concurrent; shared batching state lives in
+                # serve.batching's thread queues instead.
+                out = asyncio.run(out)
+            return out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # ---------------------------------------------------------- control plane
+    def get_metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"replica_id": self.replica_id, "ongoing": self._ongoing,
+                    "total": self._total, "uptime": time.time() - self._start_time}
+
+    def check_health(self) -> bool:
+        fn = getattr(self._user, "check_health", None)
+        if fn is not None:
+            out = fn()
+            if inspect.iscoroutine(out):
+                out = asyncio.run(out)
+            return bool(out) if out is not None else True
+        return True
+
+    def reconfigure(self, user_config: Any):
+        fn = getattr(self._user, "reconfigure", None)
+        if fn is not None:
+            out = fn(user_config)
+            if inspect.iscoroutine(out):
+                asyncio.run(out)
+        return True
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful shutdown: wait for in-flight requests to finish."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return True
+            time.sleep(0.01)
+        return False
+
+
+def _resolve_handles(app_name: str, obj):
+    """Replace bound-deployment markers with live handles at init time
+    (reference analogue: init-arg DAG resolution in
+    ``serve/_private/deployment_graph_build.py``)."""
+    from .handle import DeploymentHandle, _HandleMarker
+
+    if isinstance(obj, _HandleMarker):
+        return DeploymentHandle(app_name, obj.deployment_name)
+    if isinstance(obj, tuple):
+        return tuple(_resolve_handles(app_name, x) for x in obj)
+    if isinstance(obj, list):
+        return [_resolve_handles(app_name, x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _resolve_handles(app_name, v) for k, v in obj.items()}
+    return obj

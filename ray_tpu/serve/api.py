@@ -1,0 +1,286 @@
+"""Serve public API: ``@deployment``, ``bind``, ``run``, handles, lifecycle.
+
+Capability parity with the reference's ``ray.serve.api``
+(reference: ``python/ray/serve/api.py:248`` ``deployment``, ``:545`` ``run``,
+``:66`` ``start``, ``:120`` ``shutdown``, ``:780`` ``status``, ``:808`` /
+``:844`` handle getters; ``serve/deployment.py`` ``Deployment`` /
+``Application``). The deployment graph is serialized per-deployment with
+bound sub-applications replaced by handle markers, resolved back into live
+``DeploymentHandle``s at replica init.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import cloudpickle
+
+from .. import api as rt
+from ..exceptions import RayTpuError
+from .config import (DEFAULT_APP_NAME, SERVE_CONTROLLER_NAME,
+                     AutoscalingConfig, DeploymentConfig, HTTPOptions)
+from .handle import DeploymentHandle, _HandleMarker, reset_routers
+
+_client_lock = threading.Lock()
+_client: Dict[str, Any] = {"controller": None, "proxy": None, "http": None}
+
+
+class Deployment:
+    """A configured-but-unbound deployment (user class/function + config)."""
+
+    def __init__(self, func_or_class: Callable, name: str,
+                 config: DeploymentConfig):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Union[None, dict,
+                                          AutoscalingConfig] = None,
+                user_config: Any = None,
+                health_check_period_s: Optional[float] = None,
+                graceful_shutdown_timeout_s: Optional[float] = None,
+                ray_actor_options: Optional[dict] = None) -> "Deployment":
+        cfg = self.config
+        updates: Dict[str, Any] = {}
+        if num_replicas is not None:
+            updates["num_replicas"] = num_replicas
+        if max_ongoing_requests is not None:
+            updates["max_ongoing_requests"] = max_ongoing_requests
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            updates["autoscaling_config"] = autoscaling_config
+        if user_config is not None:
+            updates["user_config"] = user_config
+        if health_check_period_s is not None:
+            updates["health_check_period_s"] = health_check_period_s
+        if graceful_shutdown_timeout_s is not None:
+            updates["graceful_shutdown_timeout_s"] = graceful_shutdown_timeout_s
+        if ray_actor_options is not None:
+            updates["ray_actor_options"] = ray_actor_options
+        return Deployment(self.func_or_class, name or self.name,
+                          replace(cfg, **updates))
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name!r})"
+
+
+class Application:
+    """A bound deployment graph; the root is the app's ingress."""
+
+    def __init__(self, deployment: Deployment, args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+def deployment(_func_or_class: Optional[Callable] = None, *,
+               name: Optional[str] = None,
+               num_replicas: Union[int, str, None] = None,
+               max_ongoing_requests: Optional[int] = None,
+               autoscaling_config: Union[None, dict,
+                                         AutoscalingConfig] = None,
+               user_config: Any = None,
+               health_check_period_s: Optional[float] = None,
+               graceful_shutdown_timeout_s: Optional[float] = None,
+               ray_actor_options: Optional[dict] = None):
+    """``@serve.deployment`` decorator (reference: ``serve/api.py:248``).
+
+    ``num_replicas="auto"`` enables autoscaling with default bounds, like the
+    reference's ``handle_num_replicas_auto``.
+    """
+
+    def decorate(obj):
+        cfg = DeploymentConfig()
+        nr = num_replicas
+        asc = autoscaling_config
+        if nr == "auto":
+            nr = None
+            if asc is None:
+                asc = AutoscalingConfig(min_replicas=1, max_replicas=10)
+        if isinstance(asc, dict):
+            asc = AutoscalingConfig(**asc)
+        if nr is not None:
+            cfg.num_replicas = int(nr)
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        cfg.autoscaling_config = asc
+        if user_config is not None:
+            cfg.user_config = user_config
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if graceful_shutdown_timeout_s is not None:
+            cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        return Deployment(obj, name or obj.__name__, cfg)
+
+    if _func_or_class is not None and callable(_func_or_class):
+        return decorate(_func_or_class)
+    return decorate
+
+
+# ------------------------------------------------------------------ lifecycle
+def start(http_options: Union[None, dict, HTTPOptions] = None,
+          proxy: bool = True):
+    """Start the Serve control plane (controller + optional HTTP proxy)."""
+    if not rt.is_initialized():
+        rt.init()
+    if isinstance(http_options, dict):
+        http_options = HTTPOptions(**http_options)
+    http_options = http_options or HTTPOptions()
+    with _client_lock:
+        if _client["controller"] is None:
+            _client["controller"] = _get_or_create_controller()
+        if proxy and _client["proxy"] is None:
+            from ._proxy import ProxyActor
+
+            p = rt.remote(ProxyActor).options(
+                name="SERVE_PROXY", max_concurrency=8).remote()
+            info = rt.get(p.start.remote(
+                http_options.host, http_options.port,
+                http_options.request_timeout_s), timeout=30)
+            rt.get(_client["controller"].set_http_info.remote(info),
+                   timeout=10)
+            _client["proxy"] = p
+            _client["http"] = info
+    return _client["controller"]
+
+
+def _get_or_create_controller():
+    from ._controller import ServeController
+
+    try:
+        return rt.get_actor(SERVE_CONTROLLER_NAME, timeout=0.5)
+    except Exception:  # noqa: BLE001 - not created yet
+        pass
+    try:
+        ctrl = rt.remote(ServeController).options(
+            name=SERVE_CONTROLLER_NAME, max_concurrency=16).remote()
+        ctrl._wait_ready(timeout=30)
+        return ctrl
+    except Exception:  # noqa: BLE001 - lost a creation race
+        return rt.get_actor(SERVE_CONTROLLER_NAME, timeout=10)
+
+
+def run(app: Application, *, name: str = DEFAULT_APP_NAME,
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _proxy: bool = True) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress
+    (reference: ``serve/api.py:545``)."""
+    if not isinstance(app, Application):
+        raise TypeError("serve.run() takes an Application built with "
+                        "`Deployment.bind()`")
+    ctrl = start(proxy=_proxy)
+    spec = _build_app_spec(app, name, route_prefix)
+    rt.get(ctrl.deploy_app.remote(spec), timeout=120)
+    handle = DeploymentHandle(name, spec["ingress"])
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def _build_app_spec(app: Application, name: str,
+                    route_prefix: Optional[str]) -> dict:
+    deployments: Dict[str, dict] = {}
+
+    def visit(a: Application) -> str:
+        d = a.deployment
+        args = _strip(a.args)
+        kwargs = _strip(a.kwargs)
+        payload = cloudpickle.dumps((d.func_or_class, args, kwargs))
+        if d.name in deployments:
+            if deployments[d.name]["payload"] != payload:
+                raise RayTpuError(
+                    f"two different deployments named {d.name!r} in one app")
+        else:
+            deployments[d.name] = {"name": d.name, "payload": payload,
+                                   "config": d.config}
+        return d.name
+
+    def _strip(obj):
+        if isinstance(obj, Application):
+            return _HandleMarker(visit(obj))
+        if isinstance(obj, Deployment):
+            raise RayTpuError(
+                f"pass {obj!r} as an init arg via .bind(), not raw")
+        if isinstance(obj, tuple):
+            return tuple(_strip(x) for x in obj)
+        if isinstance(obj, list):
+            return [_strip(x) for x in obj]
+        if isinstance(obj, dict):
+            return {k: _strip(v) for k, v in obj.items()}
+        return obj
+
+    ingress = visit(app)
+    return {"name": name, "route_prefix": route_prefix, "ingress": ingress,
+            "deployments": list(deployments.values())}
+
+
+def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
+    ctrl = _controller()
+    ingress = rt.get(ctrl.get_ingress.remote(name), timeout=10)
+    if ingress is None:
+        raise RayTpuError(f"no application named {name!r}")
+    return DeploymentHandle(name, ingress)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = DEFAULT_APP_NAME
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def status() -> dict:
+    return rt.get(_controller().status.remote(), timeout=10)
+
+
+def delete(name: str):
+    rt.get(_controller().delete_app.remote(name), timeout=60)
+    reset_routers()
+
+
+def shutdown():
+    """Tear down all apps, the proxy, and the controller."""
+    with _client_lock:
+        ctrl = _client["controller"]
+        if ctrl is None:
+            try:
+                ctrl = rt.get_actor(SERVE_CONTROLLER_NAME, timeout=0.5)
+            except Exception:  # noqa: BLE001
+                ctrl = None
+        if ctrl is not None:
+            try:
+                rt.get(ctrl.shutdown_serve.remote(), timeout=60)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                rt.kill(ctrl)
+            except Exception:  # noqa: BLE001
+                pass
+        if _client["proxy"] is not None:
+            try:
+                rt.kill(_client["proxy"])
+            except Exception:  # noqa: BLE001
+                pass
+        _client.update({"controller": None, "proxy": None, "http": None})
+    reset_routers()
+
+
+def _controller():
+    with _client_lock:
+        if _client["controller"] is not None:
+            return _client["controller"]
+    return rt.get_actor(SERVE_CONTROLLER_NAME, timeout=10)

@@ -1,0 +1,179 @@
+"""Dynamic request batching with TPU-friendly bucketed padding.
+
+Capability parity with ``@serve.batch`` (reference:
+``python/ray/serve/batching.py:530`` — queue per wrapped function, flush on
+``max_batch_size`` or ``batch_wait_timeout_s``), rebuilt on threads +
+``concurrent.futures`` to match this runtime's threaded replica execution
+model instead of the reference's asyncio replica event loop.
+
+The TPU-specific part is **bucketed padding**: a jitted model recompiles for
+every distinct batch size, so naively flushing whatever arrived (3 requests,
+then 7, then 5 …) would trigger a new XLA compilation per size. With
+``pad_to_bucket=True`` the flusher pads each batch up to the next bucket
+(powers of two by default) by repeating the final item, runs the handler on
+the static-shaped batch, and truncates the results — so the jitted callee
+only ever sees ``len(buckets)`` distinct shapes (SURVEY.md §7: "dynamic
+batching vs static XLA shapes via bucketed padding").
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+
+def default_buckets(max_batch_size: int) -> List[int]:
+    """Powers of two up to (and including) max_batch_size."""
+    out, b = [], 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return sorted(set(out))
+
+
+def pad_to_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+class _BatchQueue:
+    """One pending-request queue + flusher thread per wrapped function."""
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float,
+                 pad: bool, buckets: Optional[Sequence[int]]):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = batch_wait_timeout_s
+        self.pad = pad
+        self.buckets = sorted(buckets) if buckets else \
+            default_buckets(max_batch_size)
+        self.q: "queue.Queue" = queue.Queue()
+        self.batch_sizes: List[int] = []  # observed (pre-pad) for tests/metrics
+        self._thread = threading.Thread(
+            target=self._flusher, daemon=True, name="rt-serve-batch")
+        self._thread.start()
+
+    def submit(self, item) -> "concurrent.futures.Future":
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        self.q.put((item, fut))
+        return fut
+
+    def _flusher(self):
+        while True:
+            item, fut = self.q.get()
+            batch = [(item, fut)]
+            deadline = time.monotonic() + self.timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self.q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._run_batch(batch)
+
+    def _run_batch(self, batch):
+        items = [b[0] for b in batch]
+        futs = [b[1] for b in batch]
+        self.batch_sizes.append(len(items))
+        n = len(items)
+        if self.pad:
+            target = pad_to_bucket(n, self.buckets)
+            items = items + [items[-1]] * (target - n)
+        try:
+            results = self.fn(items)
+            if results is None or len(results) < n:
+                raise ValueError(
+                    f"batch handler returned {0 if results is None else len(results)} "
+                    f"results for {n} requests")
+            for fut, r in zip(futs, results[:n]):
+                fut.set_result(r)
+        except Exception as e:  # noqa: BLE001 - fan the error out per caller
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+# Runtime state (queues, locks) lives here — NOT in decorator closures —
+# because deployment classes are cloudpickled at ``bind()`` time and
+# thread locks / running flusher threads don't pickle.
+_REGISTRY: dict = {}
+_REG_LOCK = threading.Lock()
+
+
+def _queue_for(self_obj, key, fn, cfg) -> _BatchQueue:
+    max_bs, wait_s, pad, buckets = cfg
+    if self_obj is not None:
+        attr = f"__rt_batch_queue_{fn.__name__}"
+        bq = self_obj.__dict__.get(attr)
+        if bq is None:
+            with _REG_LOCK:
+                bq = self_obj.__dict__.get(attr)
+                if bq is None:
+                    bq = _BatchQueue(lambda items: fn(self_obj, items),
+                                     max_bs, wait_s, pad, buckets)
+                    object.__setattr__(self_obj, attr, bq)
+        return bq
+    with _REG_LOCK:
+        bq = _REGISTRY.get(key)
+        if bq is None:
+            bq = _REGISTRY[key] = _BatchQueue(fn, max_bs, wait_s, pad,
+                                              buckets)
+    return bq
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01, pad_to_bucket: bool = False,
+          buckets: Optional[Sequence[int]] = None):
+    """Decorator: turn a ``List[T] -> List[R]`` handler into a ``T -> R``
+    callable that transparently batches concurrent callers.
+
+    Usage (on a replica method)::
+
+        @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.005,
+                     pad_to_bucket=True)
+        def predict_batch(self, inputs):      # inputs: List[np.ndarray]
+            return self._jitted(np.stack(inputs))  # static bucket shapes
+
+        def __call__(self, request):
+            return self.predict_batch(request)
+    """
+
+    def decorate(fn):
+        is_method = _looks_like_method(fn)
+        cfg = (max_batch_size, batch_wait_timeout_s, pad_to_bucket,
+               tuple(buckets) if buckets else None)
+        key = (getattr(fn, "__module__", ""), getattr(fn, "__qualname__", ""))
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            import ray_tpu.serve.batching as _mod
+
+            if is_method:
+                self_obj, item = args
+            else:
+                self_obj, (item,) = None, args
+            return _mod._queue_for(self_obj, key, fn, cfg).submit(
+                item).result()
+
+        wrapper.__rt_is_batched__ = True
+        return wrapper
+
+    if _fn is not None and callable(_fn):
+        return decorate(_fn)
+    return decorate
+
+
+def _looks_like_method(fn) -> bool:
+    import inspect
+
+    params = list(inspect.signature(fn).parameters)
+    return bool(params) and params[0] == "self"

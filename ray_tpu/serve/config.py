@@ -1,0 +1,72 @@
+"""Serve configuration objects.
+
+Capability parity with the reference's ``ray.serve.config``
+(reference: ``python/ray/serve/config.py`` — ``AutoscalingConfig``,
+``HTTPOptions``; ``python/ray/serve/_private/config.py`` —
+``DeploymentConfig``), redesigned as plain dataclasses for this runtime.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Target-driven replica autoscaling.
+
+    The controller computes ``desired = ceil(total_ongoing /
+    target_ongoing_requests)`` from replica-reported metrics and applies it
+    after the decision has been stable for ``upscale_delay_s`` /
+    ``downscale_delay_s`` (reference:
+    ``serve/_private/autoscaling_state.py:262`` and
+    ``serve/autoscaling_policy.py``).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+    metrics_interval_s: float = 0.25
+    initial_replicas: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
+            raise ValueError("need 0 <= min_replicas <= max_replicas")
+
+
+@dataclass
+class DeploymentConfig:
+    """Per-deployment behavior knobs (reference:
+    ``serve/_private/config.py`` ``DeploymentConfig``)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    user_config: Any = None
+    health_check_period_s: float = 2.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+
+    def initial_target(self) -> int:
+        ac = self.autoscaling_config
+        if ac is None:
+            return self.num_replicas
+        if ac.initial_replicas is not None:
+            return max(ac.min_replicas,
+                       min(ac.max_replicas, ac.initial_replicas))
+        return ac.min_replicas
+
+
+@dataclass
+class HTTPOptions:
+    """Proxy bind options (reference: ``serve/config.py`` ``HTTPOptions``)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+    request_timeout_s: float = 60.0
+
+
+SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
+DEFAULT_APP_NAME = "default"

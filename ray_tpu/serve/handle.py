@@ -1,0 +1,274 @@
+"""DeploymentHandle: the client-side data plane (router included).
+
+Capability parity with the reference's handle + router
+(reference: ``python/ray/serve/handle.py`` ``DeploymentHandle`` /
+``DeploymentResponse``; ``serve/_private/router.py:518`` and
+``replica_scheduler/pow_2_scheduler.py:49`` — power-of-two-choices on
+queue length with client-side ``max_ongoing_requests`` admission).
+
+Design differences from the reference: the router lives entirely in the
+caller process (no dedicated router actors), tracks in-flight counts
+locally, and learns replica membership by polling the controller with a
+version number — membership changes are rare; request dispatch is hot.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..exceptions import (ActorDiedError, ActorUnavailableError, RayTpuError,
+                          TaskError, WorkerCrashedError)
+
+_RETRYABLE_CAUSES = ("ActorDiedError", "ActorUnavailableError",
+                     "WorkerCrashedError", "ConnectionLost")
+
+
+def _is_replica_failure(e: Exception) -> bool:
+    if isinstance(e, (ActorDiedError, ActorUnavailableError,
+                      WorkerCrashedError)):
+        return True
+    return (isinstance(e, TaskError)
+            and getattr(e, "cause_type", "") in _RETRYABLE_CAUSES)
+from .config import SERVE_CONTROLLER_NAME
+
+_routers: Dict[Tuple[str, str], "Router"] = {}
+_routers_lock = threading.Lock()
+
+
+class _HandleMarker:
+    """Placeholder for a bound deployment inside init args; replaced with a
+    live ``DeploymentHandle`` at replica init."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+    def __repr__(self):
+        return f"_HandleMarker({self.deployment_name})"
+
+
+def get_router(app_name: str, deployment_name: str) -> "Router":
+    key = (app_name, deployment_name)
+    with _routers_lock:
+        r = _routers.get(key)
+        if r is None or r.closed:
+            r = Router(app_name, deployment_name)
+            _routers[key] = r
+        return r
+
+
+def reset_routers():
+    """Drop all cached routers (serve.shutdown / tests)."""
+    with _routers_lock:
+        for r in _routers.values():
+            r.close()
+        _routers.clear()
+
+
+class DeploymentResponse:
+    """Future-like result of ``handle.remote()``; also awaitable inside
+    async actors (delegates to the ObjectRef awaitable)."""
+
+    def __init__(self, router: "Router", rid: str, ref,
+                 call: Tuple[str, tuple, dict]):
+        self._router = router
+        self._rid = rid
+        self._ref = ref
+        self._call = call
+
+    @property
+    def object_ref(self):
+        return self._ref
+
+    def result(self, timeout: Optional[float] = None,
+               _retries: int = 2) -> Any:
+        from .. import api as rt
+
+        try:
+            return rt.get(self._ref, timeout=timeout)
+        except Exception as e:  # noqa: BLE001
+            # Replica died mid-request: refresh membership and retry on a
+            # different replica (reference: router retry on
+            # ActorDiedError, ``router.py``).
+            if not _is_replica_failure(e):
+                raise
+            self._router.mark_dead(self._rid)
+            if _retries <= 0:
+                raise
+            method, args, kwargs = self._call
+            resp = self._router.submit(method, args, kwargs)
+            self._rid, self._ref = resp._rid, resp._ref
+            return self.result(timeout=timeout, _retries=_retries - 1)
+
+    def __await__(self):
+        return self._ref.__await__()
+
+
+class DeploymentHandle:
+    """Picklable handle to one deployment of one app."""
+
+    def __init__(self, app_name: str, deployment_name: str,
+                 method_name: str = "__call__"):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.method_name = method_name
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.app_name, self.deployment_name, self.method_name))
+
+    def options(self, *, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(self.app_name, self.deployment_name,
+                                method_name or self.method_name)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.app_name, self.deployment_name, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = get_router(self.app_name, self.deployment_name)
+        return router.submit(self.method_name, args, kwargs)
+
+    def __repr__(self):
+        return (f"DeploymentHandle(app={self.app_name!r}, "
+                f"deployment={self.deployment_name!r})")
+
+
+class Router:
+    """Power-of-two-choices replica scheduler with local admission control."""
+
+    MEMBERSHIP_TTL_S = 1.0
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.closed = False
+        self._cond = threading.Condition()
+        self._replicas: Dict[str, Any] = {}   # rid -> ActorHandle
+        self._ongoing: Dict[str, int] = {}
+        self._version = -1
+        self._max_ongoing = 16
+        self._last_refresh = 0.0
+        self._outstanding: Dict[Any, str] = {}  # ObjectRef -> rid
+        self._waiter_wake = threading.Event()
+        self._waiter = threading.Thread(
+            target=self._completion_loop, daemon=True,
+            name=f"rt-serve-router-{deployment_name}")
+        self._waiter.start()
+
+    # -------------------------------------------------------------- control
+    def _controller(self):
+        from .. import api as rt
+
+        return rt.get_actor(SERVE_CONTROLLER_NAME, timeout=10)
+
+    def refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._cond:
+            if not force and now - self._last_refresh < self.MEMBERSHIP_TTL_S:
+                return
+            self._last_refresh = now
+        info = self._controller().get_replicas.remote(
+            self.app_name, self.deployment_name)
+        from .. import api as rt
+
+        info = rt.get(info, timeout=30)
+        if info is None:
+            raise RayTpuError(
+                f"deployment {self.app_name}/{self.deployment_name} not found")
+        with self._cond:
+            if info["version"] == self._version:
+                return
+            self._version = info["version"]
+            self._max_ongoing = info["max_ongoing_requests"]
+            new = dict(info["replicas"])  # rid -> ActorHandle
+            self._replicas = new
+            self._ongoing = {rid: self._ongoing.get(rid, 0) for rid in new}
+            self._cond.notify_all()
+
+    def mark_dead(self, rid: str):
+        with self._cond:
+            self._replicas.pop(rid, None)
+            self._ongoing.pop(rid, None)
+            self._last_refresh = 0.0
+            self._cond.notify_all()
+
+    def close(self):
+        self.closed = True
+        self._waiter_wake.set()
+
+    # ----------------------------------------------------------- data plane
+    def submit(self, method_name: str, args: tuple, kwargs: dict,
+               timeout_s: float = 60.0) -> DeploymentResponse:
+        from .. import api as rt
+
+        self.refresh()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._cond:
+                rid = self._pick_locked()
+                if rid is not None:
+                    self._ongoing[rid] += 1
+                    handle = self._replicas[rid]
+                    break
+                waited = self._cond.wait(timeout=0.05)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {self.deployment_name} accepted the "
+                    f"request within {timeout_s}s")
+            if not waited:
+                self.refresh()
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        with self._cond:
+            self._outstanding[ref] = rid
+        self._waiter_wake.set()
+        return DeploymentResponse(self, rid, ref, (method_name, args, kwargs))
+
+    def _pick_locked(self) -> Optional[str]:
+        rids = [r for r in self._replicas
+                if self._ongoing.get(r, 0) < self._max_ongoing]
+        if not rids:
+            return None
+        if len(rids) <= 2:
+            return min(rids, key=lambda r: self._ongoing[r])
+        a, b = random.sample(rids, 2)
+        return a if self._ongoing[a] <= self._ongoing[b] else b
+
+    def _completion_loop(self):
+        """Decrement in-flight counts as results land (the reference does
+        this with asyncio callbacks on the replica result future)."""
+        from .. import api as rt
+
+        while not self.closed:
+            with self._cond:
+                refs = list(self._outstanding)
+            if not refs:
+                self._waiter_wake.wait(timeout=0.5)
+                self._waiter_wake.clear()
+                continue
+            try:
+                ready, _ = rt.wait(refs, num_returns=len(refs), timeout=0.05,
+                                   fetch_local=False)
+            except Exception:  # noqa: BLE001 - core shut down under us
+                if self.closed:
+                    return
+                time.sleep(0.1)
+                continue
+            if ready:
+                with self._cond:
+                    for ref in ready:
+                        rid = self._outstanding.pop(ref, None)
+                        if rid in self._ongoing:
+                            self._ongoing[rid] = max(
+                                0, self._ongoing[rid] - 1)
+                    self._cond.notify_all()
+
+    # ------------------------------------------------------------- metrics
+    def snapshot(self) -> Dict[str, Any]:
+        with self._cond:
+            return {"replicas": len(self._replicas),
+                    "ongoing": dict(self._ongoing),
+                    "version": self._version}

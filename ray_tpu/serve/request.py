@@ -1,0 +1,71 @@
+"""Picklable HTTP request/response surface handed to ingress deployments.
+
+The reference hands replicas a Starlette ``Request`` over ASGI
+(reference: ``python/ray/serve/_private/http_util.py``); this runtime ships
+a plain picklable snapshot instead, because requests cross a process
+boundary (proxy actor -> replica actor) rather than staying inside one
+asyncio app.
+"""
+from __future__ import annotations
+
+import json as _json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+
+@dataclass
+class Request:
+    method: str = "GET"
+    path: str = "/"
+    query_params: Dict[str, str] = field(default_factory=dict)
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self) -> Any:
+        return _json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return self.body.decode("utf-8", errors="replace")
+
+    @classmethod
+    def from_target(cls, method: str, target: str, headers: Dict[str, str],
+                    body: bytes) -> "Request":
+        parts = urlsplit(target)
+        return cls(method=method, path=parts.path,
+                   query_params=dict(parse_qsl(parts.query)),
+                   headers=headers, body=body)
+
+
+@dataclass
+class Response:
+    """Optional rich response; plain return values are auto-encoded."""
+
+    body: Any = b""
+    status: int = 200
+    content_type: Optional[str] = None
+
+    def encode(self):
+        body, ctype = encode_body(self.body)
+        return self.status, self.content_type or ctype, body
+
+
+def encode_body(value: Any):
+    """Encode a handler return value to (content_type, bytes)."""
+    if isinstance(value, Response):
+        _, ctype, body = value.encode()
+        return ctype, body
+    if isinstance(value, bytes):
+        return "application/octet-stream", value
+    if isinstance(value, str):
+        return "text/plain; charset=utf-8", value.encode()
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        elif isinstance(value, np.generic):
+            value = value.item()
+    except Exception:  # noqa: BLE001
+        pass
+    return "application/json", _json.dumps(value).encode()
