@@ -1,0 +1,152 @@
+"""Core-runtime microbenchmarks vs the reference's release suite.
+
+Counterpart of the reference's ``release/microbenchmark`` numbers recorded
+in BASELINE.md:35-47 (single-node microbenchmark.json). Run:
+
+    python benchmarks/micro_bench.py [--quick]
+
+Prints one JSON line per metric:
+    {"metric": ..., "value": N, "unit": ..., "baseline": N, "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+BASELINES = {
+    # metric -> (baseline value, unit) from BASELINE.md:35-47
+    "actor_calls_sync_1_1": (2005.0, "calls/s"),
+    "actor_calls_async_1_1": (8766.0, "calls/s"),
+    "actor_calls_async_n_n": (27322.0, "calls/s"),
+    "tasks_sync_single_client": (974.0, "tasks/s"),
+    "tasks_async_single_client": (7379.0, "tasks/s"),
+    "get_small_objects": (10501.0, "gets/s"),
+    "put_small_objects": (5286.0, "puts/s"),
+    "wait_1k_refs": (5.16, "waits/s"),
+    "pg_create_remove": (788.1, "pairs/s"),
+}
+
+
+def report(metric: str, value: float):
+    base, unit = BASELINES[metric]
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, "baseline": base,
+                      "vs_baseline": round(value / base, 3)}), flush=True)
+
+
+def bench_actor_calls(rt, n_async: int, n_sync: int):
+    @rt.remote
+    class Echo:
+        def ping(self, x=None):
+            return x
+
+    a = Echo.remote()
+    rt.get(a.ping.remote())  # warm
+
+    t0 = time.perf_counter()
+    for _ in range(n_sync):
+        rt.get(a.ping.remote())
+    report("actor_calls_sync_1_1", n_sync / (time.perf_counter() - t0))
+
+    t0 = time.perf_counter()
+    rt.get([a.ping.remote() for _ in range(n_async)])
+    report("actor_calls_async_1_1", n_async / (time.perf_counter() - t0))
+
+    actors = [Echo.options(max_concurrency=4).remote() for _ in range(4)]
+    rt.get([b.ping.remote() for b in actors])
+    t0 = time.perf_counter()
+    rt.get([b.ping.remote() for b in actors for _ in range(n_async // 4)])
+    report("actor_calls_async_n_n",
+           (n_async // 4 * 4) / (time.perf_counter() - t0))
+
+
+def bench_tasks(rt, n_async: int, n_sync: int):
+    @rt.remote
+    def nop(x=None):
+        return x
+
+    rt.get(nop.remote())  # warm the lease
+
+    t0 = time.perf_counter()
+    for _ in range(n_sync):
+        rt.get(nop.remote())
+    report("tasks_sync_single_client", n_sync / (time.perf_counter() - t0))
+
+    t0 = time.perf_counter()
+    rt.get([nop.remote() for _ in range(n_async)])
+    report("tasks_async_single_client",
+           n_async / (time.perf_counter() - t0))
+
+
+def bench_objects(rt, n: int):
+    value = b"x" * 1024
+    t0 = time.perf_counter()
+    refs = [rt.put(value) for _ in range(n)]
+    report("put_small_objects", n / (time.perf_counter() - t0))
+
+    t0 = time.perf_counter()
+    for r in refs:
+        rt.get(r)
+    report("get_small_objects", n / (time.perf_counter() - t0))
+    del refs
+    gc.collect()
+
+
+def bench_wait(rt, rounds: int):
+    """ray.wait over 1k refs, half already completed (the reference
+    benchmark shape: scan a large in-flight set repeatedly)."""
+
+    @rt.remote
+    def quick(i):
+        return i
+
+    @rt.remote
+    def slow():
+        time.sleep(30)
+
+    refs = [quick.remote(i) for i in range(500)]
+    refs += [slow.remote() for _ in range(4)]  # keep some never-ready
+    rt.wait(refs, num_returns=500, timeout=30)  # settle
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        ready, _ = rt.wait(refs, num_returns=len(refs), timeout=0.01)
+    report("wait_1k_refs", rounds / (time.perf_counter() - t0))
+
+
+def bench_pgs(rt, n: int):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = rt.placement_group([{"CPU": 1}])
+        pg.ready(timeout=30)
+        rt.remove_placement_group(pg)
+    report("pg_create_remove", n / (time.perf_counter() - t0))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="10x fewer iterations")
+    args = parser.parse_args()
+    scale = 10 if args.quick else 1
+
+    import ray_tpu as rt
+
+    rt.init(num_cpus=8, num_tpus=0, ignore_reinit_error=True)
+    bench_tasks(rt, n_async=5000 // scale, n_sync=1000 // scale)
+    bench_actor_calls(rt, n_async=5000 // scale, n_sync=2000 // scale)
+    bench_objects(rt, n=5000 // scale)
+    bench_wait(rt, rounds=50 // scale)
+    bench_pgs(rt, n=100 // scale)
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -17,22 +17,25 @@ from .api import (  # noqa: F401
     PlacementGroupSchedulingStrategy,
     available_resources,
     cluster_resources,
+    dashboard_url,
     get,
     get_actor,
     init,
     is_initialized,
     kill,
     list_actors,
+    metrics_text,
     nodes,
     placement_group,
     put,
     remote,
     remove_placement_group,
     shutdown,
+    state,
     timeline,
     wait,
 )
-from .core.worker import ObjectRef  # noqa: F401
+from .core.worker import ObjectRef, ObjectRefGenerator  # noqa: F401
 from . import exceptions  # noqa: F401
 
 __version__ = "0.1.0"

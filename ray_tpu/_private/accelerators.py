@@ -1,0 +1,94 @@
+"""TPU detection and gang-scheduling resources.
+
+Capability parity with the reference's TPU accelerator manager
+(reference: ``python/ray/_private/accelerators/tpu.py:75``
+TPUAcceleratorManager; ``:363`` documents the ``TPU-v4-16-head`` gang
+pattern): every node advertises its chip count as ``TPU``, and worker 0 of
+a slice additionally advertises ``TPU-{pod_type}-head: 1`` so a gang can
+anchor itself to exactly one slice and fan out over its hosts.
+
+Zero-egress redesign: the reference polls GCE instance metadata over HTTP;
+here detection is purely env-var + device-file based (the same variables
+the TPU runtime/GKE injects), with ``RT_TPU_TOPOLOGY`` as an explicit
+override for tests and air-gapped machines.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+# Long-form GCE accelerator types → short version names.
+_VERSION_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v6litepod": "v6e",
+    "v6lite": "v6e",
+}
+
+# Chips per host per TPU generation (v5e pods come in 4- and 8-chip host
+# shapes; override with RT_TPU_CHIPS_PER_HOST when needed).
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 4, "v5p": 4, "v6e": 4}
+
+
+def normalize_pod_type(raw: str) -> str:
+    """'v5litepod-16' → 'v5e-16'; already-short names pass through."""
+    version, _, chips = raw.partition("-")
+    version = _VERSION_ALIASES.get(version, version)
+    return f"{version}-{chips}" if chips else version
+
+
+def parse_topology(topology: str) -> Tuple[str, int]:
+    """'v5e-16' → ('v5e', 16). Raises ValueError on malformed input."""
+    topology = normalize_pod_type(topology)
+    version, _, chips = topology.partition("-")
+    if not chips or not chips.isdigit():
+        raise ValueError(
+            f"malformed TPU topology {topology!r}; expected "
+            "'<version>-<chips>' like 'v5e-16'")
+    return version, int(chips)
+
+
+def chips_per_host(version: str) -> int:
+    env = os.environ.get("RT_TPU_CHIPS_PER_HOST")
+    if env:
+        return int(env)
+    return _CHIPS_PER_HOST.get(version, 4)
+
+
+def num_hosts(topology: str) -> int:
+    version, chips = parse_topology(topology)
+    per = chips_per_host(version)
+    return max(1, chips // per)
+
+
+def detect_pod_type() -> Optional[str]:
+    """The slice this host belongs to, e.g. 'v5e-16' (None off-TPU)."""
+    raw = (os.environ.get("RT_TPU_TOPOLOGY")
+           or os.environ.get("TPU_ACCELERATOR_TYPE"))
+    return normalize_pod_type(raw) if raw else None
+
+
+def detect_worker_id() -> int:
+    """This host's index within its slice (0 on single-host)."""
+    return int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+
+
+def head_resource_name(pod_type: str) -> str:
+    return f"TPU-{normalize_pod_type(pod_type)}-head"
+
+
+def gang_resources(num_chips: float) -> Dict[str, float]:
+    """Extra node resources advertised alongside ``TPU: num_chips``.
+
+    Worker 0 of a slice gets the ``TPU-{pod}-head`` anchor; every worker
+    gets the ``accelerator_type:TPU-{VERSION}`` label-style resource.
+    """
+    pod = detect_pod_type()
+    if not pod or not num_chips:
+        return {}
+    version, _ = parse_topology(pod)
+    res: Dict[str, float] = {
+        f"accelerator_type:TPU-{version.upper()}": float(num_chips)}
+    if detect_worker_id() == 0:
+        res[head_resource_name(pod)] = 1.0
+    return res

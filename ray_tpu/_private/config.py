@@ -39,6 +39,15 @@ _flag("object_store_memory", int, 2 * 1024**3,
       "Bytes of shared memory reserved for the node object store.")
 _flag("worker_lease_timeout_s", float, 30.0,
       "How long a task waits for a worker lease before erroring.")
+_flag("lease_idle_ttl_s", float, 2.0,
+      "Idle leased workers return to the shared pool after this long.")
+_flag("dashboard_port", int, 0,
+      "Dashboard HTTP port (0 = ephemeral, -1 = disabled).")
+_flag("actor_gc_grace_s", float, 1.0,
+      "Delay before killing an actor whose handle count hit zero.")
+_flag("borrow_release_grace_s", float, 2.0,
+      "Delay before a finished submission's arg borrows are released "
+      "(covers in-flight borrower ref_incs on other connections).")
 _flag("task_max_retries", int, 3, "Default retry count for failed tasks.")
 _flag("actor_max_restarts", int, 0, "Default actor restart count.")
 _flag("num_workers_soft_limit", int, 0,

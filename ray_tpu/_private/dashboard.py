@@ -1,0 +1,108 @@
+"""Dashboard-lite: the head's HTTP observability endpoint.
+
+Capability parity with the reference's dashboard head + metrics exporter
+(reference: ``python/ray/dashboard/head.py:81`` aiohttp app;
+``src/ray/stats`` prometheus exporter), collapsed into one dependency-free
+asyncio HTTP server on the head:
+
+- ``GET /metrics``        → prometheus text (cluster-merged)
+- ``GET /api/state?kind=``→ JSON state listing (nodes/workers/actors/…)
+- ``GET /api/timeline``   → chrome://tracing JSON events
+- ``GET /``               → tiny HTML index linking the above
+
+No aiohttp in the image, so requests are parsed by hand (GET only).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class DashboardServer:
+    def __init__(self, state_fn: Callable[[str], object],
+                 metrics_fn: Callable[[], str],
+                 timeline_fn: Callable[[], list],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._state_fn = state_fn
+        self._metrics_fn = metrics_fn
+        self._timeline_fn = timeline_fn
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._serve, host=self._host, port=self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter):
+        try:
+            request = await asyncio.wait_for(reader.readline(), 10)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.decode("latin1").split()
+            if len(parts) < 2 or parts[0] != "GET":
+                await self._respond(writer, 405, "text/plain",
+                                    b"GET only")
+                return
+            url = urlparse(parts[1])
+            q = {k: v[0] for k, v in parse_qs(url.query).items()}
+            if url.path == "/metrics":
+                body = self._metrics_fn().encode()
+                await self._respond(
+                    writer, 200, "text/plain; version=0.0.4", body)
+            elif url.path == "/api/state":
+                data = self._state_fn(q.get("kind", "summary"))
+                await self._respond(writer, 200, "application/json",
+                                    json.dumps(data).encode())
+            elif url.path == "/api/timeline":
+                await self._respond(
+                    writer, 200, "application/json",
+                    json.dumps(self._timeline_fn()).encode())
+            elif url.path == "/":
+                body = (b"<html><body><h3>ray_tpu dashboard</h3><ul>"
+                        b'<li><a href="/metrics">/metrics</a></li>'
+                        b'<li><a href="/api/state?kind=summary">'
+                        b"/api/state</a></li>"
+                        b'<li><a href="/api/timeline">/api/timeline</a>'
+                        b"</li></ul></body></html>")
+                await self._respond(writer, 200, "text/html", body)
+            else:
+                await self._respond(writer, 404, "text/plain",
+                                    b"not found")
+        except Exception:  # noqa: BLE001 - a bad client mustn't kill the head
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    @staticmethod
+    async def _respond(writer, code: int, ctype: str, body: bytes):
+        reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}
+        head = (f"HTTP/1.1 {code} {reason.get(code, '?')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n").encode()
+        writer.write(head + body)
+        await writer.drain()

@@ -25,6 +25,7 @@ Responsibilities:
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import socket
 import subprocess
@@ -89,6 +90,12 @@ class ActorInfo:
     death_cause: str = ""
     registered_at: float = 0.0
     creation_started: bool = False
+    # Handle GC (reference: GCS kills actors when all handles go out of
+    # scope). Detached actors — explicit lifetime="detached" or named —
+    # opt out; handle_refs aggregates per-process inc/dec pushes.
+    detached: bool = False
+    handle_refs: int = 0
+    pending_gc: Any = None  # asyncio task for the grace-period kill
 
 
 @dataclass
@@ -142,6 +149,10 @@ class HeadService:
         self._spawn_env = spawn_env_with_pkg_root()
         self.task_events: deque = deque(maxlen=100_000)
         self._shutting_down = False
+        # Observability: per-process metric snapshots (worker_id → snap)
+        # merged on demand; dashboard server started in start().
+        self.metrics_snapshots: Dict[str, dict] = {}
+        self.dashboard = None
 
     # ------------------------------------------------------------- lifecycle
     async def start(self):
@@ -155,6 +166,23 @@ class HeadService:
         self._tcp_server = rpc.RpcServer(self._handle, host="0.0.0.0")
         await self._tcp_server.start()
         self._reaper_task = self._loop.create_task(self._reap_loop())
+        if getattr(self.config, "dashboard_port", 0) >= 0:
+            from .dashboard import DashboardServer
+
+            self.dashboard = DashboardServer(
+                self.state_listing, self.metrics_text, self.chrome_trace,
+                port=getattr(self.config, "dashboard_port", 0))
+            await self.dashboard.start()
+        # Discovery file for the CLI (`python -m ray_tpu status`).
+        with open(os.path.join(self.session_dir, "session.json"), "w") as f:
+            json.dump({
+                "head_sock": self.sock_path,
+                "tcp_address": list(self.tcp_address),
+                "dashboard_url": self.dashboard.url if self.dashboard
+                else None,
+                "pid": os.getpid(),
+                "started_at": time.time(),
+            }, f)
         return self
 
     @property
@@ -163,6 +191,8 @@ class HeadService:
 
     async def stop(self):
         self._shutting_down = True
+        if self.dashboard is not None:
+            await self.dashboard.stop()
         if self._reaper_task:
             self._reaper_task.cancel()
         for w in list(self.workers.values()):
@@ -285,6 +315,7 @@ class HeadService:
     async def _on_worker_death(self, w: WorkerInfo, cause: str,
                                node_dead: bool = False):
         self.workers.pop(w.worker_id, None)
+        self.metrics_snapshots.pop(w.worker_id.hex(), None)
         node = self.nodes.get(w.node)
         if node is not None:
             try:
@@ -495,6 +526,7 @@ class HeadService:
             except Exception:
                 pass
         self.workers.pop(w.worker_id, None)
+        self.metrics_snapshots.pop(w.worker_id.hex(), None)
 
     # ------------------------------------------------------------- leases
     def _find_grant(self, req: Dict[str, float], pg_meta, strategy
@@ -542,6 +574,9 @@ class HeadService:
             raise
         w.assignment = "lease"
         w.charge = charge
+        from .metrics import core_metrics
+
+        core_metrics()["leases_granted"].inc()
         return {"worker_id": w.worker_id.hex(), "address": w.address}
 
     def _pump_leases(self):
@@ -731,6 +766,7 @@ class HeadService:
             creation_spec_meta=payload["spec_meta"],
             strategy=payload.get("strategy") or {},
             registered_at=time.time(),
+            detached=bool(name) or payload.get("lifetime") == "detached",
         )
         self.actors[actor_id] = actor
         if name:
@@ -807,15 +843,46 @@ class HeadService:
         a = self.actors.get(actor_id)
         if a is None or a.state == "DEAD":
             return {}
-        a.max_restarts = 0 if payload.get("no_restart", True) else a.max_restarts
+        self._kill_actor_now(a, "killed via kill_actor",
+                             no_restart=payload.get("no_restart", True))
+        return {}
+
+    def _kill_actor_now(self, a: ActorInfo, cause: str,
+                        no_restart: bool = True):
+        a.max_restarts = 0 if no_restart else a.max_restarts
         w = a.worker
-        self._mark_actor_dead(a, "killed via kill_actor")
+        self._mark_actor_dead(a, cause)
         if w is not None:
             self._release_charged(w.charge)
             w.charge = None
             self._kill_worker(w)
         self._pump_leases()
+
+    async def _rpc_actor_handle_change(self, payload, bufs):
+        """Per-process handle counts: +1 when a process gains its first
+        handle to an actor, -1 when it loses its last. On zero the actor
+        is garbage-collected after a short grace period (an in-flight
+        handle transfer sends its inc within the window). Detached/named
+        actors opt out (reference: gcs_actor_manager.cc handle-out-of-
+        scope death, simplified to head-aggregated counting)."""
+        a = self.actors.get(ActorID.from_hex(payload["actor_id"]))
+        if a is None or a.state == "DEAD":
+            return {}
+        a.handle_refs += payload["delta"]
+        if a.handle_refs > 0 and a.pending_gc is not None:
+            a.pending_gc.cancel()
+            a.pending_gc = None
+        if (a.handle_refs <= 0 and payload["delta"] < 0
+                and not a.detached and a.pending_gc is None):
+            a.pending_gc = self._loop.create_task(self._actor_gc_after(a))
         return {}
+
+    async def _actor_gc_after(self, a: ActorInfo):
+        await asyncio.sleep(
+            getattr(self.config, "actor_gc_grace_s", 1.0))
+        a.pending_gc = None
+        if a.state != "DEAD" and a.handle_refs <= 0 and not a.detached:
+            self._kill_actor_now(a, "all actor handles went out of scope")
 
     # ------------------------------------------------------------- KV
     async def _rpc_kv_put(self, payload, bufs):
@@ -1000,6 +1067,106 @@ class HeadService:
     async def _rpc_get_task_events(self, payload, bufs):
         limit = payload.get("limit", 10000)
         return list(self.task_events)[-limit:]
+
+    # -------------------------------------------------------- observability
+    async def _rpc_report_metrics(self, payload, bufs):
+        """Workers/drivers push their metric registry snapshots.
+
+        A driver in the head's own process shares the head's
+        process-global registry, which metrics_text merges directly —
+        storing its snapshot too would double-count every counter."""
+        if payload.get("pid") == os.getpid():
+            return {}
+        self.metrics_snapshots[payload["component"]] = payload["snapshot"]
+        return {}
+
+    async def _rpc_metrics_text(self, payload, bufs):
+        return {"text": self.metrics_text()}
+
+    async def _rpc_state(self, payload, bufs):
+        return self.state_listing(payload.get("kind", "summary"))
+
+    async def _rpc_dashboard_url(self, payload, bufs):
+        return {"url": self.dashboard.url if self.dashboard else None}
+
+    async def _rpc_chrome_trace(self, payload, bufs):
+        return self.chrome_trace()
+
+    def metrics_text(self) -> str:
+        """Cluster-merged prometheus exposition."""
+        from . import metrics as m
+
+        core = m.core_metrics()
+        core["actors_alive"].set(
+            sum(1 for a in self.actors.values() if a.state == "ALIVE"))
+        core["workers_alive"].set(len(self.workers))
+        snaps = [m.global_registry().snapshot()]
+        snaps.extend(self.metrics_snapshots.values())
+        return m.render_prometheus(m.merge_snapshots(snaps))
+
+    def state_listing(self, kind: str):
+        """State API listings (reference: ``util/state/api.py`` list_*)."""
+        if kind == "nodes":
+            return [{"node_id": n.node_id, "hostname": n.hostname,
+                     "is_head": n.is_head, "state": n.state,
+                     "total": dict(n.total), "available": dict(n.available)}
+                    for n in self.nodes.values()]
+        if kind == "workers":
+            return [{"worker_id": w.worker_id.hex(), "pid": w.pid,
+                     "node_id": w.node, "assignment": str(w.assignment)}
+                    for w in self.workers.values()]
+        if kind == "actors":
+            return [{"actor_id": a.actor_id.hex(), "name": a.name,
+                     "state": a.state, "resources": dict(a.resources),
+                     "death_cause": a.death_cause}
+                    for a in self.actors.values()]
+        if kind == "placement_groups":
+            return [{"pg_id": pg.pg_id.hex(), "state": pg.state,
+                     "strategy": pg.strategy,
+                     "bundles": [dict(b.resources) for b in pg.bundles],
+                     "bundle_nodes": list(pg.bundle_nodes)}
+                    for pg in self.pgs.values()]
+        if kind == "tasks":
+            return list(self.task_events)[-1000:]
+        if kind == "objects":
+            return {"snapshots": {
+                k: {n: d for n, d in snap.items()
+                    if n.startswith("object_store")}
+                for k, snap in self.metrics_snapshots.items()}}
+        if kind == "summary":
+            return {
+                "nodes": len(self.nodes),
+                "workers": len(self.workers),
+                "actors_alive": sum(1 for a in self.actors.values()
+                                    if a.state == "ALIVE"),
+                "placement_groups": len(self.pgs),
+                "task_events": len(self.task_events),
+                "resources_total": dict(self._cluster_totals()),
+                "resources_available": self._available_summary(),
+            }
+        raise rpc.RpcError(f"unknown state kind {kind!r}")
+
+    def _cluster_totals(self) -> Dict[str, float]:
+        total: Dict[str, float] = defaultdict(float)
+        for n in self._alive_nodes():
+            for k, v in n.total.items():
+                total[k] += v
+        return total
+
+    def chrome_trace(self) -> list:
+        """Task events → chrome://tracing 'X' events (reference:
+        ``timeline()`` chrome-trace export in the dashboard)."""
+        out = []
+        for ev in list(self.task_events):
+            out.append({
+                "name": ev.get("name") or ev.get("task_id", "")[:8],
+                "cat": "task", "ph": "X",
+                "ts": int(ev["start"] * 1e6),
+                "dur": int((ev["end"] - ev["start"]) * 1e6),
+                "pid": "ray_tpu",
+                "tid": ev.get("worker_id", "?")[:12],
+            })
+        return out
 
     async def _rpc_ping(self, payload, bufs):
         return {"ok": True, "time": time.time()}

@@ -1,0 +1,229 @@
+"""Metrics plane: typed instruments + Prometheus text exposition.
+
+Capability parity with the reference's stats pipeline (reference:
+``src/ray/stats/metric.h:103`` Count/Gauge/Histogram/Sum over
+opencensus → prometheus exporter on each node), re-designed for this
+runtime: a process-local registry of lock-protected instruments; every
+worker ships snapshots to the head with its task events, and the head
+merges them per-component and serves the classic ``/metrics`` text format
+(dashboard-lite, ``head.py``).
+
+Conventions follow prometheus: ``_total`` suffix on counters, seconds for
+durations, labels as a frozen kv tuple.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+LabelPairs = Tuple[Tuple[str, str], ...]
+
+
+def _labels(kv: Optional[Dict[str, str]]) -> LabelPairs:
+    return tuple(sorted((kv or {}).items()))
+
+
+class _Instrument:
+    kind = ""
+
+    def __init__(self, name: str, description: str = "",
+                 registry: "MetricsRegistry" = None):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        (registry or global_registry()).register(self)
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry)
+        self._values: Dict[LabelPairs, float] = {}
+
+    def inc(self, value: float = 1.0, labels: Optional[Dict] = None):
+        key = _labels(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def collect(self) -> List[Tuple[LabelPairs, float]]:
+        with self._lock:
+            return list(self._values.items())
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry)
+        self._values: Dict[LabelPairs, float] = {}
+
+    def set(self, value: float, labels: Optional[Dict] = None):
+        with self._lock:
+            self._values[_labels(labels)] = float(value)
+
+    def collect(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+    DEFAULT_BOUNDS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+    def __init__(self, name, description="", bounds: Iterable[float] = (),
+                 registry=None):
+        super().__init__(name, description, registry)
+        self.bounds = tuple(bounds) or self.DEFAULT_BOUNDS
+        # labels -> [bucket counts..., +inf count, sum, n]
+        self._values: Dict[LabelPairs, list] = {}
+
+    def observe(self, value: float, labels: Optional[Dict] = None):
+        key = _labels(labels)
+        with self._lock:
+            ent = self._values.get(key)
+            if ent is None:
+                ent = [0] * (len(self.bounds) + 1) + [0.0, 0]
+                self._values[key] = ent
+            for i, b in enumerate(self.bounds):
+                if value <= b:
+                    ent[i] += 1
+                    break
+            else:
+                ent[len(self.bounds)] += 1
+            ent[-2] += value
+            ent[-1] += 1
+
+    def collect(self):
+        with self._lock:
+            return [(k, list(v)) for k, v in self._values.items()]
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def register(self, inst: _Instrument):
+        with self._lock:
+            existing = self._instruments.get(inst.name)
+            if existing is not None and existing.kind != inst.kind:
+                raise ValueError(
+                    f"metric {inst.name!r} already registered as "
+                    f"{existing.kind}")
+            self._instruments[inst.name] = inst
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._instruments.get(name)
+
+    def snapshot(self) -> dict:
+        """Wire-format snapshot: shipped from workers to the head."""
+        out = {}
+        with self._lock:
+            instruments = list(self._instruments.values())
+        for inst in instruments:
+            out[inst.name] = {
+                "kind": inst.kind, "description": inst.description,
+                "bounds": list(getattr(inst, "bounds", ())),
+                "values": [(list(k), v) for k, v in inst.collect()],
+            }
+        return out
+
+
+_global: Optional[MetricsRegistry] = None
+_global_lock = threading.Lock()
+
+
+def global_registry() -> MetricsRegistry:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = MetricsRegistry()
+        return _global
+
+
+def merge_snapshots(snaps: List[dict]) -> dict:
+    """Head-side merge of per-process snapshots (sum counters/histograms,
+    last-writer-wins gauges)."""
+    merged: dict = {}
+    for snap in snaps:
+        for name, data in snap.items():
+            ent = merged.setdefault(name, {
+                "kind": data["kind"], "description": data["description"],
+                "bounds": data.get("bounds", []), "values": {}})
+            for key_list, v in data["values"]:
+                key = tuple(tuple(p) for p in key_list)
+                if data["kind"] == "counter":
+                    ent["values"][key] = ent["values"].get(key, 0.0) + v
+                elif data["kind"] == "gauge":
+                    ent["values"][key] = v
+                else:  # histogram: element-wise sum
+                    cur = ent["values"].get(key)
+                    ent["values"][key] = (
+                        [a + b for a, b in zip(cur, v)] if cur else list(v))
+    return merged
+
+
+def render_prometheus(merged: dict, prefix: str = "ray_tpu") -> str:
+    """Merged snapshot → prometheus text exposition format."""
+    lines: List[str] = []
+
+    def fmt_labels(key: LabelPairs, extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in key]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    for name in sorted(merged):
+        ent = merged[name]
+        full = f"{prefix}_{name}"
+        if ent["description"]:
+            lines.append(f"# HELP {full} {ent['description']}")
+        lines.append(f"# TYPE {full} {ent['kind']}")
+        for key, v in sorted(ent["values"].items()):
+            if ent["kind"] in ("counter", "gauge"):
+                lines.append(f"{full}{fmt_labels(key)} {v}")
+            else:
+                bounds = ent["bounds"]
+                cum = 0
+                for i, b in enumerate(bounds):
+                    cum += v[i]
+                    lines.append(
+                        f"{full}_bucket"
+                        f"{fmt_labels(key, f'le=\"{b}\"')} {cum}")
+                cum += v[len(bounds)]
+                lines.append(
+                    f"{full}_bucket{fmt_labels(key, 'le=\"+Inf\"')} {cum}")
+                lines.append(f"{full}_sum{fmt_labels(key)} {v[-2]}")
+                lines.append(f"{full}_count{fmt_labels(key)} {v[-1]}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- core set
+# Instantiated lazily so importing this module stays cheap.
+_core: dict = {}
+
+
+def core_metrics() -> dict:
+    if not _core:
+        _core.update(
+            tasks_finished=Counter(
+                "tasks_finished_total", "Tasks executed on this worker"),
+            task_duration=Histogram(
+                "task_duration_seconds", "Task execution wall time"),
+            objects_stored=Gauge(
+                "object_store_objects", "Objects in the memory store"),
+            shm_bytes=Gauge(
+                "object_store_shm_bytes", "Bytes in shared-memory store"),
+            actors_alive=Gauge("actors_alive", "Live actors (head view)"),
+            workers_alive=Gauge("workers_alive", "Live workers (head view)"),
+            leases_granted=Counter(
+                "leases_granted_total", "Worker leases granted by the head"),
+        )
+    return _core
+
+
+def now() -> float:
+    return time.time()

@@ -49,19 +49,50 @@ def _open_shm(name: str, create: bool = False, size: int = 0):
 
 
 class MemoryStore:
-    """In-process object store with blocking waiters (thread-safe)."""
+    """In-process object store with blocking waiters (thread-safe).
+
+    Two wake-up mechanisms: a per-object event for ``get`` blockers, and
+    registered multi-object watcher events so ``wait()`` over N refs parks
+    on ONE event instead of polling (reference: event-driven
+    ``CoreWorker::Wait``, ``core_worker.cc:1735``).
+    """
 
     def __init__(self):
         self._objects: Dict[ObjectID, List[bytes]] = {}
-        self._lock = threading.Lock()
+        # RLock: ObjectRef.__del__ (cyclic GC) can re-enter delete() while
+        # this thread is inside put()/get() — a plain Lock would self-deadlock.
+        self._lock = threading.RLock()
         self._events: Dict[ObjectID, threading.Event] = {}
+        self._watchers: Dict[ObjectID, List[threading.Event]] = {}
 
     def put(self, object_id: ObjectID, frames: List[bytes]) -> None:
         with self._lock:
             self._objects[object_id] = frames
             ev = self._events.pop(object_id, None)
+            watchers = self._watchers.pop(object_id, ())
         if ev:
             ev.set()
+        for w in watchers:
+            w.set()
+
+    def add_watcher(self, object_id: ObjectID, ev: threading.Event) -> None:
+        """Fire ``ev`` when the object arrives (immediately if present)."""
+        with self._lock:
+            if object_id in self._objects:
+                ev.set()
+                return
+            self._watchers.setdefault(object_id, []).append(ev)
+
+    def remove_watcher(self, object_id: ObjectID, ev: threading.Event) -> None:
+        with self._lock:
+            ws = self._watchers.get(object_id)
+            if ws is not None:
+                try:
+                    ws.remove(ev)
+                except ValueError:
+                    pass
+                if not ws:
+                    self._watchers.pop(object_id, None)
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -96,7 +127,8 @@ class SharedMemoryStore:
     def __init__(self, capacity_bytes: int, spill_dir: str = ""):
         self._capacity = capacity_bytes
         self._used = 0
-        self._lock = threading.Lock()
+        # RLock: see MemoryStore — the GC free path may re-enter delete().
+        self._lock = threading.RLock()
         # object_id -> (shm handle or None, nbytes, spilled_path or None)
         self._owned: "OrderedDict[ObjectID, tuple]" = OrderedDict()
         self._attached: Dict[ObjectID, shared_memory.SharedMemory] = {}

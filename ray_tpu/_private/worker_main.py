@@ -15,6 +15,12 @@ import time
 
 
 def main():
+    import faulthandler
+
+    # `kill -USR1 <worker pid>` dumps thread stacks to the worker log —
+    # the debugging hook for distributed hangs.
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--worker-id", required=True)

@@ -100,6 +100,12 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
                 total.setdefault("TPU", float(_detect_tpu_chips()))
             total.setdefault("memory", float(
                 os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")))
+            # Slice gang resources: TPU-{pod}-head anchor + accelerator
+            # label (reference: accelerators/tpu.py:363).
+            from ._private.accelerators import gang_resources
+
+            for k, v in gang_resources(total.get("TPU", 0.0)).items():
+                total.setdefault(k, v)
             head_thread = _HeadThread(session_dir, config, total).start()
             head_sock = head_thread.head.sock_path
             _global_state["head_thread"] = head_thread
@@ -261,6 +267,8 @@ class RemoteFunction:
             strategy=_strategy_from_options(self._options),
             name=self._options.get("name") or self._fn.__name__,
         )
+        if num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if num_returns == 1 else refs
 
 
@@ -278,12 +286,30 @@ class ActorMethod:
         refs = core.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns)
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID):
         self._actor_id = actor_id
+        # Handle GC: per-process 0↔1 transitions reach the head, which
+        # kills non-detached actors when every process's count is zero
+        # (reference: handle-out-of-scope actor death). CoreWorker._current
+        # (not _global_state) so handles held inside worker processes —
+        # e.g. a controller actor owning replica handles — count too.
+        core = CoreWorker._current
+        if core is not None and not core._shutdown:
+            core.on_actor_handle_created(actor_id)
+
+    def __del__(self):
+        core = CoreWorker._current
+        if core is not None and not core._shutdown:
+            try:
+                core.on_actor_handle_deleted(self._actor_id)
+            except Exception:  # noqa: BLE001 - never raise from __del__
+                pass
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -361,10 +387,34 @@ def list_actors() -> List[dict]:
     return _core().head_call("list_actors")
 
 
-def timeline() -> List[dict]:
-    """Task timeline events (chrome://tracing-style records)."""
+def timeline(format: str = "raw") -> List[dict]:
+    """Task timeline. ``format="chrome"`` returns chrome://tracing 'X'
+    events (one mapping, shared with the dashboard's /api/timeline)."""
     _core().flush_task_events()
-    return _core().head_call("get_task_events", {"limit": 100000})
+    if format == "raw":
+        return _core().head_call("get_task_events", {"limit": 100000})
+    if format != "chrome":
+        raise ValueError(f"unknown timeline format {format!r}")
+    return _core().head_call("chrome_trace")
+
+
+def metrics_text() -> str:
+    """Cluster-merged prometheus text exposition (also at the dashboard's
+    ``/metrics`` endpoint)."""
+    _core().flush_metrics()
+    return _core().head_call("metrics_text")["text"]
+
+
+def dashboard_url() -> Optional[str]:
+    """URL of the head's observability HTTP endpoint."""
+    return _core().head_call("dashboard_url")["url"]
+
+
+def state(kind: str = "summary"):
+    """State API listing: summary|nodes|workers|actors|placement_groups|
+    tasks|objects (reference: ``ray.util.state`` list_* API)."""
+    _core().flush_task_events()
+    return _core().head_call("state", {"kind": kind})
 
 
 # --------------------------------------------------------------- placement

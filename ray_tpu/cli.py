@@ -1,0 +1,99 @@
+"""``python -m ray_tpu`` — cluster status/state/metrics CLI.
+
+Capability parity with the reference's ``ray status`` / ``ray list``
+CLI (reference: ``python/ray/scripts/scripts.py``,
+``util/state/state_cli.py``), attaching to a running head via the
+``session.json`` discovery file each head writes at startup.
+
+Commands:
+    python -m ray_tpu status                  # cluster summary
+    python -m ray_tpu list nodes|workers|actors|placement_groups|tasks
+    python -m ray_tpu metrics                 # prometheus text
+    python -m ray_tpu timeline out.json       # chrome-trace export
+    python -m ray_tpu dashboard               # print dashboard URL
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _find_session(session_dir: str = "") -> dict:
+    if session_dir:
+        candidates = [os.path.join(session_dir, "session.json")]
+    else:
+        root = os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu")
+        candidates = sorted(
+            glob.glob(os.path.join(root, "*", "session.json")),
+            key=os.path.getmtime, reverse=True)
+    for path in candidates:
+        try:
+            with open(path) as f:
+                info = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        # Stale session? The head's pid must still be alive.
+        try:
+            os.kill(info["pid"], 0)
+        except (OSError, KeyError):
+            continue
+        info["session_dir"] = os.path.dirname(path)
+        return info
+    raise SystemExit(
+        "no live ray_tpu session found (is a driver running?); "
+        "pass --session-dir explicitly")
+
+
+def _connect(info: dict):
+    import ray_tpu as rt
+
+    rt.init(address=info["head_sock"])
+    return rt
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    parser.add_argument("--session-dir", default="",
+                        help="session directory (default: newest live)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    p_list = sub.add_parser("list")
+    p_list.add_argument("kind", choices=[
+        "nodes", "workers", "actors", "placement_groups", "tasks"])
+    sub.add_parser("metrics")
+    p_tl = sub.add_parser("timeline")
+    p_tl.add_argument("output", nargs="?", default="timeline.json")
+    sub.add_parser("dashboard")
+    args = parser.parse_args(argv)
+
+    info = _find_session(args.session_dir)
+    rt = _connect(info)
+    try:
+        if args.cmd == "status":
+            summary = rt.state("summary")
+            print(f"session: {info['session_dir']}")
+            if info.get("dashboard_url"):
+                print(f"dashboard: {info['dashboard_url']}")
+            for k, v in summary.items():
+                print(f"  {k}: {v}")
+        elif args.cmd == "list":
+            print(json.dumps(rt.state(args.kind), indent=1, default=str))
+        elif args.cmd == "metrics":
+            print(rt.metrics_text(), end="")
+        elif args.cmd == "timeline":
+            events = rt.timeline(format="chrome")
+            with open(args.output, "w") as f:
+                json.dump(events, f)
+            print(f"wrote {len(events)} events to {args.output}")
+        elif args.cmd == "dashboard":
+            print(rt.dashboard_url() or "dashboard disabled")
+    finally:
+        rt.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

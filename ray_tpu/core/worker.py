@@ -50,13 +50,34 @@ class ObjectRef:
 
     Owner-based like the reference (``reference_count.h:61``): the ref itself
     carries the owner's serving address, so any holder can resolve it.
+    Creation/destruction feed the process-local reference counter so the
+    owner can free the backing store when the last holder (local or
+    borrower) drops the ref.
     """
 
-    __slots__ = ("object_id", "owner_address", "_weak_core")
+    __slots__ = ("object_id", "owner_address", "_weak_core", "_counted")
 
-    def __init__(self, object_id: ObjectID, owner_address: Any):
+    def __init__(self, object_id: ObjectID, owner_address: Any,
+                 _counted: bool = True):
         self.object_id = object_id
         self.owner_address = owner_address
+        # _counted=False refs (task-arg refs materialized by the executing
+        # worker) are covered by the submitting driver's per-task borrow
+        # and must not touch the reference counter.
+        self._counted = _counted
+        core = CoreWorker._current
+        if _counted and core is not None and not core._shutdown:
+            core.refs.on_created(self)
+
+    def __del__(self):
+        if not getattr(self, "_counted", False):
+            return
+        core = CoreWorker._current
+        if core is not None and not core._shutdown:
+            try:
+                core.refs.on_deleted(self)
+            except Exception:  # noqa: BLE001 - never raise from __del__
+                pass
 
     def binary(self) -> bytes:
         return self.object_id.binary()
@@ -83,6 +104,255 @@ class ObjectRef:
             asyncio.run_coroutine_threadsafe(
                 core._async_get_one(self), core._loop))
         return fut.__await__()
+
+
+class ReferenceCounter:
+    """Distributed reference counting for owned objects.
+
+    Capability parity with the reference's ReferenceCounter
+    (reference: ``src/ray/core_worker/reference_count.h:61``), simplified to
+    an owner-centric protocol for this runtime:
+
+    - every process counts live ``ObjectRef`` pythons per object id
+    - serializing a ref charges one *external* borrow at the owner
+      (locally if we are the owner, else a fire-and-forget ``ref_inc``)
+    - when a process's local count hits zero it sends ``ref_dec`` to the
+      owner (or decrements locally if it is the owner)
+    - the owner frees memory-store + shm entries when local == external == 0
+
+    Known simplification vs the reference: a borrower forwarding a ref to a
+    third process races its own dec against the forwarded inc; the
+    reference solves this with contained-in tracking. Here the worst case
+    of that rare pattern is an early free surfacing as ObjectLostError.
+
+    Deadlock safety: ``ObjectRef.__del__`` may run from a cyclic-GC pass
+    triggered by an allocation made *while this thread already holds*
+    ``_lock`` (or a store lock further down the free path). ``on_deleted``
+    therefore never blocks: it appends to a lock-free deque and drains with
+    a non-blocking acquire; every lock-releasing entry point re-drains, and
+    the core's IO-loop sweeper is the backstop.
+    """
+
+    def __init__(self, core: "CoreWorker"):
+        self.core = core
+        self._lock = threading.Lock()
+        self._local: Dict[bytes, int] = defaultdict(int)
+        self._external: Dict[bytes, int] = defaultdict(int)
+        self._pending: deque = deque()  # (ObjectID, owner_address) decs
+        # container object → refs its serialized bytes borrow
+        self._containment: Dict[bytes, list] = {}
+        self.enabled = os.environ.get("RT_DISABLE_REF_GC", "") != "1"
+
+    def add_containment(self, container: ObjectID, contained: list):
+        """Record that ``container``'s bytes hold borrows on ``contained``
+        refs; freeing the container releases them."""
+        if not self.enabled or not contained:
+            return
+        with self._lock:
+            self._containment.setdefault(
+                container.binary(), []).extend(contained)
+
+    def pop_containment(self, container: ObjectID) -> list:
+        with self._lock:
+            return self._containment.pop(container.binary(), [])
+
+    def _is_owner(self, owner_address) -> bool:
+        return owner_address == self.core.address
+
+    # ----------------------------------------------------- local lifecycle
+    def on_created(self, ref: "ObjectRef"):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._local[ref.object_id.binary()] += 1
+        self._drain()
+
+    def on_deleted(self, ref: "ObjectRef"):
+        """Called from ``__del__`` — must never block on any lock."""
+        if not self.enabled:
+            return
+        self._pending.append((ref.object_id, ref.owner_address))
+        self._drain()
+
+    def _drain(self):
+        """Apply pending decrements; skip (not block) if the lock is busy."""
+        while self._pending:
+            if not self._lock.acquire(blocking=False):
+                return  # holder re-drains on release; sweeper is backstop
+            to_free, to_dec = [], []
+            try:
+                while True:
+                    try:
+                        oid, owner = self._pending.popleft()
+                    except IndexError:
+                        break
+                    key = oid.binary()
+                    n = self._local.get(key, 0) - 1
+                    if n > 0:
+                        self._local[key] = n
+                    else:
+                        self._local.pop(key, None)
+                    if owner == self.core.address:
+                        if n <= 0:
+                            to_free.append(oid)
+                    else:
+                        # EVERY remote-owned counted ref acquired its own
+                        # borrow at creation (deserialize hook), so every
+                        # death pays one back — N copies, N incs, N decs.
+                        to_dec.append((oid, owner))
+            finally:
+                self._lock.release()
+            for oid in to_free:
+                self._maybe_free(oid)
+            for oid, owner in to_dec:
+                self._notify_owner(oid, owner, "ref_dec")
+
+    # ------------------------------------------------------------ borrows
+    def on_serialized(self, ref: "ObjectRef"):
+        """A ref is leaving this process (task arg, return value, pickle)."""
+        self.acquire_borrow(ref.object_id, ref.owner_address)
+
+    def acquire_borrow(self, object_id: ObjectID, owner_address):
+        """Charge one external borrow at the object's owner."""
+        if not self.enabled:
+            return
+        if self._is_owner(owner_address):
+            with self._lock:
+                self._external[object_id.binary()] += 1
+        else:
+            self._notify_owner(object_id, owner_address, "ref_inc")
+        self._drain()
+
+    def release_borrow(self, object_id: ObjectID, owner_address):
+        """Pay back one acquire_borrow charge."""
+        if not self.enabled:
+            return
+        if self._is_owner(owner_address):
+            self.on_borrow_change(object_id, -1)
+        else:
+            self._notify_owner(object_id, owner_address, "ref_dec")
+
+    def on_borrow_change(self, object_id: ObjectID, delta: int):
+        """Owner-side handler for ref_inc / ref_dec pushes."""
+        if not self.enabled:
+            return
+        key = object_id.binary()
+        with self._lock:
+            self._external[key] = self._external.get(key, 0) + delta
+            freed = self._external[key] <= 0
+            if freed:
+                self._external.pop(key, None)
+        self._drain()
+        if freed:
+            self._maybe_free(object_id)
+
+    def on_result_stored(self, object_id: ObjectID):
+        """A task result landed; free it immediately if every ref died
+        while the task was still running."""
+        self._maybe_free(object_id)
+
+    def _maybe_free(self, object_id: ObjectID):
+        key = object_id.binary()
+        with self._lock:
+            if self._local.get(key, 0) > 0 or self._external.get(key, 0) > 0:
+                return
+        self.core.free_object(object_id)
+
+    def _notify_owner(self, object_id: ObjectID, owner_address, method: str):
+        core = self.core
+        if core._loop is None or not core._loop.is_running():
+            return
+
+        async def _send():
+            try:
+                conn = await core._get_conn(owner_address)
+                conn.push(method, {"object_id": object_id.hex()})
+            except Exception:  # noqa: BLE001 - missed dec only leaks
+                pass
+
+        asyncio.run_coroutine_threadsafe(_send(), core._loop)
+
+    def counts(self, object_id: ObjectID) -> Tuple[int, int]:
+        self._drain()
+        key = object_id.binary()
+        with self._lock:
+            return self._local.get(key, 0), self._external.get(key, 0)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's return refs.
+
+    Capability parity with ``num_returns="streaming"`` (reference:
+    ``core_worker.proto:462`` ReportGeneratorItemReturns +
+    ``python/ray/_raylet`` ObjectRefGenerator): the executing worker pushes
+    each yielded item back to the owner as it is produced; iteration yields
+    ``ObjectRef``s that are already (or about to become) local. Consumable
+    in the owner process.
+    """
+
+    def __init__(self, task_id: TaskID, owner_address: Any):
+        self.task_id = task_id
+        self.owner_address = owner_address
+        self._next_index = 0
+        self._finished = False  # stream fully consumed (or errored)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        core = CoreWorker.current()
+        try:
+            ref = core.generator_next(self.task_id, self._next_index,
+                                      self.owner_address)
+        except (StopIteration, Exception):
+            self._finished = True
+            raise
+        self._next_index += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    def __del__(self):
+        if self._finished:
+            return  # stream fully drained: nothing to free or track
+        core = CoreWorker._current
+        if core is not None and not core._shutdown:
+            try:
+                # Never touch locks from __del__ (same hazard as
+                # ObjectRef GC): defer to the IO-loop sweeper.
+                core._dropped_gen_pending.append(
+                    (self.task_id, self._next_index))
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _deserialize_object_ref(t):
+    """Unpickle hook for nested ObjectRefs: the new counted ref acquires
+    its own borrow (paid back by its death), keeping repeated
+    deserialize/del cycles net-zero on the container's borrow."""
+    oid, owner = t
+    core = CoreWorker._current
+    if core is not None and not core._shutdown and owner != core.address:
+        core.refs.acquire_borrow(oid, owner)
+    return ObjectRef(oid, owner)
+
+
+def _small_value(v) -> bool:
+    """Cheap-to-serialize check: primitives and tiny containers package on
+    the IO loop; everything else hops to the thread pool."""
+    if v is None or isinstance(v, (bool, int, float)):
+        return True
+    if isinstance(v, (str, bytes)) and len(v) < 4096:
+        return True
+    return False
 
 
 class _LeaseCache:
@@ -161,6 +431,24 @@ class CoreWorker:
         self._shutdown = False
         self._pubsub_handlers: Dict[str, List] = defaultdict(list)
         self._next_task_index = 0
+        self.refs = ReferenceCounter(self)
+        self._pulls_inflight: set = set()
+        # streaming-generator state (owner side): task_id -> {count, error}
+        self._generators: Dict[bytes, dict] = {}
+        # generators whose handle died mid-stream: late items are freed on
+        # arrival instead of stored (entry removed on generator_done)
+        self._dropped_generators: set = set()
+        # ObjectRefGenerator.__del__ parks here; the sweeper frees items
+        self._dropped_gen_pending: deque = deque()
+        # actor-handle GC: per-actor local handle counts; 0↔1 transitions
+        # push actor_handle_change to the head (deque+drain — __del__ may
+        # fire inside a locked region, same hazard as ObjectRef GC)
+        self._handle_counts: Dict[bytes, int] = defaultdict(int)
+        self._handle_pending: deque = deque()
+        self._handle_lock = threading.Lock()
+        self._capture_tls = threading.local()  # nested-ref capture stack
+        self._actor_gc_enabled = (
+            os.environ.get("RT_DISABLE_ACTOR_GC", "") != "1")
 
     # ------------------------------------------------------------- lifecycle
     @classmethod
@@ -175,7 +463,55 @@ class CoreWorker:
         self._io_thread.start()
         self._loop_ready.wait(timeout=30)
         CoreWorker._current = self
+
+        # Nested-ref protocol (reference: contained-in borrow tracking,
+        # ``reference_count.h``): SERIALIZING a nested ref charges one
+        # borrow owned by the *container* (captured via _capture_tls and
+        # recorded against the container object / task spec — released
+        # when that container is freed). DESERIALIZING acquires a fresh
+        # borrow for the new counted ref, which its own death pays back —
+        # so repeated get() cycles are net-zero and can never consume the
+        # container's borrow.
+        def _ser(ref):
+            self.refs.on_serialized(ref)
+            lst = getattr(self._capture_tls, "lst", None)
+            if lst is not None:
+                lst.append((ref.object_id, ref.owner_address))
+            return (ref.object_id, ref.owner_address)
+
+        # The deserializer must be module-level: the reduce tuple embeds
+        # it in the pickle stream, and a closure over `self` would drag
+        # the whole CoreWorker (locks and all) into every message.
+        self.serde.register_serializer(
+            ObjectRef, serializer=_ser,
+            deserializer=_deserialize_object_ref)
         return self
+
+    class _CaptureRefs:
+        def __init__(self, core):
+            self.core = core
+            self.lst: list = []
+
+        def __enter__(self):
+            self._prev = getattr(self.core._capture_tls, "lst", None)
+            self.core._capture_tls.lst = self.lst
+            return self.lst
+
+        def __exit__(self, *exc):
+            self.core._capture_tls.lst = self._prev
+            return False
+
+    def capture_nested_refs(self) -> "_CaptureRefs":
+        """Context manager collecting refs serialized within the block."""
+        return CoreWorker._CaptureRefs(self)
+
+    def free_object(self, object_id: ObjectID):
+        """Drop an owned object from the local stores (GC endpoint) and
+        release the borrows of any refs its bytes contain."""
+        self.memory_store.delete(object_id)
+        self.shm_store.delete(object_id)
+        for oid, owner in self.refs.pop_containment(object_id):
+            self.refs.release_borrow(oid, owner)
 
     def _run_loop(self):
         self._loop = asyncio.new_event_loop()
@@ -203,21 +539,41 @@ class CoreWorker:
         self._head = await rpc.connect(self.head_sock, self._handle)
         self._reaper = asyncio.get_running_loop().create_task(
             self._lease_reaper())
+        self._gc_sweeper = asyncio.get_running_loop().create_task(
+            self._ref_gc_sweeper())
 
-    async def _lease_reaper(self):
-        """Return leases idle for >0.2s so other clients aren't starved."""
+    async def _ref_gc_sweeper(self):
+        """Backstop drain for ref-dec events parked while a lock was busy."""
         while not self._shutdown:
             await asyncio.sleep(0.1)
+            if self.refs._pending:
+                self.refs._drain()
+            if self._handle_pending:
+                self._drain_handle_events()
+            while self._dropped_gen_pending:
+                task_id, idx = self._dropped_gen_pending.popleft()
+                try:
+                    self.generator_dropped(task_id, idx)
+                except Exception:  # noqa: BLE001 - missed free only leaks
+                    pass
+
+    async def _lease_reaper(self):
+        """Return leases idle past the TTL so other clients aren't starved."""
+        ttl = getattr(self.config, "lease_idle_ttl_s", 2.0)
+        while not self._shutdown:
+            await asyncio.sleep(min(0.25, ttl / 2))
             now = time.time()
             for shape, leases in list(self._leases.by_shape.items()):
                 for lease in list(leases):
                     if (lease["inflight"] == 0
-                            and now - lease.get("last_used", now) > 0.2):
+                            and now - lease.get("last_used", now) > ttl):
                         await self._drop_lease(shape, lease)
 
     async def _async_stop(self):
         if getattr(self, "_reaper", None):
             self._reaper.cancel()
+        if getattr(self, "_gc_sweeper", None):
+            self._gc_sweeper.cancel()
         if self._server:
             await self._server.stop()
         for c in self._conns.values():
@@ -263,8 +619,10 @@ class CoreWorker:
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
         object_id = ObjectID.from_random()
-        frames = self.serde.serialize(value)
+        with self.capture_nested_refs() as contained:
+            frames = self.serde.serialize(value)
         self._store_frames(object_id, frames)
+        self.refs.add_containment(object_id, contained)
         return ObjectRef(object_id, self.address)
 
     def _store_frames(self, object_id: ObjectID, frames: List[bytes]):
@@ -360,39 +718,136 @@ class CoreWorker:
             raise value
         return value
 
+    # ----------------------------------------------------------- generators
+    def generator_next(self, task_id: TaskID, index: int,
+                       owner_address) -> ObjectRef:
+        """Block until streamed item ``index`` exists (or the stream ended
+        before it — StopIteration)."""
+        if owner_address != self.address:
+            raise RuntimeError(
+                "an ObjectRefGenerator is only consumable in the process "
+                "that submitted the task (its items' owner)")
+        oid = ObjectID.for_task_return(task_id, index)
+        key = task_id.binary()
+        # Event-driven park: item arrival fires the watcher; stream
+        # end/error isn't signalled through the store, so cap the wait to
+        # re-check the generator state.
+        ev = threading.Event()
+        self.memory_store.add_watcher(oid, ev)
+        try:
+            while True:
+                if self.memory_store.contains(oid):
+                    return ObjectRef(oid, self.address)
+                st = self._generators.get(key)
+                if st is not None:
+                    if st.get("error") is not None and \
+                            st.get("count") is None:
+                        self._generators.pop(key, None)
+                        raise st["error"]
+                    count = st.get("count")
+                    if count is not None and index >= count:
+                        self._generators.pop(key, None)
+                        raise StopIteration
+                if self._shutdown:
+                    raise RuntimeError("core worker shut down")
+                ev.wait(0.05)
+                ev.clear()
+        finally:
+            self.memory_store.remove_watcher(oid, ev)
+
+    def generator_dropped(self, task_id: TaskID, from_index: int):
+        """Generator handle died: free unconsumed streamed items, and mark
+        the stream dropped so still-in-flight items are freed on arrival
+        instead of leaking into the memory store."""
+        key = task_id.binary()
+        st = self._generators.pop(key, None)
+        count = (st or {}).get("count")
+        if count is None:
+            # Producer may still be running; generator_done cleans this up.
+            self._dropped_generators.add(key)
+        i = from_index
+        while True:
+            oid = ObjectID.for_task_return(task_id, i)
+            if count is not None and i >= count:
+                break
+            if count is None and not self.memory_store.contains(oid):
+                break
+            self.free_object(oid)
+            i += 1
+
     def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None,
              fetch_local=True):
+        """Event-driven wait (reference: ``core_worker.cc:1735``): parks on
+        a single event wired to the memory store instead of polling; refs
+        owned remotely get one long-poll pull each whose arrival fires the
+        same event."""
         deadline = None if timeout is None else time.time() + timeout
-        ready, not_ready = [], list(refs)
-        while True:
-            still = []
+        ready, not_ready = [], []
+        for ref in refs:
+            (ready if self._is_ready_local(ref) else not_ready).append(ref)
+        if len(ready) >= num_returns or not not_ready:
+            return ready, not_ready
+        ev = threading.Event()
+        watched = []
+        try:
             for ref in not_ready:
-                if self._is_ready(ref):
-                    ready.append(ref)
-                else:
-                    still.append(ref)
-            not_ready = still
-            if len(ready) >= num_returns or not not_ready:
-                return ready, not_ready
-            if deadline is not None and time.time() >= deadline:
-                return ready, not_ready
-            time.sleep(0.001)
+                self.memory_store.add_watcher(ref.object_id, ev)
+                watched.append(ref)
+            while True:
+                still = []
+                for ref in not_ready:
+                    if self._is_ready_local(ref):
+                        ready.append(ref)
+                    else:
+                        # Re-issue failed pulls each pass (the inflight
+                        # set dedups) so a transiently unreachable owner
+                        # doesn't turn wait(timeout=None) into a hang.
+                        if ref.owner_address != self.address:
+                            self._ensure_pull(ref)
+                        still.append(ref)
+                not_ready = still
+                if len(ready) >= num_returns or not not_ready:
+                    return ready, not_ready
+                remaining = None if deadline is None else                     deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return ready, not_ready
+                # Cap the park so shm-only arrivals (segments created by
+                # another process on this host) are still noticed.
+                ev.wait(timeout=min(0.2, remaining)
+                        if remaining is not None else 0.2)
+                ev.clear()
+        finally:
+            for ref in watched:
+                self.memory_store.remove_watcher(ref.object_id, ev)
 
-    def _is_ready(self, ref: ObjectRef) -> bool:
-        if self.memory_store.contains(ref.object_id):
-            return True
-        if self.shm_store.contains(ref.object_id):
-            return True
-        if ref.owner_address != self.address:
+    def _is_ready_local(self, ref: ObjectRef) -> bool:
+        return (self.memory_store.contains(ref.object_id)
+                or self.shm_store.contains(ref.object_id))
+
+    def _ensure_pull(self, ref: ObjectRef):
+        """Start (once) a background pull of a remote-owned ref; the result
+        lands in the memory store, firing any wait() watchers."""
+        key = ref.object_id.binary()
+        if key in self._pulls_inflight:
+            return
+        self._pulls_inflight.add(key)
+
+        async def _pull():
             try:
-                meta, bufs = self.run_sync(self._probe_remote(ref), timeout=5)
-            except Exception:
-                return False
-            if meta.get("found"):
-                if not meta.get("in_shm"):
-                    self.memory_store.put(ref.object_id, bufs)
-                return True
-        return False
+                meta, bufs = await self._pull_remote(ref)
+                if meta.get("found"):
+                    if meta.get("in_shm"):
+                        frames = self.shm_store.get(ref.object_id)
+                        if frames is not None:
+                            self.memory_store.put(ref.object_id, None)
+                    else:
+                        self.memory_store.put(ref.object_id, bufs)
+            except Exception:  # noqa: BLE001 - wait() deadline handles it
+                pass
+            finally:
+                self._pulls_inflight.discard(key)
+
+        asyncio.run_coroutine_threadsafe(_pull(), self._loop)
 
     async def _probe_remote(self, ref: ObjectRef):
         conn = await self._get_conn(ref.owner_address)
@@ -426,52 +881,103 @@ class CoreWorker:
         return fn
 
     # ------------------------------------------------------------- submission
-    def _serialize_args(self, args, kwargs) -> Tuple[list, list]:
-        """Inline small args; pass refs through; promote big args to shm."""
-        out = []
+    def _serialize_args(self, args, kwargs) -> Tuple[list, list, list]:
+        """Inline small args; pass refs through; promote big args to shm.
+
+        Every "ref" arg charges one borrow at its owner — the borrow
+        belongs to the *task spec* (it must survive retries), so the
+        caller releases it when the submission finally completes (normal
+        tasks) or never (actor creation specs, which the head keeps for
+        restarts). Returns (ser_args, kw_keys, borrowed) with borrowed =
+        [(ObjectID, owner_address), ...].
+        """
+        out, borrowed = [], []
         kw_keys = list(kwargs.keys())
         for v in list(args) + [kwargs[k] for k in kw_keys]:
             if isinstance(v, ObjectRef):
+                self.refs.acquire_borrow(v.object_id, v.owner_address)
+                borrowed.append((v.object_id, v.owner_address))
                 out.append(("ref", (v.object_id.binary(), v.owner_address)))
             else:
-                frames = self.serde.serialize(v)
+                # Refs nested inside pickled args borrow for the whole
+                # submission (incl. retries), same as top-level ref args.
+                with self.capture_nested_refs() as nested:
+                    frames = self.serde.serialize(v)
+                borrowed.extend(nested)
                 total = sum(len(f) for f in frames)
                 if total > self.config.max_inline_object_size:
                     oid = ObjectID.from_random()
                     self.shm_store.create(oid, frames)
                     self.memory_store.put(oid, None)
+                    self.refs.acquire_borrow(oid, self.address)
+                    borrowed.append((oid, self.address))
                     out.append(("ref", (oid.binary(), self.address)))
                 else:
                     # materialize out-of-band buffers: inline frames ride
                     # the pickled payload, which can't carry memoryviews
                     out.append(("inline", [bytes(f) for f in frames]))
-        return out, kw_keys
+        return out, kw_keys, borrowed
 
     def submit_task(self, fn_key: str, args, kwargs, *, num_returns=1,
                     resources=None, max_retries=None, strategy=None,
-                    name="") -> List[ObjectRef]:
+                    name=""):
         task_id = TaskID.from_random()
-        ser_args, kw_keys = self._serialize_args(args, kwargs)
+        streaming = num_returns == "streaming"
+        ser_args, kw_keys, borrowed = self._serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.NORMAL,
             function_ref=("kv", fn_key), args=ser_args, kwargs_keys=kw_keys,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
             resources=resources or {"CPU": 1.0},
-            max_retries=(self.config.task_max_retries
-                         if max_retries is None else max_retries),
+            max_retries=0 if streaming else (
+                self.config.task_max_retries
+                if max_retries is None else max_retries),
             scheduling_strategy=strategy or SchedulingStrategy(),
             name=name, owner_address=self.address,
+            is_generator=streaming,
         )
-        refs = [ObjectRef(oid, self.address)
-                for oid in spec.return_object_ids()]
-        asyncio.run_coroutine_threadsafe(self._submit_normal(spec), self._loop)
-        return refs
+        # Refs MUST exist before the submission is scheduled: a fast task
+        # completing on the IO thread hits on_result_stored, and with no
+        # live ref counted the result would be GC'd before the caller ever
+        # holds it.
+        if streaming:
+            out = ObjectRefGenerator(task_id, self.address)
+        else:
+            out = [ObjectRef(oid, self.address)
+                   for oid in spec.return_object_ids()]
+        asyncio.run_coroutine_threadsafe(
+            self._submit_normal(spec, borrowed), self._loop)
+        return out
 
-    async def _submit_normal(self, spec: TaskSpec):
+    async def _submit_normal(self, spec: TaskSpec, borrowed=()):
         try:
             await self._submit_normal_inner(spec)
         except Exception as e:  # noqa: BLE001 - surface via result objects
             self._store_error(spec, e)
+        finally:
+            self._release_borrows_later(borrowed)
+
+    def _release_borrows_later(self, borrowed):
+        """Pay back a submission's arg borrows after a grace period.
+
+        The executing worker's own deserialize-time ref_inc rides a
+        different connection than the task reply; releasing immediately
+        could zero the count before that inc lands and free an object the
+        worker still holds. The grace window covers the in-flight inc
+        (same approach as actor-handle GC)."""
+        if not borrowed:
+            return
+
+        async def _later():
+            await asyncio.sleep(
+                getattr(self.config, "borrow_release_grace_s", 2.0))
+            for oid, owner in borrowed:
+                self.refs.release_borrow(oid, owner)
+
+        try:
+            self._loop.create_task(_later())
+        except RuntimeError:  # loop gone (shutdown): leak, don't crash
+            pass
 
     def _store_error(self, spec: TaskSpec, exc: Exception):
         if isinstance(exc, TaskError):
@@ -479,6 +985,10 @@ class CoreWorker:
         else:
             err = TaskError(type(exc).__name__, str(exc),
                             traceback.format_exc())
+        if spec.is_generator:
+            st = self._generators.setdefault(spec.task_id.binary(), {})
+            st["error"] = err
+            return
         frames = self.serde.serialize(err)
         for oid in spec.return_object_ids():
             self.memory_store.put(oid, frames)
@@ -521,6 +1031,7 @@ class CoreWorker:
             "owner_address": spec.owner_address,
             "name": spec.name,
             "max_concurrency": spec.max_concurrency,
+            "is_generator": spec.is_generator,
         }
 
     def _ingest_results(self, spec: TaskSpec, meta, bufs):
@@ -528,12 +1039,17 @@ class CoreWorker:
         offset = 0
         for i, oid in enumerate(spec.return_object_ids()):
             r = meta["returns"][i]
+            contained = [(ObjectID(ob), owner)
+                         for ob, owner in r.get("contained", ())]
+            self.refs.add_containment(oid, contained)
             if r["where"] == "inline":
                 n = r["nframes"]
                 self.memory_store.put(oid, bufs[offset:offset + n])
                 offset += n
             else:  # shm
                 self.memory_store.put(oid, None)
+            # If every ref died while the task ran, drop the result now.
+            self.refs.on_result_stored(oid)
 
     async def _acquire_lease(self, shape, spec: TaskSpec) -> dict:
         """Pick a leased worker, growing the lease set without stampeding.
@@ -551,37 +1067,68 @@ class CoreWorker:
             best = min(live, key=lambda l: l["inflight"], default=None)
             want_more = best is None or best["inflight"] >= cap
             if want_more and self._lease_requests_inflight[shape] < 2:
-                strategy = spec.scheduling_strategy
-                payload = {
-                    "resources": spec.resources,
-                    "timeout": 2.0 if best is not None else 30.0,
-                    "strategy": None if strategy.kind == "DEFAULT" else {
-                        "kind": strategy.kind,
-                        "pg_id": strategy.placement_group_id.hex()
-                        if strategy.placement_group_id else None,
-                        "bundle_index": strategy.bundle_index,
-                        "node_id": strategy.node_id,
-                        "soft": strategy.soft,
-                    }}
+                if best is None:
+                    # No worker yet: this task must wait for the grant.
+                    try:
+                        lease = await self._request_lease(shape, spec, 30.0)
+                    except rpc.RpcError:
+                        live = [l for l in leases if not l.get("dead")]
+                        best = min(live, key=lambda l: l["inflight"],
+                                   default=None)
+                        if best is not None:
+                            return best
+                        raise
+                    if lease is not None:
+                        return lease
+                    continue
+                # Saturated but serviceable: grow the pool in the
+                # background and pipeline this task onto the least-loaded
+                # lease NOW (a blocking grant here would serialize burst
+                # submission behind ~0.5s worker spawns). Count the request
+                # HERE — create_task runs later, and the gate above must
+                # see it immediately or a 500-task burst floods the head.
                 self._lease_requests_inflight[shape] += 1
-                try:
-                    meta = await self._head.call_simple(
-                        "lease_worker", payload)
-                except rpc.RpcError:
-                    if best is not None:
-                        return best  # saturated: pipeline onto existing
-                    raise
-                finally:
-                    self._lease_requests_inflight[shape] -= 1
-                conn = await self._get_conn(meta["address"])
-                lease = {"worker_id": meta["worker_id"],
-                         "address": meta["address"],
-                         "conn": conn, "inflight": 0}
-                leases.append(lease)
-                return lease
+                self._loop.create_task(
+                    self._request_lease_quiet(shape, spec))
+                return best
             if best is not None:
                 return best
             await asyncio.sleep(0.001)  # first lease request is in flight
+
+    async def _request_lease(self, shape, spec: TaskSpec, timeout: float,
+                             pre_counted: bool = False):
+        strategy = spec.scheduling_strategy
+        payload = {
+            "resources": spec.resources,
+            "timeout": timeout,
+            "strategy": None if strategy.kind == "DEFAULT" else {
+                "kind": strategy.kind,
+                "pg_id": strategy.placement_group_id.hex()
+                if strategy.placement_group_id else None,
+                "bundle_index": strategy.bundle_index,
+                "node_id": strategy.node_id,
+                "soft": strategy.soft,
+            }}
+        if not pre_counted:
+            self._lease_requests_inflight[shape] += 1
+        try:
+            meta = await self._head.call_simple("lease_worker", payload)
+        finally:
+            self._lease_requests_inflight[shape] -= 1
+        conn = await self._get_conn(meta["address"])
+        # Stamp last_used at birth: a background-grown lease that never
+        # receives a task must still age out, or its charge leaks forever.
+        lease = {"worker_id": meta["worker_id"],
+                 "address": meta["address"],
+                 "conn": conn, "inflight": 0, "last_used": time.time()}
+        self._leases.by_shape[shape].append(lease)
+        return lease
+
+    async def _request_lease_quiet(self, shape, spec: TaskSpec):
+        try:
+            await self._request_lease(shape, spec, 2.0, pre_counted=True)
+        except Exception:  # noqa: BLE001 - growth is best-effort
+            pass
 
     async def _drop_lease(self, shape, lease, kill=False):
         try:
@@ -609,7 +1156,11 @@ class CoreWorker:
                      lifetime=None) -> "ActorID":
         actor_id = ActorID.from_random()
         cls_key = self.export_function(cls)
-        ser_args, kw_keys = self._serialize_args(args, kwargs)
+        # Creation-spec borrows are deliberately never released: the head
+        # keeps the spec for actor restarts, so its args must stay alive
+        # for the actor's whole life.
+        ser_args, kw_keys, _creation_borrows = self._serialize_args(
+            args, kwargs)
         spec_meta = {
             "actor_id": actor_id.binary(),
             "cls_ref": ("kv", cls_key),
@@ -623,6 +1174,7 @@ class CoreWorker:
         payload = {
             "actor_id": actor_id.hex(),
             "name": name,
+            "lifetime": lifetime,
             "resources": resources or {"CPU": 1.0},
             "max_restarts": max_restarts,
             "spec_meta": spec_meta,
@@ -737,33 +1289,45 @@ class CoreWorker:
         return st["address"]
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
-                          kwargs, num_returns=1) -> List[ObjectRef]:
+                          kwargs, num_returns=1):
         task_id = TaskID.from_random()
-        ser_args, kw_keys = self._serialize_args(args, kwargs)
+        streaming = num_returns == "streaming"
+        ser_args, kw_keys, borrowed = self._serialize_args(args, kwargs)
         key = actor_id.binary()
         seq = self._actor_seq[key]
         self._actor_seq[key] = seq + 1
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
             function_ref=("method", method_name), args=ser_args,
-            kwargs_keys=kw_keys, num_returns=num_returns, actor_id=actor_id,
+            kwargs_keys=kw_keys,
+            num_returns=0 if streaming else num_returns, actor_id=actor_id,
             method_name=method_name, seq_no=seq, owner_address=self.address,
+            is_generator=streaming,
         )
-        refs = [ObjectRef(oid, self.address)
-                for oid in spec.return_object_ids()]
+        # Refs before scheduling — same GC race as submit_task.
+        if streaming:
+            out = ObjectRefGenerator(task_id, self.address)
+        else:
+            out = [ObjectRef(oid, self.address)
+                   for oid in spec.return_object_ids()]
         asyncio.run_coroutine_threadsafe(
-            self._submit_actor_task(spec), self._loop)
-        return refs
+            self._submit_actor_task(spec, borrowed), self._loop)
+        return out
 
-    async def _submit_actor_task(self, spec: TaskSpec):
+    async def _submit_actor_task(self, spec: TaskSpec, borrowed=()):
         try:
             # Writes must hit the socket in seq order: resolve + write under
             # a per-actor lock (FIFO), await the reply outside it.
             key = spec.actor_id.binary()
             lock = self._actor_send_locks.setdefault(key, asyncio.Lock())
             async with lock:
-                addr = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: self.actor_address(spec.actor_id))
+                st = self._actor_state.get(key)
+                if st is not None and st["state"] == "ALIVE" and \
+                        st["address"] is not None:
+                    addr = st["address"]  # hot path: no executor hop
+                else:
+                    addr = await asyncio.get_running_loop().run_in_executor(
+                        None, lambda: self.actor_address(spec.actor_id))
                 conn = await self._get_conn(addr)
                 fut = conn.send_request("push_task", self._spec_meta(spec))
             reply, bufs = await fut
@@ -777,6 +1341,61 @@ class CoreWorker:
             self._store_error(spec, e)
         except Exception as e:  # noqa: BLE001
             self._store_error(spec, e)
+        finally:
+            self._release_borrows_later(borrowed)
+
+    # -------------------------------------------------- actor handle GC
+    def on_actor_handle_created(self, actor_id: ActorID):
+        if not self._actor_gc_enabled:
+            return
+        self._handle_pending.append((actor_id.binary(), +1))
+        self._drain_handle_events()
+
+    def on_actor_handle_deleted(self, actor_id: ActorID):
+        """Called from ``ActorHandle.__del__`` — never blocks."""
+        if not self._actor_gc_enabled:
+            return
+        self._handle_pending.append((actor_id.binary(), -1))
+        self._drain_handle_events()
+
+    def _drain_handle_events(self):
+        while self._handle_pending:
+            if not self._handle_lock.acquire(blocking=False):
+                return  # a later create/delete (or the sweeper) re-drains
+            notify = []
+            try:
+                while True:
+                    try:
+                        key, delta = self._handle_pending.popleft()
+                    except IndexError:
+                        break
+                    before = self._handle_counts[key]
+                    after = before + delta
+                    self._handle_counts[key] = after
+                    if before == 0 and after == 1:
+                        notify.append((key, +1))
+                    elif before == 1 and after == 0:
+                        self._handle_counts.pop(key, None)
+                        notify.append((key, -1))
+            finally:
+                self._handle_lock.release()
+            for key, delta in notify:
+                self._push_handle_change(key, delta)
+
+    def _push_handle_change(self, key: bytes, delta: int):
+        if self._loop is None or not self._loop.is_running() or \
+                self._shutdown:
+            return
+
+        async def _send():
+            try:
+                await self._head.call_simple(
+                    "actor_handle_change",
+                    {"actor_id": ActorID(key).hex(), "delta": delta})
+            except Exception:  # noqa: BLE001 - a lost dec only delays GC
+                pass
+
+        asyncio.run_coroutine_threadsafe(_send(), self._loop)
 
     def kill_actor(self, actor_id: ActorID, no_restart=True):
         self.run_sync(self._head.call_simple(
@@ -790,9 +1409,37 @@ class CoreWorker:
     # ------------------------------------------------------------- execution
     async def _handle(self, method, payload, bufs, conn):
         if method == "push_task":
-            return await self._exec_push_task(payload, bufs)
+            return await self._exec_push_task(payload, bufs, conn)
         if method == "get_object":
             return await self._exec_get_object(payload)
+        if method == "ref_inc":
+            self.refs.on_borrow_change(
+                ObjectID.from_hex(payload["object_id"]), +1)
+            return {}
+        if method == "ref_dec":
+            self.refs.on_borrow_change(
+                ObjectID.from_hex(payload["object_id"]), -1)
+            return {}
+        if method == "generator_item":
+            key = payload["task_id"]
+            oid = ObjectID.for_task_return(TaskID(key), payload["index"])
+            self.refs.add_containment(oid, [
+                (ObjectID(ob), owner)
+                for ob, owner in payload.get("contained", ())])
+            if key in self._dropped_generators:
+                self.free_object(oid)  # consumer gone: drop, don't store
+            else:
+                self.memory_store.put(oid, [bytes(b) for b in bufs])
+            return {}
+        if method == "generator_done":
+            key = payload["task_id"]
+            if key in self._dropped_generators:
+                self._dropped_generators.discard(key)
+                self._generators.pop(key, None)
+            else:
+                st = self._generators.setdefault(key, {})
+                st["count"] = payload["count"]
+            return {}
         if method == "create_actor":
             return await self._exec_create_actor(payload, bufs)
         if method == "pubsub":
@@ -867,7 +1514,10 @@ class CoreWorker:
                 vals.append(self.serde.deserialize(payload))
             else:
                 oid_b, owner = payload
-                ref = ObjectRef(ObjectID(oid_b), owner)
+                # Uncounted: the submitter's per-task borrow keeps the
+                # object alive across retries; counting here would pay
+                # that borrow back after the first execution.
+                ref = ObjectRef(ObjectID(oid_b), owner, _counted=False)
                 vals.append(self._get_one(ref, timeout=300))
         nkw = len(kwargs_keys)
         if nkw:
@@ -900,34 +1550,38 @@ class CoreWorker:
             "ordered": maxc == 1, "streams": {}}
         return {"ok": True}
 
-    async def _exec_push_task(self, payload, bufs):
+    async def _exec_push_task(self, payload, bufs, conn=None):
         t0 = time.time()
         meta = payload
         loop = asyncio.get_running_loop()
         if meta["type"] == TaskType.ACTOR_TASK.value:
-            result = await self._run_actor_task(meta)
+            result = await self._run_actor_task(meta, conn)
         else:
             result = await loop.run_in_executor(
-                self._exec_pool, lambda: self._run_normal_task(meta))
+                self._exec_pool, lambda: self._run_normal_task(meta, conn))
         returns_meta, out_bufs = result
+        end = time.time()
         self._task_events.append(
             {"task_id": meta["task_id"].hex(), "name": meta.get("name", ""),
-             "start": t0, "end": time.time(),
+             "start": t0, "end": end,
              "worker_id": self.worker_id.hex()})
+        from .._private.metrics import core_metrics
+
+        cm = core_metrics()
+        cm["tasks_finished"].inc()
+        cm["task_duration"].observe(end - t0)
         return {"returns": returns_meta}, out_bufs
 
     def _execute_function(self, meta):
-        """Run the task function; returns list of return values."""
+        """Fetch + run the task function; returns its raw result."""
         kind, ref = meta["function_ref"]
-        if kind == "kv":
-            fn = self.fetch_function(ref)
-            fn = getattr(fn, "__rt_function__", fn)
-        else:
+        if kind != "kv":
             raise RuntimeError(f"bad function ref {kind}")
+        fn = self.fetch_function(ref)
+        fn = getattr(fn, "__rt_function__", fn)
         args, kwargs = self._deserialize_args(meta["args"],
                                               meta["kwargs_keys"])
-        out = fn(*args, **kwargs)
-        return self._split_returns(out, meta["num_returns"])
+        return fn(*args, **kwargs)
 
     @staticmethod
     def _split_returns(out, num_returns):
@@ -940,31 +1594,90 @@ class CoreWorker:
         return list(out)
 
     def _package_returns(self, meta, values) -> Tuple[list, list]:
-        """Serialize return values: small inline, large to shm."""
+        """Serialize return values: small inline, large to shm.
+
+        Refs nested in a return value charge borrows here (serializer
+        side); their (oid, owner) pairs ride the reply so the RESULT'S
+        owner records the containment and releases the borrows when it
+        frees the result object.
+        """
         returns_meta, out_bufs = [], []
         owner_is_remote = meta["owner_address"] != self.address
         for i, v in enumerate(values):
-            frames = self.serde.serialize(v)
+            with self.capture_nested_refs() as contained:
+                frames = self.serde.serialize(v)
             total = sum(len(f) for f in frames)
             oid = ObjectID.for_task_return(TaskID(meta["task_id"]), i)
+            ent = {"contained": [(o.binary(), owner)
+                                 for o, owner in contained]}
             if total > self.config.max_inline_object_size and owner_is_remote:
-                self.shm_store.create(oid, frames)
-                returns_meta.append({"where": "shm"})
+                ent["where"] = "shm"
             else:
-                returns_meta.append({"where": "inline",
-                                     "nframes": len(frames)})
+                ent["where"] = "inline"
+                ent["nframes"] = len(frames)
                 out_bufs.extend(bytes(f) for f in frames)
+            if ent["where"] == "shm":
+                self.shm_store.create(oid, frames)
+            returns_meta.append(ent)
         return returns_meta, out_bufs
 
-    def _run_normal_task(self, meta):
+    def _run_normal_task(self, meta, conn=None):
+        if meta.get("is_generator"):
+            # Arg fetch/deserialize happens inside _run_generator's try so
+            # failures stream back as an error item, not a protocol error.
+            return self._run_generator(meta, conn,
+                                       lambda: self._execute_function(meta))
         try:
-            values = self._execute_function(meta)
+            values = self._split_returns(self._execute_function(meta),
+                                         meta["num_returns"])
         except Exception as e:  # noqa: BLE001
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
             values = [err] * meta["num_returns"]
         return self._package_returns(meta, values)
 
-    async def _run_actor_task(self, meta):
+    def _run_generator(self, meta, conn, produce):
+        """Stream yielded items back to the owner as they are produced
+        (reference: ``core_worker.proto:462`` ReportGeneratorItemReturns).
+        Runs on an executor thread; item pushes hop to the IO loop in call
+        order, so indices arrive monotonically."""
+        task_id_b = meta["task_id"]
+        idx = 0
+
+        def push(method, payload, bufs=()):
+            self._loop.call_soon_threadsafe(
+                lambda: self._push_quiet(conn, method, payload, list(bufs)))
+
+        try:
+            out = produce()
+            for item in out:
+                with self.capture_nested_refs() as contained:
+                    frames = self.serde.serialize(item)
+                push("generator_item",
+                     {"task_id": task_id_b, "index": idx,
+                      "contained": [(o.binary(), owner)
+                                    for o, owner in contained]},
+                     [bytes(f) for f in frames])
+                idx += 1
+                if idx >= 65535:
+                    raise ValueError("streaming generator exceeded 65535 "
+                                     "items (object-id index space)")
+        except Exception as e:  # noqa: BLE001
+            err = TaskError(type(e).__name__, str(e), traceback.format_exc())
+            frames = self.serde.serialize(err)
+            push("generator_item", {"task_id": task_id_b, "index": idx},
+                 [bytes(f) for f in frames])
+            idx += 1
+        push("generator_done", {"task_id": task_id_b, "count": idx})
+        return {"returns": [], "generator_count": idx}, []
+
+    @staticmethod
+    def _push_quiet(conn, method, payload, bufs):
+        try:
+            conn.push(method, payload, bufs)
+        except Exception:  # noqa: BLE001 - owner died; nothing to stream to
+            pass
+
+    async def _run_actor_task(self, meta, conn=None):
         actor_id_b = meta["actor_id"]
         instance = self._actors_local.get(actor_id_b)
         if instance is None:
@@ -977,11 +1690,38 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
         method = getattr(instance, meta["method_name"])
 
+        def _args_are_light():
+            # Tiny inline args deserialize in ~us: do it on the loop and
+            # skip two thread-pool hops on the hot path.
+            total = 0
+            for kind, payload in meta["args"]:
+                if kind != "inline":
+                    return False
+                total += sum(len(f) for f in payload)
+            return total < 8192
+
         async def _invoke():
-            args, kwargs = await loop.run_in_executor(
-                self._exec_pool,
-                lambda: self._deserialize_args(meta["args"],
-                                               meta["kwargs_keys"]))
+            if meta.get("is_generator"):
+                # Deserialize inside the generator runner's try: a lost
+                # arg ref streams back as an error item instead of
+                # crashing the reply protocol (num_returns == 0 here).
+                def produce():
+                    args, kwargs = self._deserialize_args(
+                        meta["args"], meta["kwargs_keys"])
+                    return method(*args, **kwargs)
+
+                ex = self._actor_executors[actor_id_b]
+                return await loop.run_in_executor(
+                    ex, lambda: self._run_generator(meta, conn, produce))
+            light = _args_are_light()
+            if light:
+                args, kwargs = self._deserialize_args(meta["args"],
+                                                      meta["kwargs_keys"])
+            else:
+                args, kwargs = await loop.run_in_executor(
+                    self._exec_pool,
+                    lambda: self._deserialize_args(meta["args"],
+                                                   meta["kwargs_keys"]))
             if asyncio.iscoroutinefunction(method):
                 out = await method(*args, **kwargs)
             else:
@@ -997,23 +1737,43 @@ class CoreWorker:
         # previous instance.
         stream = None
         if order["ordered"] and seq >= 0:
+            # Per-seq events, not a shared Condition: notify_all on a
+            # condition wakes EVERY queued call per completion (O(n^2)
+            # wakeups across a deep pipeline); here each completion wakes
+            # exactly its successor.
             stream = order["streams"].setdefault(
-                meta["owner_address"],
-                {"next": None, "cond": asyncio.Condition()})
-            async with stream["cond"]:
-                if stream["next"] is None:
-                    stream["next"] = seq
-                await stream["cond"].wait_for(lambda: stream["next"] == seq)
+                meta["owner_address"], {"next": None, "events": {}})
+            if stream["next"] is None:
+                stream["next"] = seq
+            if seq > stream["next"]:
+                ev = stream["events"].setdefault(seq, asyncio.Event())
+                await ev.wait()
+                stream["events"].pop(seq, None)
         try:
             values = await _invoke()
         except Exception as e:  # noqa: BLE001
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
-            values = [err] * meta["num_returns"]
+            values = [err] * max(1, meta["num_returns"])
         finally:
-            if stream is not None:
-                async with stream["cond"]:
-                    stream["next"] = seq + 1
-                    stream["cond"].notify_all()
+            if stream is not None and seq >= stream["next"]:
+                stream["next"] = seq + 1
+                nxt = stream["events"].get(seq + 1)
+                if nxt is not None:
+                    nxt.set()
+        if meta.get("is_generator"):
+            if isinstance(values, tuple):
+                return values  # _run_generator built the (meta, bufs)
+            # _invoke failed before the stream started: surface the error
+            # as the stream's only item.
+            frames = self.serde.serialize(values[0])
+            self._push_quiet(conn, "generator_item",
+                             {"task_id": meta["task_id"], "index": 0},
+                             [bytes(f) for f in frames])
+            self._push_quiet(conn, "generator_done",
+                             {"task_id": meta["task_id"], "count": 1})
+            return {"returns": [], "generator_count": 1}, []
+        if all(_small_value(v) for v in values):
+            return self._package_returns(meta, values)
         return await loop.run_in_executor(
             self._exec_pool, lambda: self._package_returns(meta, values))
 
@@ -1050,3 +1810,19 @@ class CoreWorker:
                 self.head_call("report_task_events", evs)
             except Exception:
                 pass
+        self.flush_metrics()
+
+    def flush_metrics(self):
+        """Ship this process's metric snapshot to the head."""
+        from .._private.metrics import core_metrics, global_registry
+
+        cm = core_metrics()
+        cm["objects_stored"].set(self.memory_store.size())
+        cm["shm_bytes"].set(self.shm_store.used_bytes())
+        try:
+            self.head_call("report_metrics", {
+                "component": self.worker_id.hex(),
+                "pid": os.getpid(),
+                "snapshot": global_registry().snapshot()})
+        except Exception:  # noqa: BLE001 - metrics are best-effort
+            pass

@@ -108,7 +108,7 @@ class BackendExecutor:
         if self.scaling.num_workers > 1 or self.scaling.use_tpu:
             self.placement_group = rt.placement_group(
                 self.scaling.bundles(),
-                strategy=self.scaling.placement_strategy)
+                strategy=self.scaling.effective_placement_strategy)
             self.placement_group.ready(timeout=60)
         self.worker_group = WorkerGroup(
             self.scaling.num_workers, self.scaling.worker_resources,

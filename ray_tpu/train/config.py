@@ -38,7 +38,32 @@ class ScalingConfig:
         return res
 
     def bundles(self) -> List[Dict[str, float]]:
-        return [dict(self.worker_resources) for _ in range(self.num_workers)]
+        """One bundle per worker; with ``topology`` set, bundle 0 also
+        claims the slice's ``TPU-{topology}-head`` anchor so the whole
+        gang lands on one ICI domain (reference:
+        ``_private/accelerators/tpu.py:363``)."""
+        bs = [dict(self.worker_resources) for _ in range(self.num_workers)]
+        if self.topology:
+            from ray_tpu._private.accelerators import (
+                head_resource_name, parse_topology)
+
+            _, chips = parse_topology(self.topology)
+            if self.use_tpu and self.tpus_per_worker:
+                gang = self.num_workers * self.tpus_per_worker
+                if gang != chips:
+                    raise ValueError(
+                        f"topology {self.topology!r} has {chips} chips but "
+                        f"the gang reserves {self.num_workers} x "
+                        f"{self.tpus_per_worker} = {gang}")
+            bs[0][head_resource_name(self.topology)] = 1.0
+        return bs
+
+    @property
+    def effective_placement_strategy(self) -> str:
+        # A topology gang is one ICI domain: never spread it.
+        if self.topology and self.placement_strategy in ("PACK", "SPREAD"):
+            return "STRICT_PACK"
+        return self.placement_strategy
 
 
 @dataclasses.dataclass

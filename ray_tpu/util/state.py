@@ -1,0 +1,45 @@
+"""State API: typed list_* helpers over the head's state listings.
+
+Capability parity with the reference's state observability API
+(reference: ``python/ray/util/state/api.py`` — list_actors, list_nodes,
+list_workers, list_tasks, list_objects, list_placement_groups, summary),
+served here by one head RPC (``head.py state_listing``) and the
+dashboard's ``/api/state`` endpoint.
+"""
+from __future__ import annotations
+
+from typing import List
+
+
+def _state(kind: str):
+    import ray_tpu as rt
+
+    return rt.state(kind)
+
+
+def list_nodes() -> List[dict]:
+    return _state("nodes")
+
+
+def list_workers() -> List[dict]:
+    return _state("workers")
+
+
+def list_actors() -> List[dict]:
+    return _state("actors")
+
+
+def list_placement_groups() -> List[dict]:
+    return _state("placement_groups")
+
+
+def list_tasks() -> List[dict]:
+    return _state("tasks")
+
+
+def list_objects() -> dict:
+    return _state("objects")
+
+
+def summarize_cluster() -> dict:
+    return _state("summary")

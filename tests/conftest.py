@@ -13,7 +13,81 @@ from ray_tpu.testing import force_host_devices  # noqa: E402
 force_host_devices(8)
 os.environ.setdefault("RT_HEALTH_CHECK_PERIOD_S", "0.2")
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Hang watchdog: any single test running >120s dumps every thread's stack
+# AND every asyncio task's coroutine stack (the part thread dumps can't see)
+# to /tmp/rt_stacks_<pid>.txt (pytest's fd capture would swallow stderr).
+_stack_dump_file = open(f"/tmp/rt_stacks_{os.getpid()}.txt", "w")
+
+
+def _dump_asyncio_tasks():
+    import asyncio
+    import threading as _threading
+
+    f = _stack_dump_file
+
+    loops = []
+    try:
+        from ray_tpu.core.worker import CoreWorker
+
+        core = CoreWorker._current
+        if core is not None and core._loop is not None:
+            loops.append(("core", core._loop))
+    except Exception:
+        pass
+    try:
+        from ray_tpu import api as _api
+
+        ht = _api._global_state.get("head_thread")
+        if ht is not None and ht._loop is not None:
+            loops.append(("head", ht._loop))
+    except Exception:
+        pass
+
+    for name, loop in loops:
+        done = _threading.Event()
+
+        def dump(name=name, loop=loop, done=done):
+            try:
+                print(f"--- asyncio tasks: {name} loop ---", file=f)
+                for t in asyncio.all_tasks(loop):
+                    print(repr(t), file=f)
+                    t.print_stack(file=f)
+            finally:
+                f.flush()
+                done.set()
+
+        try:
+            loop.call_soon_threadsafe(dump)
+            done.wait(5)
+        except Exception:
+            pass
+    f.flush()
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    import threading as _threading
+
+    done = _threading.Event()
+
+    def watch():
+        if not done.wait(120):
+            print(f"=== WATCHDOG: {item.nodeid} hung ===",
+                  file=_stack_dump_file)
+            faulthandler.dump_traceback(file=_stack_dump_file,
+                                        all_threads=True)
+            _dump_asyncio_tasks()
+
+    t = _threading.Thread(target=watch, daemon=True)
+    t.start()
+    try:
+        return (yield)
+    finally:
+        done.set()
 
 
 @pytest.fixture

@@ -1,0 +1,139 @@
+"""Observability plane: metrics, prometheus exposition, state API,
+dashboard HTTP endpoint, chrome-trace timeline, CLI.
+
+Mirrors the reference's stats/state/dashboard coverage
+(``python/ray/tests/test_metrics_agent.py``, ``test_state_api.py``):
+instruments aggregate across processes, exposition parses, and the state
+listings reflect live cluster entities.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from ray_tpu._private import metrics as m
+
+
+def test_registry_instruments():
+    reg = m.MetricsRegistry()
+    c = m.Counter("reqs_total", "requests", registry=reg)
+    g = m.Gauge("depth", registry=reg)
+    h = m.Histogram("lat_seconds", bounds=(0.1, 1.0), registry=reg)
+    c.inc()
+    c.inc(2, labels={"route": "/a"})
+    g.set(7)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = reg.snapshot()
+    assert snap["reqs_total"]["kind"] == "counter"
+    merged = m.merge_snapshots([snap, snap])  # two identical processes
+    text = m.render_prometheus(merged)
+    assert "ray_tpu_reqs_total 2" in text          # summed counters
+    assert 'route="/a"' in text
+    assert "ray_tpu_depth 7" in text               # gauge not summed
+    assert 'lat_seconds_bucket{le="0.1"} 2' in text
+    assert "lat_seconds_count 6" in text
+
+
+def test_cluster_metrics_and_state(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def work(x):
+        return x * 2
+
+    assert rt.get([work.remote(i) for i in range(10)]) == \
+        [i * 2 for i in range(10)]
+    # Worker snapshots arrive on the ~1s flush cadence; poll briefly.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        text = rt.metrics_text()
+        if "ray_tpu_task_duration_seconds_bucket" in text:
+            break
+        time.sleep(0.25)
+    else:
+        pytest.fail("worker metrics never reached the head")
+    assert "ray_tpu_tasks_finished_total" in text
+    assert "ray_tpu_workers_alive" in text
+
+    summary = rt.state("summary")
+    assert summary["workers"] >= 1
+    assert summary["resources_total"]["CPU"] == 8.0
+    nodes = rt.state("nodes")
+    assert any(n["is_head"] for n in nodes)
+    workers = rt.state("workers")
+    assert len(workers) >= 1
+    # Workers flush task events on a ~1s cadence; poll briefly.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if any(t["name"] == "work" for t in rt.state("tasks")):
+            break
+        time.sleep(0.25)
+    else:
+        pytest.fail("task events never reached the head")
+
+
+def test_dashboard_http(rt_cluster):
+    rt = rt_cluster
+    url = rt.dashboard_url()
+    assert url and url.startswith("http://127.0.0.1:")
+
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+        body = resp.read().decode()
+    assert "ray_tpu_workers_alive" in body
+
+    with urllib.request.urlopen(url + "/api/state?kind=summary",
+                                timeout=10) as resp:
+        summary = json.loads(resp.read())
+    assert summary["nodes"] >= 1
+
+    with urllib.request.urlopen(url + "/api/timeline", timeout=10) as resp:
+        events = json.loads(resp.read())
+    assert isinstance(events, list)
+
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(url + "/nope", timeout=10)
+
+
+def test_chrome_timeline(rt_cluster):
+    rt = rt_cluster
+
+    @rt.remote
+    def traced():
+        return 1
+
+    rt.get(traced.remote())
+    deadline = time.time() + 10
+    events = []
+    while time.time() < deadline and not events:
+        events = rt.timeline(format="chrome")
+        time.sleep(0.25)
+    assert events, "no timeline events"
+    ev = events[-1]
+    assert ev["ph"] == "X" and ev["ts"] > 0 and ev["dur"] >= 0
+
+
+def test_cli_status_and_list(rt_cluster):
+    rt = rt_cluster
+    from ray_tpu.core.worker import CoreWorker
+
+    session_dir = CoreWorker.current().session_dir
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "--session-dir", session_dir,
+         "status"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "workers:" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "--session-dir", session_dir,
+         "list", "nodes"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)[0]["node_id"]
