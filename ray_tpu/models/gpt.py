@@ -43,6 +43,13 @@ class GPTConfig:
     remat: Any = "dots"              # none|dots|full (bool accepted)
     attn_backend: str = "auto"       # auto | xla | flash | ring
     sp_axis: Optional[str] = None    # mesh axis for ring attention
+    pp_axis: Optional[str] = None    # mesh axis for pipeline parallelism
+    num_microbatches: int = 0        # pp microbatches (0 → 2 * pp size)
+    n_experts: int = 0               # >0 → MoE FFN in every block
+    expert_top_k: int = 2            # tokens routed to k experts
+    capacity_factor: float = 1.25    # per-expert slots = cf*k*T/E
+    moe_aux_coef: float = 0.01       # load-balance loss weight
+    ep_axis: Optional[str] = "ep"    # mesh axis sharding the expert dim
 
     @property
     def head_dim(self) -> int:
@@ -86,20 +93,36 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Params:
         return jnp.stack([_dense_init(k, shape, pd, scale) for k in ks])
 
     resid_scale = 1.0 / math.sqrt(2 * L * d)
+    block = {
+        "ln1_scale": jnp.ones((L, d), pd),
+        "ln2_scale": jnp.ones((L, d), pd),
+        "wq": {"kernel": stack(keys[2], (d, d))},
+        "wk": {"kernel": stack(keys[3], (d, d))},
+        "wv": {"kernel": stack(keys[4], (d, d))},
+        "wo": {"kernel": stack(keys[5], (d, d), resid_scale)},
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        ks = jax.random.split(keys[6], 3)
+
+        def stack_e(key, shape, scale=None):
+            kk = jax.random.split(key, L)
+            return jnp.stack([
+                jnp.stack([_dense_init(k2, shape, pd, scale)
+                           for k2 in jax.random.split(k, E)])
+                for k in kk])
+
+        block["router"] = {"kernel": stack(ks[0], (d, E), 0.02)}
+        block["w_up"] = {"kernel": stack_e(ks[1], (d, f))}
+        block["w_down"] = {"kernel": stack_e(ks[2], (f, d), resid_scale)}
+    else:
+        block["w1"] = {"kernel": stack(keys[6], (d, f))}
+        block["w2"] = {"kernel": stack(keys[7], (f, d), resid_scale)}
     return {
         "embed": {"kernel": _dense_init(keys[0], (cfg.vocab_size, d), pd,
                                         scale=0.02)},
         "pos_embed": _dense_init(keys[1], (cfg.max_seq, d), pd, scale=0.01),
-        "block": {
-            "ln1_scale": jnp.ones((L, d), pd),
-            "ln2_scale": jnp.ones((L, d), pd),
-            "wq": {"kernel": stack(keys[2], (d, d))},
-            "wk": {"kernel": stack(keys[3], (d, d))},
-            "wv": {"kernel": stack(keys[4], (d, d))},
-            "wo": {"kernel": stack(keys[5], (d, d), resid_scale)},
-            "w1": {"kernel": stack(keys[6], (d, f))},
-            "w2": {"kernel": stack(keys[7], (f, d), resid_scale)},
-        },
+        "block": block,
         "ln_f_scale": jnp.ones((d,), pd),
     }
 
@@ -199,7 +222,11 @@ def _attention(q, k, v, cfg: GPTConfig, mesh=None):
 
 
 def _block(x, layer_params, cfg: GPTConfig, mesh=None):
-    """One transformer block; ``layer_params`` leaves have no layer dim."""
+    """One transformer block → (x, aux_loss).
+
+    ``layer_params`` leaves have no layer dim. ``aux_loss`` is the MoE
+    load-balance term (0 for dense FFN).
+    """
     B, S, d = x.shape
     H, hd = cfg.n_head, cfg.head_dim
     p = layer_params
@@ -210,18 +237,29 @@ def _block(x, layer_params, cfg: GPTConfig, mesh=None):
     att = _attention(q, k, v, cfg, mesh).reshape(B, S, d)
     x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
     h = _rmsnorm(x, p["ln2_scale"])
+    if cfg.n_experts > 0:
+        from ray_tpu.models.moe import moe_ffn
+
+        y, aux = moe_ffn(
+            h, p["router"]["kernel"], p["w_up"]["kernel"],
+            p["w_down"]["kernel"], top_k=cfg.expert_top_k,
+            capacity_factor=cfg.capacity_factor, dtype=cfg.dtype,
+            ep_axis=cfg.ep_axis, mesh=mesh)
+        return x + y, aux
     h = _mm(h, p["w1"]["kernel"], cfg.dtype)
     h = jax.nn.gelu(h)
     x = x + _mm(h, p["w2"]["kernel"], cfg.dtype)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
-            mesh=None) -> jax.Array:
+            mesh=None, *, return_aux: bool = False):
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
 
     ``mesh`` is only needed for shard_map attention backends (ring,
-    ulysses); GSPMD backends (xla, flash) ignore it.
+    ulysses) and MoE/PP sharding constraints; plain GSPMD backends (xla,
+    flash) ignore it. With ``return_aux`` also returns a dict of auxiliary
+    losses (MoE load balance).
     """
     B, S = tokens.shape
     x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
@@ -242,14 +280,33 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
     elif remat != "none":
         raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
-    def scan_body(carry, layer_params):
-        return block_fn(carry, layer_params, cfg, mesh), None
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.pp_axis and mesh is not None and cfg.pp_axis in mesh.axis_names:
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "MoE inside a pipeline stage is not supported yet; use an "
+                "{ep, dp} mesh for expert parallelism")
+        from ray_tpu.parallel.pipeline import pipeline_apply
 
-    x, _ = lax.scan(scan_body, x, params["block"])
+        # Inside the pipeline body each stage runs single-device math
+        # (mesh=None): GSPMD does not reach under the shard_map.
+        x = pipeline_apply(
+            lambda act, lp: block_fn(act, lp, cfg, None)[0],
+            params["block"], x, mesh=mesh, pp_axis=cfg.pp_axis,
+            num_microbatches=cfg.num_microbatches)
+    else:
+        def scan_body(carry, layer_params):
+            out, a = block_fn(carry, layer_params, cfg, mesh)
+            return out, a
+
+        x, layer_aux = lax.scan(scan_body, x, params["block"])
+        aux = jnp.sum(layer_aux)
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = lax.dot_general(
         x.astype(cfg.dtype), params["embed"]["kernel"].astype(cfg.dtype),
         (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    if return_aux:
+        return logits, {"moe_aux": aux}
     return logits
 
 
@@ -261,11 +318,15 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         tokens, targets = batch["tokens"], batch["targets"]
     else:
         tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    logits = forward(params, tokens, cfg, mesh)
+    logits, aux = forward(params, tokens, cfg, mesh, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     loss = -jnp.mean(ll)
-    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
+    metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_coef * aux["moe_aux"]
+        metrics["moe_aux"] = aux["moe_aux"]
+    return loss, metrics
 
 
 # ------------------------------------------------------------- train step
@@ -285,7 +346,9 @@ def make_train_step(cfg: GPTConfig, mesh, optimizer=None, *,
 
     if optimizer is None:
         optimizer = optax.adamw(3e-4, weight_decay=0.01)
-    rules = rules if rules is not None else shr.LM_RULES
+    if rules is None:
+        pp_mode = cfg.pp_axis and cfg.pp_axis in mesh.axis_names
+        rules = shr.PP_LM_RULES if pp_mode else shr.LM_RULES
 
     def init(rng):
         params = init_params(rng, cfg)

@@ -1,0 +1,116 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+Capability parity with the reference's pipeline-parallel training support
+(the reference delegates PP to torch/DeepSpeed through Train's backend,
+e.g. ``python/ray/train/torch/config.py``); on TPU the schedule is built
+from XLA collectives directly: layers are sharded over ``pp`` (each rank
+holds a contiguous stage of the stacked-layer pytree) and activations flow
+stage-to-stage with ``lax.ppermute`` inside a ``shard_map`` — the
+collective-permute pipeline pattern that maps onto neighboring ICI links.
+
+Schedule (GPipe):
+    step t: stage p processes microbatch (t - p); M + P - 1 total steps;
+    bubble fraction (P-1)/(M+P-1). Backward is the transposed pipeline
+    automatically — the autodiff transpose of ``ppermute`` is the reverse
+    ``ppermute``, so one ``jax.grad`` of this forward IS the backward
+    schedule.
+
+Composition: ``pp`` composes with ``dp``/``fsdp`` batch axes (batch is
+sharded outside, every pp stage sees its dp shard). ``tp``/``sp`` inside a
+pipeline stage would need manual per-matmul collectives in the block body
+and are rejected for now.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _stage_spec(leaf, pp_axis: str):
+    """PartitionSpec sharding only the leading (layer) dim over pp."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(pp_axis, *([None] * (leaf.ndim - 1)))
+
+
+def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
+                   *, mesh, pp_axis: str = "pp",
+                   num_microbatches: int = 0) -> jax.Array:
+    """Run ``x`` through L stacked layers pipelined over the pp axis.
+
+    ``block_fn(act, layer_params) -> act`` is one transformer block;
+    ``stacked_params`` is a pytree whose leaves have leading dim L with
+    L % pp == 0 (stage s owns layers [s*L/P, (s+1)*L/P)).
+    ``x`` is [B, S, d] with the batch dim (optionally) sharded over
+    dp/fsdp; it must NOT be sharded over pp.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+    if pp_axis not in names:
+        raise ValueError(f"mesh has no {pp_axis!r} axis: {mesh.axis_names}")
+    for bad in ("tp", "sp"):
+        if bad in names:
+            raise ValueError(
+                f"pipeline_apply does not compose with {bad!r} yet; use a "
+                "{dp, fsdp, pp} mesh")
+    pp_size = mesh.shape[pp_axis]
+    num_mb = num_microbatches or 2 * pp_size
+
+    bt = tuple(a for a in ("dp", "fsdp") if a in names) or None
+    x_spec = P(bt, None, None)
+    param_specs = jax.tree.map(lambda l: _stage_spec(l, pp_axis),
+                               stacked_params)
+
+    def body(params_local, x_local):
+        P_ = pp_size  # static: mesh shape is known at trace time
+        p = lax.axis_index(pp_axis)
+        B_loc, S, d = x_local.shape
+        if B_loc % num_mb:
+            raise ValueError(
+                f"per-shard batch {B_loc} not divisible by "
+                f"num_microbatches={num_mb}")
+        mb = B_loc // num_mb
+        x_mbs = x_local.reshape(num_mb, mb, S, d)
+
+        def stage(act):
+            def scan_body(carry, layer_params):
+                return block_fn(carry, layer_params), None
+
+            out, _ = lax.scan(scan_body, act, params_local)
+            return out
+
+        fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+        def step(carry, t):
+            prev_out, outbuf = carry
+            recv = lax.ppermute(prev_out, pp_axis, fwd_perm)
+            feed = lax.dynamic_index_in_dim(
+                x_mbs, jnp.clip(t, 0, num_mb - 1), keepdims=False)
+            act_in = jnp.where(p == 0, feed, recv)
+            out = stage(act_in)
+            # Stage P-1 finishes microbatch (t - (P-1)) at step t; other
+            # ranks write garbage slots that the masked psum below zeroes.
+            out_idx = jnp.clip(t - (P_ - 1), 0, num_mb - 1)
+            valid = (t >= P_ - 1) & (t - (P_ - 1) < num_mb)
+            cur = lax.dynamic_index_in_dim(outbuf, out_idx, keepdims=False)
+            outbuf = lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(valid, out, cur), out_idx, 0)
+            return (out, outbuf), None
+
+        act0 = jnp.zeros((mb, S, d), x_local.dtype)
+        outbuf0 = jnp.zeros((num_mb, mb, S, d), x_local.dtype)
+        (_, outbuf), _ = lax.scan(step, (act0, outbuf0),
+                                  jnp.arange(num_mb + P_ - 1))
+        # Only the last stage's buffer is real; masked psum broadcasts it
+        # to every pp rank (exact: all other contributions are 0).
+        outbuf = lax.psum(
+            jnp.where(p == P_ - 1, outbuf, jnp.zeros_like(outbuf)), pp_axis)
+        return outbuf.reshape(B_loc, S, d)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(param_specs, x_spec),
+        out_specs=x_spec, check_vma=False)(stacked_params, x)

@@ -92,12 +92,23 @@ LM_RULES: List[PartitionRule] = [
     (r"embed/kernel", (("fsdp",), "tp")),          # [vocab, d] row-shard
     (r"(wq|wk|wv)/kernel", (("fsdp",), "tp")),     # [d, heads*hd] col-shard
     (r"wo/kernel", ("tp", ("fsdp",))),             # [heads*hd, d]
+    (r"router/kernel", (("fsdp",),)),              # [L, d, E] small, L-shard
+    (r"w_up/kernel", (("fsdp",), "ep", None, "tp")),   # [L, E, d, f]
+    (r"w_down/kernel", (("fsdp",), "ep", "tp")),       # [L, E, f, d]
     (r"(w1|wi|up|gate)/kernel", (("fsdp",), "tp")),
     (r"(w2|wo_ff|down)/kernel", ("tp", ("fsdp",))),
     (r"head/kernel", (("fsdp",), "tp")),
     (r"pos_embed", (None, ("fsdp",))),
     (r"(bias|scale|norm)", (None,)),
     (r".*", ()),                                   # replicate the rest
+]
+
+# Pipeline parallel: stacked block layers sharded over pp on the layer
+# (leading) dim, everything else replicated (or dp-replicated). Matches
+# pipeline_apply's stage ownership.
+PP_LM_RULES: List[PartitionRule] = [
+    (r"block/", ("pp",)),
+    (r".*", ()),
 ]
 
 # Pure data-parallel: everything replicated.
