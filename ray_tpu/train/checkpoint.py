@@ -22,17 +22,34 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class Checkpoint:
-    """A directory of files; the unit of save/restore."""
+    """A directory of files; the unit of save/restore.
+
+    ``path`` may be a local directory or a storage URI (``mock://…``,
+    ``file://…`` — see :mod:`ray_tpu.train.storage`); URI-backed
+    checkpoints download to a local cache on first ``as_directory()``.
+    """
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+        from .storage import is_uri
+
+        self.path = path if is_uri(path) else os.path.abspath(path)
+        self._local_cache: Optional[str] = None
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
 
     def as_directory(self) -> str:
-        return self.path
+        from .storage import get_filesystem, is_uri
+
+        if not is_uri(self.path):
+            return self.path
+        if self._local_cache is None or not os.path.exists(
+                self._local_cache):
+            fs, _ = get_filesystem(self.path)
+            cache = tempfile.mkdtemp(prefix="ckpt_dl_")
+            self._local_cache = fs.download_dir(self.path, cache)
+        return self._local_cache
 
     def __repr__(self):
         return f"Checkpoint({self.path})"
@@ -66,7 +83,7 @@ class Checkpoint:
         if its leaves are jax arrays with shardings)."""
         import numpy as np
 
-        with np.load(os.path.join(self.path, f"{name}.npz")) as z:
+        with np.load(os.path.join(self.as_directory(), f"{name}.npz")) as z:
             leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
         if like is None:
             return leaves
@@ -99,8 +116,14 @@ class CheckpointManager:
                  num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None,
                  score_order: str = "max"):
+        from .storage import get_filesystem, is_uri
+
         self.storage_dir = storage_dir
-        os.makedirs(storage_dir, exist_ok=True)
+        if is_uri(storage_dir):
+            fs, _ = get_filesystem(storage_dir)
+            fs.makedirs(storage_dir)
+        else:
+            os.makedirs(storage_dir, exist_ok=True)
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
@@ -139,7 +162,17 @@ class CheckpointManager:
             if worst is self._tracked[-1]:
                 worst = min(self._tracked[:-1], key=self._score)
             self._tracked.remove(worst)
-            shutil.rmtree(worst.checkpoint.path, ignore_errors=True)
+            self._delete(worst.checkpoint)
+
+    @staticmethod
+    def _delete(ckpt: Checkpoint):
+        from .storage import get_filesystem, is_uri
+
+        if is_uri(ckpt.path):
+            fs, _ = get_filesystem(ckpt.path)
+            fs.rmtree(ckpt.path)
+        else:
+            shutil.rmtree(ckpt.path, ignore_errors=True)
 
     @property
     def best_checkpoint(self) -> Optional[Checkpoint]:

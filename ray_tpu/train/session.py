@@ -52,21 +52,29 @@ class _TrainSession:
         }
         if checkpoint is not None:
             # Persist to the checkpoint's FINAL immutable location from the
-            # worker itself — the driver only tracks paths, never moves
-            # them (reference storage.py flow), so get_checkpoint() stays
-            # valid for the whole run.
+            # worker itself — the driver only tracks paths/URIs, never
+            # relays checkpoint bytes (reference storage.py flow), so
+            # get_checkpoint() stays valid for the whole run.
             if self.storage_dir:
-                os.makedirs(self.storage_dir, exist_ok=True)
+                from .storage import get_filesystem, is_uri
+
                 # incarnation in the name: a restarted group's indices
                 # begin at 0 again and must not overwrite tracked dirs
-                dst = os.path.join(
-                    self.storage_dir,
-                    f"checkpoint_rank{self.world_rank}_"
-                    f"i{self.incarnation}_{self._report_idx:06d}")
-                if os.path.abspath(checkpoint.path) != dst:
-                    if os.path.exists(dst):
-                        shutil.rmtree(dst)
-                    shutil.move(checkpoint.path, dst)
+                name = (f"checkpoint_rank{self.world_rank}_"
+                        f"i{self.incarnation}_{self._report_idx:06d}")
+                if is_uri(self.storage_dir):
+                    # Remote/shared storage: the worker uploads directly.
+                    fs, _ = get_filesystem(self.storage_dir)
+                    dst = fs.join(self.storage_dir, name)
+                    fs.upload_dir(checkpoint.path, dst)
+                    shutil.rmtree(checkpoint.path, ignore_errors=True)
+                else:
+                    os.makedirs(self.storage_dir, exist_ok=True)
+                    dst = os.path.join(self.storage_dir, name)
+                    if os.path.abspath(checkpoint.path) != dst:
+                        if os.path.exists(dst):
+                            shutil.rmtree(dst)
+                        shutil.move(checkpoint.path, dst)
                 checkpoint = Checkpoint(dst)
             payload["checkpoint"] = checkpoint.to_dict()
             self.latest_checkpoint = checkpoint
@@ -76,10 +84,19 @@ class _TrainSession:
             if self.world_rank != 0 and self.storage_dir:
                 self._own_ckpts.append(checkpoint.path)
                 while len(self._own_ckpts) > 2:
-                    shutil.rmtree(self._own_ckpts.pop(0),
-                                  ignore_errors=True)
+                    self._drop_own(self._own_ckpts.pop(0))
         self._report_idx += 1
         self.result_queue.put(payload)
+
+    @staticmethod
+    def _drop_own(path: str):
+        from .storage import get_filesystem, is_uri
+
+        if is_uri(path):
+            fs, _ = get_filesystem(path)
+            fs.rmtree(path)
+        else:
+            shutil.rmtree(path, ignore_errors=True)
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.latest_checkpoint

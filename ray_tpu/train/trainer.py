@@ -151,10 +151,18 @@ class DataParallelTrainer(BaseTrainer):
 
     # -- storage ----------------------------------------------------------
     def _experiment_dir(self) -> str:
+        from .storage import get_filesystem, is_uri
+
         name = self.run_config.name or \
             f"{type(self).__name__}_{uuid.uuid4().hex[:8]}"
-        d = os.path.join(self.run_config.resolved_storage_path(), name)
-        os.makedirs(d, exist_ok=True)
+        base = self.run_config.resolved_storage_path()
+        if is_uri(base):
+            fs, _ = get_filesystem(base)
+            d = fs.join(base, name)
+            fs.makedirs(d)
+        else:
+            d = os.path.join(base, name)
+            os.makedirs(d, exist_ok=True)
         return d
 
     def fit(self) -> Result:

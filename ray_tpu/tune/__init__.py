@@ -12,6 +12,11 @@ from ray_tpu.train.session import (  # noqa: F401
     report,
 )
 from .controller import TuneController  # noqa: F401
+from .loggers import (  # noqa: F401
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+)
 from .schedulers import (  # noqa: F401
     AsyncHyperBandScheduler,
     FIFOScheduler,

@@ -44,6 +44,7 @@ class Trial:
         self.error: Optional[str] = None
         self.actor = None
         self.iteration = 0
+        self.premature = False  # stopped by budget/kill, not by decision
         self.dir = os.path.join(exp_dir, trial_id)
         os.makedirs(self.dir, exist_ok=True)
 
@@ -54,6 +55,7 @@ class Trial:
             "iteration": self.iteration,
             "checkpoint": self.checkpoint.path if self.checkpoint else None,
             "error": self.error,
+            "premature": self.premature,
         }
 
 
@@ -74,7 +76,9 @@ class TuneController:
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  exp_dir: str = "/tmp/ray_tpu_tune",
                  time_budget_s: Optional[float] = None,
-                 trial_start_timeout_s: float = 120.0):
+                 trial_start_timeout_s: float = 120.0,
+                 callbacks: Optional[list] = None,
+                 restored_trials: Optional[List[dict]] = None):
         self.trainable = trainable
         self.searcher = searcher or BasicVariantGenerator(
             num_samples=num_samples)
@@ -88,6 +92,41 @@ class TuneController:
         self.time_budget_s = time_budget_s
         self.trial_start_timeout_s = trial_start_timeout_s
         self._exhausted = False
+        self._last_save = 0.0
+        if callbacks is None:
+            from .loggers import DEFAULT_CALLBACKS
+
+            callbacks = [cls() for cls in DEFAULT_CALLBACKS]
+        self.callbacks = callbacks
+        # Experiment resume (reference: experiment_state.py resume flow):
+        # finished trials are adopted as records; unfinished ones re-run
+        # from their latest checkpoint.
+        self._resume_queue: List[Trial] = []
+        for rec in restored_trials or []:
+            self.searcher.suggest(rec["trial_id"])  # keep sample counting
+            trial = Trial(rec["trial_id"], rec["config"], exp_dir)
+            trial.iteration = rec.get("iteration", 0)
+            trial.last_result = rec.get("last_result") or {}
+            if trial.last_result:
+                trial.metrics_history.append(trial.last_result)
+            if rec.get("checkpoint"):
+                trial.checkpoint = Checkpoint(rec["checkpoint"])
+            if rec["status"] == STOPPED and rec.get("premature"):
+                trial.status = PENDING
+                self._resume_queue.append(trial)
+            elif rec["status"] in (TERMINATED, STOPPED):
+                trial.status = rec["status"]
+                self.trials.append(trial)
+                self.searcher.on_trial_complete(trial.trial_id,
+                                                trial.last_result)
+            elif rec["status"] == ERROR and not rec.get("resume_errored"):
+                trial.status = ERROR
+                trial.error = rec.get("error")
+                self.trials.append(trial)
+                self.searcher.on_trial_complete(trial.trial_id, error=True)
+            else:
+                trial.status = PENDING
+                self._resume_queue.append(trial)
 
     # ------------------------------------------------------------ actors
     def _launch(self, trial: Trial,
@@ -129,27 +168,40 @@ class TuneController:
                     if t.status == RUNNING:
                         self._stop_actor(t)
                         t.status = STOPPED
+                        t.premature = True  # resumable, unlike a STOP
                 break
             self._fill_slots()
             progressed = self._poll_running()
+            if progressed and time.time() - self._last_save > 2.0:
+                self.save_state()  # crash/kill → resumable snapshot
             if self._all_done():
                 break
             if not progressed:
                 time.sleep(0.05)
         self.save_state()
+        for cb in self.callbacks:
+            cb.on_experiment_end(self.trials)
         return self.trials
 
     def _running(self) -> List[Trial]:
         return [t for t in self.trials if t.status == RUNNING]
 
     def _all_done(self) -> bool:
-        if self._running():
+        if self._running() or self._resume_queue:
             return False
         if self._exhausted:
             return True
         return False
 
     def _fill_slots(self):
+        # Resumed trials re-launch first (from their latest checkpoint).
+        while self._resume_queue and \
+                len(self._running()) < self.max_concurrent:
+            trial = self._resume_queue.pop(0)
+            self.trials.append(trial)
+            self._launch(trial, resume_checkpoint=trial.checkpoint)
+            for cb in self.callbacks:
+                cb.on_trial_start(trial)
         while len(self._running()) < self.max_concurrent and \
                 not self._exhausted:
             trial_id = f"trial_{len(self.trials):04d}_{uuid.uuid4().hex[:6]}"
@@ -162,6 +214,8 @@ class TuneController:
             trial = Trial(trial_id, cfg, self.exp_dir)
             self.trials.append(trial)
             self._launch(trial)
+            for cb in self.callbacks:
+                cb.on_trial_start(trial)
 
     def _poll_running(self) -> bool:
         progressed = False
@@ -180,6 +234,7 @@ class TuneController:
                         self._stop_actor(trial)
                         self.searcher.on_trial_complete(trial.trial_id,
                                                         error=True)
+                        self._notify_complete(trial)
                         progressed = True
                     continue
                 trial._start_ref = None
@@ -191,6 +246,7 @@ class TuneController:
                     self._stop_actor(trial)
                     self.searcher.on_trial_complete(trial.trial_id,
                                                     error=True)
+                    self._notify_complete(trial)
                     progressed = True
                     continue
             try:
@@ -201,6 +257,7 @@ class TuneController:
                 trial.error = f"actor failure: {e!r}"
                 self._stop_actor(trial)
                 self.searcher.on_trial_complete(trial.trial_id, error=True)
+                self._notify_complete(trial)
                 continue
             relaunched = False
             for item in items:
@@ -211,6 +268,7 @@ class TuneController:
                     trial.status = STOPPED
                     self.searcher.on_trial_complete(
                         trial.trial_id, trial.last_result)
+                    self._notify_complete(trial)
                     break
                 donor_id = getattr(trial, "_pbt_exploit", None)
                 if donor_id:
@@ -228,6 +286,7 @@ class TuneController:
                 trial.error = err
                 self._stop_actor(trial)
                 self.searcher.on_trial_complete(trial.trial_id, error=True)
+                self._notify_complete(trial)
                 progressed = True
             elif done:
                 trial.status = TERMINATED
@@ -235,6 +294,7 @@ class TuneController:
                 self.scheduler.on_trial_complete(trial, trial.last_result)
                 self.searcher.on_trial_complete(
                     trial.trial_id, trial.last_result)
+                self._notify_complete(trial)
                 progressed = True
         return progressed
 
@@ -256,6 +316,8 @@ class TuneController:
         trial.metrics_history.append(result)
         trial.last_result = result
         self.searcher.on_trial_result(trial.trial_id, result)
+        for cb in self.callbacks:
+            cb.on_trial_result(trial, result)
         return self.scheduler.on_trial_result(trial, result)
 
     def _exploit(self, trial: Trial, donor_id: str) -> bool:
@@ -283,15 +345,39 @@ class TuneController:
         self._launch(trial, resume_checkpoint=Checkpoint(snap))
         return True
 
+    def _notify_complete(self, trial: Trial):
+        for cb in self.callbacks:
+            cb.on_trial_complete(trial)
+
     # ------------------------------------------------------------- state
     def save_state(self):
-        path = os.path.join(self.exp_dir, "experiment_state.json")
-        with open(path, "w") as f:
-            json.dump({"trials": [t.to_json() for t in self.trials],
-                       "timestamp": time.time()}, f, indent=1)
+        """Atomic experiment snapshot: human-readable JSON + a pickle that
+        round-trips configs exactly (restore reads the pickle)."""
+        import cloudpickle
+
+        self._last_save = time.time()
+        recs = [t.to_json() for t in self.trials]
+        jpath = os.path.join(self.exp_dir, "experiment_state.json")
+        with open(jpath + ".tmp", "w") as f:
+            json.dump({"trials": recs, "timestamp": time.time()}, f,
+                      indent=1)
+        os.replace(jpath + ".tmp", jpath)
+        for rec, t in zip(recs, self.trials):
+            rec["config"] = t.config  # exact object for the pickle
+        blob = cloudpickle.dumps({"trials": recs, "timestamp": time.time()})
+        ppath = os.path.join(self.exp_dir, "experiment_state.pkl")
+        with open(ppath + ".tmp", "wb") as f:
+            f.write(blob)
+        os.replace(ppath + ".tmp", ppath)
 
     @staticmethod
     def load_state(exp_dir: str) -> List[dict]:
+        ppath = os.path.join(exp_dir, "experiment_state.pkl")
+        if os.path.exists(ppath):
+            import cloudpickle
+
+            with open(ppath, "rb") as f:
+                return cloudpickle.loads(f.read())["trials"]
         path = os.path.join(exp_dir, "experiment_state.json")
         with open(path) as f:
             return json.load(f)["trials"]

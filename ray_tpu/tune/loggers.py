@@ -1,0 +1,85 @@
+"""Trial loggers + callback hooks (reference:
+``python/ray/tune/logger/`` CSVLoggerCallback/JsonLoggerCallback and
+``tune/callback.py`` Callback).
+
+Callbacks observe the controller's trial lifecycle; the bundled loggers
+write per-trial ``progress.csv`` / ``result.json`` files into each trial
+dir, which is what downstream tooling (pandas, tensorboard ingestion)
+reads.
+"""
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    """Experiment lifecycle hooks; subclass and override what you need."""
+
+    def on_trial_start(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial) -> None:
+        pass
+
+    def on_experiment_end(self, trials: List) -> None:
+        pass
+
+
+def _flat(result: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in result.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                out[f"{k}/{k2}"] = v2
+        elif isinstance(v, (int, float, str, bool)) or v is None:
+            out[k] = v
+    return out
+
+
+class JsonLoggerCallback(Callback):
+    """Appends one JSON line per result to ``<trial_dir>/result.json``."""
+
+    def on_trial_result(self, trial, result):
+        with open(os.path.join(trial.dir, "result.json"), "a") as f:
+            f.write(json.dumps(_flat(result)) + "\n")
+
+
+class CSVLoggerCallback(Callback):
+    """Writes ``<trial_dir>/progress.csv``; columns fixed by first result."""
+
+    def __init__(self):
+        self._writers: Dict[str, tuple] = {}
+
+    def on_trial_result(self, trial, result):
+        flat = _flat(result)
+        ent = self._writers.get(trial.trial_id)
+        if ent is None:
+            f = open(os.path.join(trial.dir, "progress.csv"), "w",
+                     newline="")
+            w = csv.DictWriter(f, fieldnames=list(flat.keys()),
+                               extrasaction="ignore")
+            w.writeheader()
+            ent = (f, w)
+            self._writers[trial.trial_id] = ent
+        f, w = ent
+        w.writerow(flat)
+        f.flush()
+
+    def on_trial_complete(self, trial):
+        ent = self._writers.pop(trial.trial_id, None)
+        if ent:
+            ent[0].close()
+
+    def on_experiment_end(self, trials):
+        for f, _ in self._writers.values():
+            f.close()
+        self._writers.clear()
+
+
+DEFAULT_CALLBACKS = (JsonLoggerCallback, CSVLoggerCallback)

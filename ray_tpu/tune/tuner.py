@@ -106,17 +106,61 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_path: Optional[str] = None
+        self._resume_errored = False
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                resume_errored: bool = False,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Rebuild a Tuner from a (possibly crashed) experiment directory
+        (reference: ``tuner.py Tuner.restore`` over experiment_state).
+
+        Finished trials are adopted as results; unfinished (and, with
+        ``resume_errored``, failed) trials re-run from their latest
+        checkpoint. ``trainable`` must be re-supplied — code does not
+        live in the snapshot. ``tune_config`` overrides the saved one
+        (e.g. to lift the time budget that cut the original run short).
+        """
+        import cloudpickle
+
+        with open(os.path.join(path, "tuner.pkl"), "rb") as f:
+            saved = cloudpickle.loads(f.read())
+        tuner = cls(trainable, param_space=saved["param_space"],
+                    tune_config=tune_config or saved["tune_config"],
+                    run_config=saved["run_config"])
+        tuner.run_config.name = os.path.basename(path.rstrip("/"))
+        tuner._restore_path = path
+        tuner._resume_errored = resume_errored
+        return tuner
 
     def fit(self) -> ResultGrid:
+        import cloudpickle
+
         import ray_tpu as rt
 
         if not rt.is_initialized():
             rt.init(ignore_reinit_error=True)
-        name = self.run_config.name or \
-            f"tune_{getattr(self.trainable, '__name__', 'exp')}_" \
-            f"{uuid.uuid4().hex[:8]}"
-        exp_dir = os.path.join(self.run_config.resolved_storage_path(),
-                               name)
+        if self._restore_path:
+            exp_dir = self._restore_path
+            restored = TuneController.load_state(exp_dir)
+            if self._resume_errored:
+                for rec in restored:
+                    rec["resume_errored"] = True
+        else:
+            name = self.run_config.name or \
+                f"tune_{getattr(self.trainable, '__name__', 'exp')}_" \
+                f"{uuid.uuid4().hex[:8]}"
+            exp_dir = os.path.join(self.run_config.resolved_storage_path(),
+                                   name)
+            restored = None
+        os.makedirs(exp_dir, exist_ok=True)
+        with open(os.path.join(exp_dir, "tuner.pkl"), "wb") as f:
+            f.write(cloudpickle.dumps({
+                "param_space": self.param_space,
+                "tune_config": self.tune_config,
+                "run_config": self.run_config,
+            }))
         tc = self.tune_config
         controller = TuneController(
             self.trainable, self.param_space,
@@ -126,7 +170,8 @@ class Tuner:
             max_concurrent_trials=tc.max_concurrent_trials,
             resources_per_trial=tc.resources_per_trial,
             exp_dir=exp_dir,
-            time_budget_s=tc.time_budget_s)
+            time_budget_s=tc.time_budget_s,
+            restored_trials=restored)
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode, exp_dir)
 
