@@ -1,9 +1,23 @@
 """ray_tpu.rllib — reinforcement learning (reference: ``rllib/``, new API
 stack, SURVEY.md §2.8): AlgorithmConfig → Algorithm with EnvRunnerGroup
 (CPU sampling actors, numpy inference) and jax LearnerGroup (jitted
-losses, mesh-sharded batches). PPO (sync on-policy) and IMPALA (async).
+losses, mesh-sharded batches). Algorithms: PPO (sync on-policy), IMPALA
+(async + aggregators), APPO (async clipped surrogate), DQN (prioritized
+replay + double-Q), BC (offline). Modules: MLP + Nature-CNN. Connectors
+V2 preprocess env→module observations.
 """
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .appo import APPO, APPOConfig  # noqa: F401
+from .bc import BC, BCConfig  # noqa: F401
+from .connectors import (  # noqa: F401
+    ConnectorPipeline,
+    ConnectorV2,
+    FlattenObs,
+    FrameStack,
+    NormalizeObs,
+)
+from .conv_module import ConvModule  # noqa: F401
+from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
 from .env_runner import (  # noqa: F401
     EnvRunnerGroup,
     SampleBatch,
@@ -12,4 +26,8 @@ from .env_runner import (  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .learner import LearnerGroup, PPOLearner, compute_gae  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 from .rl_module import DiscreteMLPModule, RLModuleSpec  # noqa: F401

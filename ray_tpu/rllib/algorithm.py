@@ -38,6 +38,8 @@ class AlgorithmConfig:
         self.hidden = (64, 64)
         self.seed = 0
         self.mesh = None
+        self.use_conv = False           # CNN torso (image observations)
+        self.env_to_module_connector: Optional[Callable] = None
 
     # fluent sections, reference-style
     def environment(self, env: Optional[str] = None, *,
@@ -48,13 +50,24 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None):
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Callable] = None):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        return self
+
+    def rl_module(self, *, use_conv: Optional[bool] = None,
+                  hidden=None):
+        if use_conv is not None:
+            self.use_conv = use_conv
+        if hidden is not None:
+            self.hidden = tuple(hidden)
         return self
 
     def learners(self, *, num_learners: Optional[int] = None):
@@ -91,10 +104,16 @@ class AlgorithmConfig:
 
     def module_spec(self) -> RLModuleSpec:
         env = self.make_env_creator()()
+        obs_shape = tuple(env.observation_space.shape)
+        if self.env_to_module_connector is not None:
+            # The module sees post-connector observations.
+            obs_shape = self.env_to_module_connector().out_shape(obs_shape)
         spec = RLModuleSpec(
-            obs_dim=int(np.prod(env.observation_space.shape)),
+            obs_dim=int(np.prod(obs_shape)),
             num_actions=int(env.action_space.n),
-            hidden=self.hidden)
+            hidden=self.hidden,
+            obs_shape=obs_shape if self.use_conv else (),
+            conv=self.use_conv)
         env.close() if hasattr(env, "close") else None
         return spec
 
@@ -112,18 +131,24 @@ class Algorithm:
                 not rt.is_initialized():
             rt.init(ignore_reinit_error=True)
         self.config = config
-        self.module_spec = config.module_spec()
+        self.module_spec = self._make_module_spec(config)
         self.env_runner_group = EnvRunnerGroup(
             config.make_env_creator(), self.module_spec,
             num_env_runners=config.num_env_runners,
             num_envs_per_runner=config.num_envs_per_runner,
             rollout_fragment_length=config.rollout_fragment_length,
-            seed=config.seed)
+            seed=config.seed,
+            connector_factory=config.env_to_module_connector)
         self.learner_group = self._build_learner_group()
         self.iteration = 0
         self._timesteps = 0
         # initial weight sync so rollouts start from learner weights
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def _make_module_spec(self, config: AlgorithmConfig) -> RLModuleSpec:
+        """Overridable: algorithms may swap the module class (e.g. DQN's
+        epsilon-greedy module) before runners pickle the spec."""
+        return config.module_spec()
 
     def _build_learner_group(self) -> LearnerGroup:
         raise NotImplementedError

@@ -1,0 +1,113 @@
+"""BC: behavior cloning from offline data.
+
+Capability parity with the reference's offline-RL entry point
+(reference: ``rllib/algorithms/bc/bc.py`` — supervised negative
+log-likelihood on logged (obs, action) pairs read through the Data
+layer). Offline data comes in as a ``ray_tpu.data`` Dataset of row dicts,
+a list of dicts, or a column dict of numpy arrays; training is a jitted
+cross-entropy loop — no env interaction at all.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .rl_module import RLModuleSpec, module_forward
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BC
+        self.offline_data: Any = None   # Dataset | list[dict] | dict of cols
+        self.obs_dim: Optional[int] = None
+        self.num_actions: Optional[int] = None
+
+    def offline(self, data, *, obs_dim: int, num_actions: int):
+        self.offline_data = data
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        return self
+
+
+def _to_columns(data) -> Dict[str, np.ndarray]:
+    if hasattr(data, "take_all"):          # ray_tpu.data Dataset
+        data = data.take_all()
+    if isinstance(data, list):             # row dicts
+        return {
+            "obs": np.asarray([r["obs"] for r in data], np.float32),
+            "actions": np.asarray([r["actions"] for r in data], np.int64),
+        }
+    return {"obs": np.asarray(data["obs"], np.float32),
+            "actions": np.asarray(data["actions"], np.int64)}
+
+
+class BC:
+    """Offline supervised policy learning; env-free Algorithm surface."""
+
+    def __init__(self, config: BCConfig):
+        import jax
+        import optax
+
+        if config.offline_data is None:
+            raise ValueError("BCConfig.offline(data, ...) is required")
+        self.config = config
+        self._cols = _to_columns(config.offline_data)
+        self.module_spec = RLModuleSpec(
+            obs_dim=config.obs_dim, num_actions=config.num_actions,
+            hidden=config.hidden)
+        module = self.module_spec.build(config.seed)
+        self.params = module.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.iteration = 0
+        spec, optimizer = self.module_spec, self.optimizer
+
+        def loss_fn(params, batch):
+            import jax.numpy as jnp
+
+            logits, _ = module_forward(spec, params, batch["obs"], jnp)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, batch["actions"][:, None], axis=-1)[:, 0]
+            return nll.mean()
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._step = jax.jit(step)
+        self._rng = np.random.default_rng(config.seed)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._cols["obs"])
+        bs = min(cfg.minibatch_size, n)
+        loss = None
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n, bs):
+                idx = perm[lo:lo + bs]
+                mb = {k: v[idx] for k, v in self._cols.items()}
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, mb)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "bc_loss": float(loss)}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+
+        logits, _ = module_forward(
+            self.module_spec, jax.tree.map(np.asarray, self.params),
+            np.asarray(obs, np.float32), np)
+        return logits.argmax(-1)
+
+    def stop(self):
+        pass

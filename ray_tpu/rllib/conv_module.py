@@ -1,0 +1,131 @@
+"""CNN RLModule: Nature-DQN conv torso for image observations.
+
+Capability parity with the reference's default conv networks
+(reference: ``rllib/models/torch/misc.py`` + ``catalog.py`` CNN configs —
+the 32/64/64 Nature-DQN stack for 84x84 observations). Dual-path like the
+MLP module: env-runner rollouts run a pure-numpy forward (stride-trick
+im2col — no accelerator in sampling processes), learners run identical
+math under jit via ``lax.conv_general_dilated``.
+
+Observations are [B, H, W, C] float32 (already normalized by a connector
+or the env). Weights are HWIO so both paths share one pytree.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+Params = Dict[str, Any]
+
+# (out_channels, kernel, stride) — the Nature-DQN torso.
+NATURE_CONVS: Tuple[Tuple[int, int, int], ...] = (
+    (32, 8, 4), (64, 4, 2), (64, 3, 1))
+
+
+def _conv2d_np(x: np.ndarray, w: np.ndarray, stride: int) -> np.ndarray:
+    """VALID conv, NHWC x HWIO → NHWC, via as_strided im2col."""
+    B, H, W, C = x.shape
+    K = w.shape[0]
+    Ho = (H - K) // stride + 1
+    Wo = (W - K) // stride + 1
+    sB, sH, sW, sC = x.strides
+    patches = np.lib.stride_tricks.as_strided(
+        x, (B, Ho, Wo, K, K, C),
+        (sB, sH * stride, sW * stride, sH, sW, sC), writeable=False)
+    return np.tensordot(patches, w, axes=([3, 4, 5], [0, 1, 2]))
+
+
+def _conv2d_jax(x, w, stride: int):
+    from jax import lax
+
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def conv_forward(params: Params, obs, xp=np):
+    """(logits, value); ``xp`` picks the numpy or jax path.
+
+    Strides are static architecture (NATURE_CONVS), not params — an int
+    leaf inside the pytree would break ``jax.grad``.
+    """
+    is_np = xp is np
+    h = obs
+    for layer, (_, _, stride) in zip(params["convs"], NATURE_CONVS):
+        conv = _conv2d_np if is_np else _conv2d_jax
+        h = conv(h, layer["w"], stride) + layer["b"]
+        h = xp.maximum(h, 0.0)
+    h = h.reshape(h.shape[0], -1)
+    h = xp.maximum(h @ params["torso"]["w"] + params["torso"]["b"], 0.0)
+    logits = h @ params["logits"]["w"] + params["logits"]["b"]
+    value = (h @ params["value"]["w"] + params["value"]["b"])[..., 0]
+    return logits, value
+
+
+def init_conv_params(spec, seed: int) -> Params:
+    rng = np.random.default_rng(seed)
+    H, W, C = spec.obs_shape
+
+    def dense(fan_in, fan_out, scale=None):
+        s = scale if scale is not None else np.sqrt(2.0 / fan_in)
+        return {"w": (rng.standard_normal((fan_in, fan_out)) * s
+                      ).astype(np.float32),
+                "b": np.zeros((fan_out,), np.float32)}
+
+    convs = []
+    c_in, h, w = C, H, W
+    for c_out, k, stride in NATURE_CONVS:
+        fan_in = k * k * c_in
+        convs.append({
+            "w": (rng.standard_normal((k, k, c_in, c_out))
+                  * np.sqrt(2.0 / fan_in)).astype(np.float32),
+            "b": np.zeros((c_out,), np.float32),
+        })
+        h = (h - k) // stride + 1
+        w = (w - k) // stride + 1
+        c_in = c_out
+    flat = h * w * c_in
+    torso_width = spec.hidden[0] if spec.hidden else 512
+    return {
+        "convs": convs,
+        "torso": dense(flat, torso_width),
+        "logits": dense(torso_width, spec.num_actions, scale=0.01),
+        "value": dense(torso_width, 1, scale=1.0),
+    }
+
+
+class ConvModule:
+    """Categorical-action CNN module (Atari-class image tasks)."""
+
+    def __init__(self, spec, seed: int = 0):
+        if len(spec.obs_shape) != 3:
+            raise ValueError(
+                f"ConvModule needs obs_shape=(H, W, C), got "
+                f"{spec.obs_shape}")
+        self.spec = spec
+        self.params = init_conv_params(spec, seed)
+
+    def forward_exploration(self, obs: np.ndarray,
+                            rng: np.random.Generator):
+        logits, value = conv_forward(self.params, obs, np)
+        z = logits - logits.max(-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(-1, keepdims=True)
+        actions = np.array([rng.choice(len(row), p=row) for row in p])
+        logp = np.log(p[np.arange(len(actions)), actions] + 1e-20)
+        return actions, logp, value
+
+    def forward_inference(self, obs: np.ndarray):
+        logits, _ = conv_forward(self.params, obs, np)
+        return logits.argmax(-1)
+
+    def forward_values(self, obs: np.ndarray) -> np.ndarray:
+        _, value = conv_forward(self.params, obs, np)
+        return value
+
+    def get_weights(self) -> Params:
+        return self.params
+
+    def set_weights(self, params: Params):
+        self.params = params

@@ -43,15 +43,25 @@ class SingleAgentEnvRunner:
 
     def __init__(self, env_creator: Callable, module_spec: RLModuleSpec,
                  num_envs: int = 1, rollout_fragment_length: int = 200,
-                 seed: int = 0):
+                 seed: int = 0, connector_factory: Optional[Callable] = None):
         self.envs = [env_creator() for _ in range(num_envs)]
         self.module = module_spec.build(seed)
         self.T = rollout_fragment_length
         self.rng = np.random.default_rng(seed)
-        self.obs = np.stack([e.reset(seed=seed + i)[0]
-                             for i, e in enumerate(self.envs)])
+        # env→module connector pipeline (obs preprocessing; see
+        # connectors.py). Raw env obs pass through it before the module
+        # sees them and before they are recorded into sample batches.
+        self.connector = connector_factory() if connector_factory else None
+        raw = np.stack([e.reset(seed=seed + i)[0]
+                        for i, e in enumerate(self.envs)])
+        self.obs = self._connect(raw)
         self.episode_returns = [0.0] * num_envs
         self.completed_returns: List[float] = []
+
+    def _connect(self, raw_batch, slots=None):
+        if self.connector is None:
+            return np.asarray(raw_batch, np.float32)
+        return self.connector(raw_batch, slots)
 
     def set_weights(self, weights):
         self.module.set_weights(weights)
@@ -81,20 +91,24 @@ class SingleAgentEnvRunner:
                 done_buf[t, i] = term
                 trunc_buf[t, i] = trunc
                 self.episode_returns[i] += r
+                # true successor state (pre-reset) — off-policy algorithms
+                # (DQN replay) need s' even across episode boundaries
+                final_buf[t, i] = self._connect(
+                    np.asarray(o, np.float32)[None], slots=[i])[0]
                 if term or trunc:
-                    if trunc and not term:
-                        # truncation bootstraps V(true successor state),
-                        # which is NOT the reset obs that replaces it
-                        final_buf[t, i] = np.asarray(o, np.float32)
                     self.completed_returns.append(self.episode_returns[i])
                     self.episode_returns[i] = 0.0
+                    if self.connector is not None:
+                        self.connector.reset(i)
                     o = env.reset()[0]
-                self.obs[i] = o
+                    o = self._connect(
+                        np.asarray(o, np.float32)[None], slots=[i])[0]
+                    self.obs[i] = o
+                else:
+                    self.obs[i] = final_buf[t, i]
 
         # bootstrap values for the step AFTER each transition
-        from .rl_module import mlp_forward
-
-        _, next_vals_last = mlp_forward(self.module.params, self.obs, np)
+        next_vals_last = self.module.forward_values(self.obs)
         next_val_buf = np.zeros((T, N), np.float32)
         next_val_buf[:-1] = val_buf[1:]
         next_val_buf[-1] = next_vals_last
@@ -102,9 +116,8 @@ class SingleAgentEnvRunner:
         # successor, not of the reset obs that follows in the buffer
         trunc_only = trunc_buf & ~done_buf
         if trunc_only.any():
-            _, v_fin = mlp_forward(self.module.params,
-                                   final_buf[trunc_only], np)
-            next_val_buf[trunc_only] = v_fin
+            next_val_buf[trunc_only] = self.module.forward_values(
+                final_buf[trunc_only])
         # terminated states bootstrap 0
         next_val_buf[done_buf] = 0.0
 
@@ -115,7 +128,7 @@ class SingleAgentEnvRunner:
             obs=flat(obs_buf), actions=flat(act_buf), rewards=flat(rew_buf),
             dones=flat(done_buf), truncateds=flat(trunc_buf),
             logp=flat(logp_buf), values=flat(val_buf),
-            next_values=flat(next_val_buf),
+            next_values=flat(next_val_buf), next_obs=flat(final_buf),
             # episode boundaries for GAE: time-major layout preserved
             _shape=np.array([T, N]),
         )
@@ -141,19 +154,21 @@ class EnvRunnerGroup:
 
     def __init__(self, env_creator, module_spec: RLModuleSpec,
                  num_env_runners: int = 0, num_envs_per_runner: int = 1,
-                 rollout_fragment_length: int = 200, seed: int = 0):
+                 rollout_fragment_length: int = 200, seed: int = 0,
+                 connector_factory: Optional[Callable] = None):
         self.local: Optional[SingleAgentEnvRunner] = None
         self.remote: List[Any] = []
         if num_env_runners == 0:
             self.local = SingleAgentEnvRunner(
                 env_creator, module_spec, num_envs_per_runner,
-                rollout_fragment_length, seed)
+                rollout_fragment_length, seed, connector_factory)
         else:
             cls = rt.remote(SingleAgentEnvRunner)
             self.remote = [
                 cls.options(num_cpus=1).remote(
                     env_creator, module_spec, num_envs_per_runner,
-                    rollout_fragment_length, seed + 1000 * (i + 1))
+                    rollout_fragment_length, seed + 1000 * (i + 1),
+                    connector_factory)
                 for i in range(num_env_runners)
             ]
 

@@ -20,6 +20,64 @@ class IMPALAConfig(AlgorithmConfig):
         self.broadcast_interval = 1     # learner steps between syncs
         self.max_requests_in_flight = 2  # per env runner
         self.vtrace_rho_clip = 1.0
+        # >0 → offload ρ/GAE batch building to aggregator actors
+        # (reference: ``impala.py num_aggregation_workers``)
+        self.num_aggregation_workers = 0
+
+
+class _Aggregator:
+    """Aggregation actor: turns raw fragments into v-trace train batches
+    off the driver thread (reference: IMPALA's aggregation workers,
+    ``impala.py:128-131`` tree-aggregation stage).
+
+    Fragments arrive as object refs (zero-copy through the object store);
+    the current policy weights are refreshed by the driver whenever it
+    broadcasts to runners, so ρ is computed against the same snapshot.
+    """
+
+    def __init__(self, spec, gamma: float, lam: float, rho_clip: float):
+        self.spec = spec
+        self.gamma = gamma
+        self.lam = lam
+        self.rho_clip = rho_clip
+        self.weights = None
+
+    def set_weights(self, w):
+        self.weights = w
+        return True
+
+    def build_batch(self, fragments: List[Any]) -> Dict[str, np.ndarray]:
+        import ray_tpu as rt
+
+        from .rl_module import module_forward
+
+        # Driver sends REFS (fragments pull runner→aggregator directly,
+        # skipping the driver data path); local mode passes values.
+        fragments = [rt.get(f, timeout=120) if isinstance(f, rt.ObjectRef)
+                     else f for f in fragments]
+        cols = {k: [] for k in ("obs", "actions", "logp_old",
+                                "advantages", "value_targets")}
+        for frag in fragments:
+            logits, _ = module_forward(self.spec, self.weights,
+                                       frag["obs"], np)
+            z = logits - logits.max(-1, keepdims=True)
+            logp_all = z - np.log(np.exp(z).sum(-1, keepdims=True))
+            logp_cur = logp_all[np.arange(len(frag["actions"])),
+                                frag["actions"]]
+            rho = np.clip(np.exp(logp_cur - frag["logp"]), None,
+                          self.rho_clip).astype(np.float32)
+            adv, vtarg = compute_gae(
+                frag["rewards"], frag["values"], frag["next_values"],
+                frag["dones"], frag["truncateds"], frag["_shape"],
+                gamma=self.gamma, lam=self.lam, rho=rho)
+            cols["obs"].append(frag["obs"])
+            cols["actions"].append(frag["actions"])
+            cols["logp_old"].append(frag["logp"])
+            cols["advantages"].append(adv)
+            cols["value_targets"].append(vtarg)
+        return {k: np.concatenate(v).astype(
+            np.int64 if k == "actions" else np.float32)
+            for k, v in cols.items()}
 
 
 class IMPALA(Algorithm):
@@ -33,6 +91,26 @@ class IMPALA(Algorithm):
                              "(async sampling needs remote runners)")
         self._inflight: Dict[Any, List] = {}  # ref -> runner
         self._since_broadcast = 0
+        self._aggregators: List[Any] = []
+        self._agg_rr = 0
+        if config.num_aggregation_workers > 0:
+            import ray_tpu as rt
+
+            cls = rt.remote(_Aggregator)
+            self._aggregators = [
+                cls.options(num_cpus=1).remote(
+                    self.module_spec, config.gamma, config.lam,
+                    config.vtrace_rho_clip)
+                for _ in range(config.num_aggregation_workers)]
+            self._sync_aggregators()
+
+    def _sync_aggregators(self):
+        import ray_tpu as rt
+
+        if self._aggregators:
+            w = self.learner_group.get_weights()
+            rt.get([a.set_weights.remote(w) for a in self._aggregators],
+                   timeout=60)
 
     def _build_learner_group(self) -> LearnerGroup:
         cfg = self.config
@@ -67,60 +145,63 @@ class IMPALA(Algorithm):
 
         # harvest whatever fragments are ready (block until at least one —
         # a timed-out wait with zero ready refs just retries rather than
-        # crashing the step on np.concatenate([]))
-        fragments = []
-        while not fragments:
+        # crashing the step on np.concatenate([])). With aggregation
+        # workers the fragment BYTES never touch the driver: ready refs
+        # go straight to the aggregator, which pulls runner→aggregator.
+        ready_refs = []
+        while not ready_refs:
             refs = list(self._inflight.keys())
             ready, _ = rt.wait(refs, num_returns=1, timeout=60)
             # opportunistically grab more that are already done
             more, _ = rt.wait(refs, num_returns=len(refs), timeout=0)
-            ready = list(dict.fromkeys(ready + more))
-            for ref in ready:
+            ready_refs = list(dict.fromkeys(ready + more))
+            for ref in ready_refs:
                 self._inflight.pop(ref, None)
-                fragments.append(rt.get(ref, timeout=60))
             self._fill_sample_pipeline()
-
-        collected = sum(len(f) for f in fragments)
-        self._timesteps += collected
 
         # V-trace-style off-policy correction: ρ = π_cur/π_behavior,
         # clipped at vtrace_rho_clip, weights the GAE deltas; behavior
         # logp came from the (stale) sampling weights.
-        from .rl_module import mlp_forward
-
-        cur_w = self.learner_group.get_weights()
-        cols = {k: [] for k in ("obs", "actions", "logp_old",
-                                "advantages", "value_targets")}
-        for frag in fragments:
-            logits, _ = mlp_forward(cur_w, frag["obs"], np)
-            z = logits - logits.max(-1, keepdims=True)
-            logp_all = z - np.log(np.exp(z).sum(-1, keepdims=True))
-            logp_cur = logp_all[np.arange(len(frag["actions"])),
-                                frag["actions"]]
-            rho = np.clip(np.exp(logp_cur - frag["logp"]), None,
-                          cfg.vtrace_rho_clip).astype(np.float32)
-            adv, vtarg = compute_gae(
-                frag["rewards"], frag["values"], frag["next_values"],
-                frag["dones"], frag["truncateds"], frag["_shape"],
-                gamma=cfg.gamma, lam=cfg.lam, rho=rho)
-            cols["obs"].append(frag["obs"])
-            cols["actions"].append(frag["actions"])
-            cols["logp_old"].append(frag["logp"])
-            cols["advantages"].append(adv)
-            cols["value_targets"].append(vtarg)
-        train_batch = {k: np.concatenate(v).astype(
-            np.int64 if k == "actions" else np.float32)
-            for k, v in cols.items()}
+        if self._aggregators:
+            agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+            self._agg_rr += 1
+            train_batch = rt.get(agg.build_batch.remote(ready_refs),
+                                 timeout=120)
+            collected = len(train_batch["obs"])
+            num_fragments = len(ready_refs)
+        else:
+            fragments = [rt.get(r, timeout=60) for r in ready_refs]
+            collected = sum(len(f) for f in fragments)
+            num_fragments = len(fragments)
+            builder = _Aggregator(self.module_spec, cfg.gamma, cfg.lam,
+                                  cfg.vtrace_rho_clip)
+            builder.set_weights(self.learner_group.get_weights())
+            train_batch = builder.build_batch(fragments)
+        self._timesteps += collected
 
         metrics = self.learner_group.update(
             train_batch, minibatch_size=cfg.minibatch_size,
-            num_epochs=1, shuffle_seed=self.iteration)
+            num_epochs=self._num_epochs(), shuffle_seed=self.iteration)
 
         self._since_broadcast += 1
         if self._since_broadcast >= cfg.broadcast_interval:
             self.env_runner_group.sync_weights(
                 self.learner_group.get_weights())
+            self._sync_aggregators()
             self._since_broadcast = 0
         metrics["num_env_steps_trained"] = collected
-        metrics["num_fragments"] = len(fragments)
+        metrics["num_fragments"] = num_fragments
         return metrics
+
+    def _num_epochs(self) -> int:
+        return 1  # IMPALA: single pass per batch (APPO overrides)
+
+    def stop(self):
+        import ray_tpu as rt
+
+        super().stop()
+        for a in self._aggregators:
+            try:
+                rt.kill(a)
+            except Exception:
+                pass

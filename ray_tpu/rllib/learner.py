@@ -14,7 +14,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .rl_module import RLModuleSpec, mlp_forward
+from .rl_module import RLModuleSpec, mlp_forward, module_forward
 
 
 def compute_gae(rewards, values, next_values, dones, truncateds, shape,
@@ -83,8 +83,10 @@ class PPOLearner:
                            self.entropy_coeff)
         optimizer = self.optimizer
 
+        spec = self.spec
+
         def loss_fn(params, batch):
-            logits, value = mlp_forward(params, batch["obs"], jnp)
+            logits, value = module_forward(spec, params, batch["obs"], jnp)
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None], axis=-1)[:, 0]

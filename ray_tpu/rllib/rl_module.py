@@ -17,12 +17,24 @@ Params = Dict[str, Any]
 
 class RLModuleSpec:
     def __init__(self, obs_dim: int, num_actions: int,
-                 hidden: Tuple[int, ...] = (64, 64)):
+                 hidden: Tuple[int, ...] = (64, 64),
+                 obs_shape: Tuple[int, ...] = (),
+                 conv: bool = False,
+                 module_cls: Any = None):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
         self.hidden = tuple(hidden)
+        self.obs_shape = tuple(obs_shape)  # (H, W, C) for conv torsos
+        self.conv = conv
+        self.module_cls = module_cls
 
-    def build(self, seed: int = 0) -> "DiscreteMLPModule":
+    def build(self, seed: int = 0):
+        if self.module_cls is not None:
+            return self.module_cls(self, seed)
+        if self.conv:
+            from .conv_module import ConvModule
+
+            return ConvModule(self, seed)
         return DiscreteMLPModule(self, seed)
 
 
@@ -47,6 +59,15 @@ def _init_mlp(spec: RLModuleSpec, seed: int) -> Params:
         "logits": dense(sizes[-1], spec.num_actions, scale=0.01),
         "value": dense(sizes[-1], 1, scale=1.0),
     }
+
+
+def module_forward(spec: "RLModuleSpec", params: Params, obs, xp=np):
+    """Spec-dispatched (logits, value) forward shared by all learners."""
+    if spec.conv:
+        from .conv_module import conv_forward
+
+        return conv_forward(params, obs, xp)
+    return mlp_forward(params, obs, xp)
 
 
 def mlp_forward(params: Params, obs, xp=np):
@@ -82,6 +103,11 @@ class DiscreteMLPModule:
     def forward_inference(self, obs: np.ndarray):
         logits, _ = mlp_forward(self.params, obs, np)
         return logits.argmax(-1)
+
+    def forward_values(self, obs: np.ndarray) -> np.ndarray:
+        """Bootstrap values V(s) for the env runner's GAE tail."""
+        _, value = mlp_forward(self.params, obs, np)
+        return value
 
     # ------------------------------------------------------- weights
     def get_weights(self) -> Params:
