@@ -153,6 +153,9 @@ class HeadService:
         # merged on demand; dashboard server started in start().
         self.metrics_snapshots: Dict[str, dict] = {}
         self.dashboard = None
+        # Job submission (reference: dashboard/modules/job JobManager):
+        # job_id → {entrypoint, status, proc, log_path, ...}
+        self.jobs: Dict[str, dict] = {}
 
     # ------------------------------------------------------------- lifecycle
     async def start(self):
@@ -227,6 +230,7 @@ class HeadService:
         period = self.config.health_check_period_s
         while True:
             await asyncio.sleep(period)
+            self._poll_jobs()
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None:
                     await self._on_worker_death(
@@ -1091,6 +1095,106 @@ class HeadService:
 
     async def _rpc_chrome_trace(self, payload, bufs):
         return self.chrome_trace()
+
+    # ------------------------------------------------------------- jobs
+    async def _rpc_submit_job(self, payload, bufs):
+        """Spawn a driver subprocess for an entrypoint shell command
+        (reference: ``dashboard/modules/job/job_manager.py`` submit_job).
+        The job attaches to this head via RT_ADDRESS."""
+        import uuid as _uuid
+
+        job_id = payload.get("submission_id") or \
+            f"raysubmit_{_uuid.uuid4().hex[:12]}"
+        if job_id in self.jobs and self.jobs[job_id]["status"] in (
+                "PENDING", "RUNNING"):
+            raise rpc.RpcError(f"job {job_id!r} already running")
+        wire_env = payload.get("runtime_env") or {}
+        env = dict(self._spawn_env)
+        env["RT_ADDRESS"] = self.sock_path
+        env["RT_JOB_ID"] = job_id
+        env.update(wire_env.get("env_vars") or {})
+        wd_key = wire_env.get("working_dir_key")
+        blob = None
+        if wd_key:
+            blob = self.kv["default"].get(wd_key)
+            if blob is None:
+                raise rpc.RpcError(
+                    f"job working_dir package {wd_key!r} missing")
+        log_path = os.path.join(self.session_dir, "logs",
+                                f"job-{job_id}.log")
+
+        def _spawn():
+            # Blocking work (zip extraction, file opens, fork) stays off
+            # the head's event loop.
+            cwd = os.getcwd()
+            if wd_key:
+                from . import runtime_env as renv
+
+                scratch = os.path.join(self.session_dir, "runtime_envs")
+                os.makedirs(scratch, exist_ok=True)
+                cwd = renv._extract(wd_key, lambda k: blob, scratch)
+                env["PYTHONPATH"] = (
+                    cwd + os.pathsep + env.get("PYTHONPATH", ""))
+            with open(log_path, "ab") as log:
+                # Popen inherits the fd; the parent must not keep it.
+                return subprocess.Popen(
+                    ["/bin/bash", "-c", payload["entrypoint"]],
+                    stdout=log, stderr=subprocess.STDOUT, env=env, cwd=cwd)
+
+        proc = await self._loop.run_in_executor(None, _spawn)
+        self.jobs[job_id] = {
+            "job_id": job_id, "entrypoint": payload["entrypoint"],
+            "status": "RUNNING", "proc": proc, "log_path": log_path,
+            "started_at": time.time(), "finished_at": None,
+            "returncode": None,
+        }
+        return {"job_id": job_id}
+
+    def _poll_jobs(self):
+        for job in self.jobs.values():
+            proc = job.get("proc")
+            if proc is not None and job["status"] == "RUNNING" and \
+                    proc.poll() is not None:
+                job["returncode"] = proc.returncode
+                job["status"] = ("SUCCEEDED" if proc.returncode == 0
+                                 else "FAILED")
+                job["finished_at"] = time.time()
+
+    def _job_public(self, job: dict) -> dict:
+        return {k: v for k, v in job.items() if k != "proc"}
+
+    async def _rpc_job_status(self, payload, bufs):
+        self._poll_jobs()
+        job = self.jobs.get(payload["job_id"])
+        if job is None:
+            raise rpc.RpcError(f"no job {payload['job_id']!r}")
+        return self._job_public(job)
+
+    async def _rpc_list_jobs(self, payload, bufs):
+        self._poll_jobs()
+        return [self._job_public(j) for j in self.jobs.values()]
+
+    async def _rpc_stop_job(self, payload, bufs):
+        job = self.jobs.get(payload["job_id"])
+        if job is None:
+            raise rpc.RpcError(f"no job {payload['job_id']!r}")
+        proc = job.get("proc")
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            job["status"] = "STOPPED"
+            job["finished_at"] = time.time()
+        return self._job_public(job)
+
+    async def _rpc_job_logs(self, payload, bufs):
+        job = self.jobs.get(payload["job_id"])
+        if job is None:
+            raise rpc.RpcError(f"no job {payload['job_id']!r}")
+        try:
+            with open(job["log_path"], "rb") as f:
+                data = f.read()[-payload.get("tail_bytes", 1 << 20):]
+        except OSError:
+            data = b""
+        return {"logs": data.decode("utf-8", "replace")}
 
     def metrics_text(self) -> str:
         """Cluster-merged prometheus exposition."""

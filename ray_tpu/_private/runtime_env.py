@@ -1,0 +1,148 @@
+"""Runtime environments: per-task/actor env vars, working_dir, py_modules.
+
+Capability parity with the reference's runtime_env subsystem (reference:
+``python/ray/_private/runtime_env/`` — working_dir/py_modules packaging
+via zip blobs in GCS, env_vars plumbed to worker startup, pip installs),
+re-designed for this runtime:
+
+- ``working_dir``/``py_modules`` zip locally, ship through the head KV
+  (sha-keyed, deduped) and extract once per worker into session scratch,
+- ``env_vars`` apply at worker level: the lease shape key includes the
+  runtime-env hash, so tasks with different envs never share a worker
+  (the reference isolates the same way — dedicated workers per env),
+- ``pip`` is validated import-only: this deployment is zero-egress, so
+  packages must already be present; missing ones raise a clear error
+  instead of silently downloading.
+"""
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import io
+import json
+import os
+import sys
+import zipfile
+from typing import Any, Dict, Optional, Tuple
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
+    allowed = {"env_vars", "working_dir", "py_modules", "pip"}
+    unknown = set(runtime_env) - allowed
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(allowed)}")
+    env_vars = runtime_env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in env_vars.items()):
+        raise ValueError("runtime_env env_vars must be str->str")
+    return runtime_env
+
+
+def zip_directory(path: str) -> bytes:
+    """Deterministic zip of a directory tree (the reference's
+    ``package_utils`` blob format, rebuilt)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            for name in sorted(files):
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"working_dir exceeds {MAX_PACKAGE_BYTES} bytes")
+                zi = zipfile.ZipInfo(rel)  # fixed date → stable sha
+                zi.compress_type = zipfile.ZIP_DEFLATED  # ZipInfo defaults
+                with open(full, "rb") as f:              # to STORED
+                    zf.writestr(zi, f.read())
+    return buf.getvalue()
+
+
+def package_key(blob: bytes, kind: str = "working_dir") -> str:
+    return f"runtime_env/{kind}/{hashlib.sha256(blob).hexdigest()[:32]}"
+
+
+def env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
+    """Stable hash naming the worker-pool partition for this env."""
+    if not runtime_env:
+        return ""
+    return hashlib.sha256(
+        json.dumps(runtime_env, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def prepare(runtime_env: Dict[str, Any], kv_put) -> Dict[str, Any]:
+    """Driver side: validate, upload packages, return the wire form."""
+    runtime_env = validate(dict(runtime_env))
+    out: Dict[str, Any] = {}
+    if runtime_env.get("env_vars"):
+        out["env_vars"] = dict(runtime_env["env_vars"])
+    if runtime_env.get("working_dir"):
+        blob = zip_directory(runtime_env["working_dir"])
+        key = package_key(blob, "working_dir")
+        kv_put(key, blob)
+        out["working_dir_key"] = key
+    mods = []
+    for mod_path in runtime_env.get("py_modules") or []:
+        blob = zip_directory(mod_path)
+        key = package_key(blob, "py_module")
+        kv_put(key, blob)
+        mods.append((os.path.basename(mod_path.rstrip("/")), key))
+    if mods:
+        out["py_module_keys"] = mods
+    if runtime_env.get("pip"):
+        out["pip"] = list(runtime_env["pip"])
+    return out
+
+
+def apply(wire_env: Dict[str, Any], kv_get, scratch_dir: str) -> None:
+    """Worker side: materialize the env in THIS process (the worker is
+    dedicated to this env via the lease shape key)."""
+    for name in wire_env.get("pip") or []:
+        base = name.split("==")[0].split(">=")[0].split("[")[0]
+        base = base.replace("-", "_")
+        if importlib.util.find_spec(base) is None:
+            raise RuntimeError(
+                f"runtime_env pip package {name!r} is not available and "
+                "this deployment is zero-egress; bake it into the image")
+    for k, v in (wire_env.get("env_vars") or {}).items():
+        os.environ[k] = v
+    wd_key = wire_env.get("working_dir_key")
+    if wd_key:
+        target = _extract(wd_key, kv_get, scratch_dir)
+        os.chdir(target)
+        if target not in sys.path:
+            sys.path.insert(0, target)
+    for mod_name, key in wire_env.get("py_module_keys") or []:
+        target = _extract(key, kv_get, scratch_dir)
+        # a py_module zip IS the module dir: expose its parent
+        parent = os.path.dirname(target)
+        link = os.path.join(parent, mod_name)
+        if not os.path.exists(link):
+            os.symlink(target, link)
+        if parent not in sys.path:
+            sys.path.insert(0, parent)
+
+
+def _extract(key: str, kv_get, scratch_dir: str) -> str:
+    blob = kv_get(key)
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {key!r} missing from KV")
+    target = os.path.join(scratch_dir, key.replace("/", "_"))
+    marker = target + ".ok"
+    if not os.path.exists(marker):
+        os.makedirs(target, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(bytes(blob))) as zf:
+            zf.extractall(target)
+        open(marker, "w").close()
+    return target

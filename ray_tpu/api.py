@@ -266,6 +266,7 @@ class RemoteFunction:
             max_retries=self._options.get("max_retries"),
             strategy=_strategy_from_options(self._options),
             name=self._options.get("name") or self._fn.__name__,
+            runtime_env=self._options.get("runtime_env"),
         )
         if num_returns == "streaming":
             return refs  # an ObjectRefGenerator
@@ -280,6 +281,12 @@ class ActorMethod:
 
     def options(self, num_returns: int = 1):
         return ActorMethod(self._handle, self._name, num_returns)
+
+    def bind(self, upstream):
+        """Build a compiled-DAG node (see :mod:`ray_tpu.dag`)."""
+        from .dag import MethodNode
+
+        return MethodNode(self._handle, self._name, upstream)
 
     def remote(self, *args, **kwargs):
         core = _core()
@@ -351,6 +358,7 @@ class ActorClass:
             max_concurrency=self._options.get("max_concurrency", 1),
             strategy=_strategy_from_options(self._options),
             lifetime=self._options.get("lifetime"),
+            runtime_env=self._options.get("runtime_env"),
         )
         return ActorHandle(actor_id)
 

@@ -67,9 +67,36 @@ def main(argv=None) -> int:
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("output", nargs="?", default="timeline.json")
     sub.add_parser("dashboard")
+    p_job = sub.add_parser("job")
+    job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
+    p_submit = job_sub.add_parser("submit")
+    p_submit.add_argument("entrypoint")
+    p_submit.add_argument("--working-dir", default=None)
+    for name in ("status", "logs", "stop"):
+        p = job_sub.add_parser(name)
+        p.add_argument("job_id")
+    job_sub.add_parser("list")
     args = parser.parse_args(argv)
 
     info = _find_session(args.session_dir)
+    if args.cmd == "job":
+        from .job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient(info["head_sock"])
+        if args.job_cmd == "submit":
+            renv = ({"working_dir": args.working_dir}
+                    if args.working_dir else None)
+            print(client.submit_job(entrypoint=args.entrypoint,
+                                    runtime_env=renv))
+        elif args.job_cmd == "status":
+            print(json.dumps(client.get_job_info(args.job_id), indent=1))
+        elif args.job_cmd == "logs":
+            print(client.get_job_logs(args.job_id), end="")
+        elif args.job_cmd == "stop":
+            print(client.stop_job(args.job_id)["status"])
+        elif args.job_cmd == "list":
+            print(json.dumps(client.list_jobs(), indent=1))
+        return 0
     rt = _connect(info)
     try:
         if args.cmd == "status":

@@ -364,7 +364,8 @@ class _LeaseCache:
         self.max_inflight_per_worker = 16
 
     @staticmethod
-    def shape_key(resources: Dict[str, float], strategy) -> tuple:
+    def shape_key(resources: Dict[str, float], strategy,
+                  runtime_env_hash: str = "") -> tuple:
         extra = ()
         if strategy is not None and strategy.kind == "PLACEMENT_GROUP":
             extra = (strategy.placement_group_id.hex(), strategy.bundle_index)
@@ -373,6 +374,10 @@ class _LeaseCache:
             extra = ("aff", strategy.node_id, strategy.soft)
         elif strategy is not None and strategy.kind == "SPREAD":
             extra = ("spread",)
+        if runtime_env_hash:
+            # Workers are dedicated per runtime env (reference: worker
+            # pool keyed by serialized runtime env).
+            extra = extra + ("env", runtime_env_hash)
         return tuple(sorted(resources.items())) + extra
 
 
@@ -447,6 +452,8 @@ class CoreWorker:
         self._handle_pending: deque = deque()
         self._handle_lock = threading.Lock()
         self._capture_tls = threading.local()  # nested-ref capture stack
+        self._prepared_envs: Dict[str, dict] = {}  # env hash → wire form
+        self._applied_envs: set = set()  # env hashes live in this process
         self._actor_gc_enabled = (
             os.environ.get("RT_DISABLE_ACTOR_GC", "") != "1")
 
@@ -920,9 +927,10 @@ class CoreWorker:
 
     def submit_task(self, fn_key: str, args, kwargs, *, num_returns=1,
                     resources=None, max_retries=None, strategy=None,
-                    name=""):
+                    name="", runtime_env=None):
         task_id = TaskID.from_random()
         streaming = num_returns == "streaming"
+        wire_env = self._prepare_runtime_env(runtime_env)
         ser_args, kw_keys, borrowed = self._serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.NORMAL,
@@ -935,6 +943,7 @@ class CoreWorker:
             scheduling_strategy=strategy or SchedulingStrategy(),
             name=name, owner_address=self.address,
             is_generator=streaming,
+            runtime_env=wire_env,
         )
         # Refs MUST exist before the submission is scheduled: a fast task
         # completing on the IO thread hits on_result_stored, and with no
@@ -993,9 +1002,45 @@ class CoreWorker:
         for oid in spec.return_object_ids():
             self.memory_store.put(oid, frames)
 
+    def _prepare_runtime_env(self, runtime_env):
+        """Driver-side runtime-env packaging (upload via KV, dedup).
+
+        Cached by env CONTENT hash — identity would alias recycled dict
+        addresses to stale environments."""
+        if not runtime_env:
+            return None
+        from .._private import runtime_env as renv
+
+        key = renv.env_hash(renv.validate(dict(runtime_env)))
+        cached = self._prepared_envs.get(key)
+        if cached is not None:
+            return cached
+        wire = renv.prepare(runtime_env,
+                            lambda k, blob: self.kv_put(k, blob))
+        self._prepared_envs[key] = wire
+        return wire
+
+    def _ensure_runtime_env(self, wire_env):
+        """Worker-side: materialize the env once (this worker is dedicated
+        to the env via the lease shape key)."""
+        if not wire_env:
+            return
+        from .._private import runtime_env as renv
+
+        h = renv.env_hash(wire_env)
+        if h in self._applied_envs:
+            return
+        scratch = os.path.join(self.session_dir, "runtime_envs")
+        os.makedirs(scratch, exist_ok=True)
+        renv.apply(wire_env, lambda k: self.kv_get(k), scratch)
+        self._applied_envs.add(h)
+
     async def _submit_normal_inner(self, spec: TaskSpec):
+        from .._private.runtime_env import env_hash
+
         shape = _LeaseCache.shape_key(spec.resources,
-                                      spec.scheduling_strategy)
+                                      spec.scheduling_strategy,
+                                      env_hash(spec.runtime_env))
         while True:
             lease = await self._acquire_lease(shape, spec)
             lease["inflight"] += 1
@@ -1032,6 +1077,7 @@ class CoreWorker:
             "name": spec.name,
             "max_concurrency": spec.max_concurrency,
             "is_generator": spec.is_generator,
+            "runtime_env": spec.runtime_env,
         }
 
     def _ingest_results(self, spec: TaskSpec, meta, bufs):
@@ -1135,6 +1181,10 @@ class CoreWorker:
             self._leases.by_shape[shape].remove(lease)
         except ValueError:
             return
+        # Runtime-env workers mutated their process state (env vars, cwd,
+        # sys.path) — they must never rejoin the shared idle pool.
+        if "env" in shape:
+            kill = True
         try:
             await self._head.call_simple(
                 "return_lease",
@@ -1153,8 +1203,9 @@ class CoreWorker:
     # ------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, *, resources=None, name="",
                      max_restarts=0, max_concurrency=1, strategy=None,
-                     lifetime=None) -> "ActorID":
+                     lifetime=None, runtime_env=None) -> "ActorID":
         actor_id = ActorID.from_random()
+        wire_env = self._prepare_runtime_env(runtime_env)
         cls_key = self.export_function(cls)
         # Creation-spec borrows are deliberately never released: the head
         # keeps the spec for actor restarts, so its args must stay alive
@@ -1169,6 +1220,7 @@ class CoreWorker:
             "max_concurrency": max_concurrency,
             "owner_address": self.address,
             "name": name,
+            "runtime_env": wire_env,
         }
         strategy = strategy or SchedulingStrategy()
         payload = {
@@ -1535,6 +1587,7 @@ class CoreWorker:
         def _make():
             # KV fetch + arg deserialization block, so they must run off the
             # IO loop (fetch_function itself round-trips through the loop).
+            self._ensure_runtime_env(meta.get("runtime_env"))
             cls = self.fetch_function(meta["cls_ref"][1])
             args, kwargs = self._deserialize_args(
                 meta["args"], meta["kwargs_keys"])
@@ -1574,6 +1627,9 @@ class CoreWorker:
 
     def _execute_function(self, meta):
         """Fetch + run the task function; returns its raw result."""
+        # Env failures flow through the normal error channels (including
+        # the streamed-error path for generators).
+        self._ensure_runtime_env(meta.get("runtime_env"))
         kind, ref = meta["function_ref"]
         if kind != "kv":
             raise RuntimeError(f"bad function ref {kind}")
@@ -1688,6 +1744,12 @@ class CoreWorker:
         order = self._actor_order[actor_id_b]
         seq = meta["seq_no"]
         loop = asyncio.get_running_loop()
+        if meta["method_name"] == "__rt_drive__":
+            # Compiled-DAG drive loop (see ray_tpu/dag.py): pins this
+            # actor to a channel-read → method → channel-write loop until
+            # the channels close. Bypasses the ordered stream — the loop
+            # intentionally occupies the actor.
+            return await self._run_channel_drive(instance, meta, loop)
         method = getattr(instance, meta["method_name"])
 
         def _args_are_light():
@@ -1776,6 +1838,37 @@ class CoreWorker:
             return self._package_returns(meta, values)
         return await loop.run_in_executor(
             self._exec_pool, lambda: self._package_returns(meta, values))
+
+    async def _run_channel_drive(self, instance, meta, loop):
+        """Execute a compiled-DAG drive loop on this actor's executor."""
+        args, _ = self._deserialize_args(meta["args"], meta["kwargs_keys"])
+        method_name, in_ch, out_ch = args
+        fn = getattr(instance, method_name)
+
+        def drive():
+            from ray_tpu.experimental.channel import ChannelClosed
+
+            while True:
+                try:
+                    value = in_ch.read(0, timeout=3600.0)
+                except ChannelClosed:
+                    return "closed"
+                if isinstance(value, TaskError):
+                    out = value  # upstream failure passes through intact
+                else:
+                    try:
+                        out = fn(value)
+                    except Exception as e:  # noqa: BLE001 - ship downstream
+                        out = TaskError(type(e).__name__, str(e),
+                                        traceback.format_exc())
+                try:
+                    out_ch.write(out)
+                except ChannelClosed:
+                    return "closed"
+
+        ex = self._actor_executors[meta["actor_id"]]
+        result = await loop.run_in_executor(ex, drive)
+        return self._package_returns(meta, [result])
 
     # ------------------------------------------------------------- misc
     def head_call(self, method: str, payload=None, timeout=30.0):

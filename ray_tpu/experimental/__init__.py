@@ -1,0 +1,3 @@
+"""Experimental substrates: mutable-object channels (compiled-DAG
+transport)."""
+from .channel import Channel, ChannelClosed  # noqa: F401

@@ -1,0 +1,125 @@
+"""Mutable-object channels: single-writer, multi-reader shm slots.
+
+Capability parity with the reference's compiled-graph channel substrate
+(reference: ``python/ray/experimental/channel/shared_memory_channel.py``
+— a mutable plasma object the writer overwrites in place and readers
+acquire/release), re-designed for this runtime as a named POSIX shm
+segment with a version/ack protocol:
+
+    [u64 version][u32 num_readers][u32 closed][u64 acks[R]][u64 len][data]
+
+- ``write`` waits until every reader acked the previous version, then
+  serializes into the slot and bumps the version (1-deep backpressure,
+  like the reference's default buffer).
+- ``read(reader_idx)`` waits for an unseen version, deserializes, acks.
+
+Channels are picklable by name; any process on the host attaches.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from typing import Any
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import _open_shm
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """One single-writer slot; create in the driver, ship to actors."""
+
+    def __init__(self, capacity_bytes: int = 1 << 20, num_readers: int = 1,
+                 *, _name: str = None):
+        self.capacity = capacity_bytes
+        self.num_readers = num_readers
+        if _name is not None:
+            self.name = _name
+            self._shm = _open_shm(self.name)
+        else:
+            self.name = "rtchan_" + ObjectID.from_random().hex()[:24]
+            size = self._data_off() + 8 + capacity_bytes
+            self._shm = _open_shm(self.name, create=True, size=size)
+            self._shm.buf[:self._data_off()] = b"\x00" * self._data_off()
+            self._shm.buf[8:12] = _U32.pack(num_readers)
+
+    def _data_off(self) -> int:
+        return 16 + 8 * self.num_readers
+
+    @classmethod
+    def _attach(cls, capacity: int, num_readers: int, name: str):
+        return cls(capacity, num_readers, _name=name)
+
+    def __reduce__(self):
+        return (Channel._attach,
+                (self.capacity, self.num_readers, self.name))
+
+    # ------------------------------------------------------------- header
+    def _version(self) -> int:
+        return _U64.unpack_from(self._shm.buf, 0)[0]
+
+    def _ack(self, idx: int) -> int:
+        return _U64.unpack_from(self._shm.buf, 16 + 8 * idx)[0]
+
+    def _closed(self) -> bool:
+        return _U32.unpack_from(self._shm.buf, 12)[0] != 0
+
+    # -------------------------------------------------------------- write
+    def write(self, value: Any, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        version = self._version()
+        while any(self._ack(i) < version for i in range(self.num_readers)):
+            if self._closed():
+                raise ChannelClosed
+            if time.time() > deadline:
+                raise TimeoutError("channel readers lagging")
+            time.sleep(0.0002)
+        blob = pickle.dumps(value, protocol=5)
+        if len(blob) > self.capacity:
+            raise ValueError(
+                f"value ({len(blob)}B) exceeds channel capacity "
+                f"({self.capacity}B)")
+        off = self._data_off()
+        self._shm.buf[off:off + 8] = _U64.pack(len(blob))
+        self._shm.buf[off + 8:off + 8 + len(blob)] = blob
+        self._shm.buf[0:8] = _U64.pack(version + 1)
+
+    # --------------------------------------------------------------- read
+    def read(self, reader_idx: int = 0, timeout: float = 30.0) -> Any:
+        deadline = time.time() + timeout
+        seen = self._ack(reader_idx)
+        while self._version() <= seen:
+            if self._closed():
+                raise ChannelClosed
+            if time.time() > deadline:
+                raise TimeoutError("channel writer idle")
+            time.sleep(0.0002)
+        version = self._version()
+        off = self._data_off()
+        (n,) = _U64.unpack_from(self._shm.buf, off)
+        value = pickle.loads(bytes(self._shm.buf[off + 8:off + 8 + n]))
+        self._shm.buf[16 + 8 * reader_idx:24 + 8 * reader_idx] = \
+            _U64.pack(version)
+        return value
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        try:
+            self._shm.buf[12:16] = _U32.pack(1)
+        except (ValueError, TypeError):
+            pass
+
+    def destroy(self) -> None:
+        self.close()
+        try:
+            self._shm.close()
+            self._shm.unlink()
+        except Exception:
+            pass

@@ -1,0 +1,90 @@
+"""Job submission client: run driver scripts on a live cluster.
+
+Capability parity with the reference's job-submission SDK (reference:
+``python/ray/dashboard/modules/job/sdk.py`` JobSubmissionClient over the
+dashboard HTTP API): submit an entrypoint shell command with an optional
+runtime_env, then poll status / tail logs / stop. Here the transport is
+the head's RPC socket directly — no HTTP hop — discovered from the
+session's ``session.json`` like the CLI.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+from ._private import rpc
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class JobSubmissionClient:
+    """Thin blocking RPC client; safe to use without ``rt.init()``."""
+
+    def __init__(self, address: Optional[str] = None):
+        if address is None:
+            from .cli import _find_session
+
+            address = _find_session()["head_sock"]
+        self.address = address
+
+    def _call(self, method: str, payload: dict) -> Any:
+        async def go():
+            conn = await rpc.connect(self.address)
+            try:
+                return await conn.call_simple(method, payload)
+            finally:
+                await conn.close()
+
+        return asyncio.run(go())
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        wire_env = None
+        if runtime_env:
+            from ._private import runtime_env as renv
+
+            wire_env = renv.prepare(
+                runtime_env,
+                lambda k, blob: self._call(
+                    "kv_put", {"ns": "default", "key": k,
+                               "value": bytes(blob)}))
+        out = self._call("submit_job", {
+            "entrypoint": entrypoint, "runtime_env": wire_env,
+            "submission_id": submission_id})
+        return out["job_id"]
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._call("job_status", {"job_id": job_id})["status"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        return self._call("job_status", {"job_id": job_id})
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._call("list_jobs", {})
+
+    def stop_job(self, job_id: str) -> Dict[str, Any]:
+        return self._call("stop_job", {"job_id": job_id})
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._call("job_logs", {"job_id": job_id})["logs"]
+
+    def wait_until_finished(self, job_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.time() + timeout
+        status = self.get_job_status(job_id)
+        while status not in JobStatus.TERMINAL:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {status} after {timeout}s")
+            time.sleep(0.5)
+            status = self.get_job_status(job_id)
+        return status

@@ -1,0 +1,89 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Capability parity with the reference's ``ray.util.ActorPool``
+(reference: ``python/ray/util/actor_pool.py``): submit/get_next,
+map/map_unordered generators, push/pop of idle actors.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        import ray_tpu as rt
+
+        self._rt = rt
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._pending_order: List[Any] = []  # refs in submission order
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any):
+        """fn(actor, value) -> ObjectRef; blocks if no actor is idle."""
+        if not self._idle:
+            self._wait_for_one()
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._pending_order.append(ref)
+        return ref
+
+    def _wait_for_one(self):
+        refs = list(self._future_to_actor)
+        ready, _ = self._rt.wait(refs, num_returns=1)
+        for ref in ready:
+            self._reclaim(ref)
+
+    def _reclaim(self, ref):
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+
+    def has_next(self) -> bool:
+        return bool(self._pending_order)
+
+    def get_next(self, timeout=None):
+        """Next result in submission order."""
+        if not self._pending_order:
+            raise StopIteration("no pending results")
+        ref = self._pending_order[0]
+        value = self._rt.get(ref, timeout=timeout)
+        # Pop only after a successful get: a timeout must leave the
+        # result retrievable and the actor reclaimable.
+        self._pending_order.pop(0)
+        self._reclaim(ref)
+        return value
+
+    def get_next_unordered(self, timeout=None):
+        if not self._pending_order:
+            raise StopIteration("no pending results")
+        ready, _ = self._rt.wait(self._pending_order, num_returns=1,
+                                 timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result ready")
+        ref = ready[0]
+        self._pending_order.remove(ref)
+        value = self._rt.get(ref)
+        self._reclaim(ref)
+        return value
+
+    def map(self, fn, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def push(self, actor):
+        self._idle.append(actor)
+
+    def pop_idle(self):
+        return self._idle.pop(0) if self._idle else None

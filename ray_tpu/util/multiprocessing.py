@@ -1,0 +1,126 @@
+"""multiprocessing.Pool-compatible API over remote tasks.
+
+Capability parity with the reference's ``ray.util.multiprocessing.Pool``
+(reference: ``python/ray/util/multiprocessing/pool.py``): map/starmap/
+imap/apply_async with chunking, running each chunk as a cluster task so
+the pool spans hosts instead of one machine's forks.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+
+def _run_chunk(fn, chunk, star):
+    return [fn(*item) if star else fn(item) for item in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu as rt
+
+        outs = rt.get(self._refs, timeout=timeout)
+        flat = [v for chunk in outs for v in chunk]
+        return flat[0] if self._single else flat
+
+    def wait(self, timeout: Optional[float] = None):
+        import ray_tpu as rt
+
+        rt.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu as rt
+
+        ready, _ = rt.wait(self._refs, num_returns=len(self._refs),
+                           timeout=0)
+        return len(ready) == len(self._refs)
+
+
+class Pool:
+    """Task-backed process pool; ``processes`` bounds in-flight chunks."""
+
+    def __init__(self, processes: Optional[int] = None):
+        import os
+
+        import ray_tpu as rt
+
+        if not rt.is_initialized():
+            rt.init(ignore_reinit_error=True)
+        self._rt = rt
+        self._processes = processes or os.cpu_count() or 4
+        self._runner = rt.remote(_run_chunk)
+        self._closed = False
+
+    def _chunks(self, iterable: Iterable[Any], chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _submit(self, fn, chunks, star) -> List[Any]:
+        if self._closed:
+            raise ValueError("Pool not running")
+        return [self._runner.remote(fn, chunk, star) for chunk in chunks]
+
+    def map(self, fn: Callable, iterable: Iterable[Any],
+            chunksize: Optional[int] = None) -> List[Any]:
+        return AsyncResult(
+            self._submit(fn, self._chunks(iterable, chunksize),
+                         False)).get()
+
+    def starmap(self, fn: Callable, iterable: Iterable[Any],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return AsyncResult(
+            self._submit(fn, self._chunks(iterable, chunksize),
+                         True)).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(
+            self._submit(fn, self._chunks(iterable, chunksize), False))
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        kwds = kwds or {}
+        return AsyncResult(
+            [self._runner.remote(lambda _: fn(*args, **kwds), [None],
+                                 False)], single=True)
+
+    def imap(self, fn: Callable, iterable: Iterable[Any],
+             chunksize: Optional[int] = None):
+        refs = self._submit(fn, self._chunks(iterable, chunksize), False)
+        for ref in refs:  # submission order
+            for v in self._rt.get(ref):
+                yield v
+
+    def imap_unordered(self, fn, iterable, chunksize=None):
+        refs = self._submit(fn, self._chunks(iterable, chunksize), False)
+        pending = list(refs)
+        while pending:
+            # wait() may return MORE than num_returns ready refs; consume
+            # them all or they vanish from `pending`.
+            ready, pending = self._rt.wait(pending, num_returns=1)
+            for ref in ready:
+                for v in self._rt.get(ref):
+                    yield v
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

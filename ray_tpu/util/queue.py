@@ -1,0 +1,144 @@
+"""Distributed FIFO queue backed by an actor.
+
+Capability parity with the reference's ``ray.util.queue.Queue``
+(reference: ``python/ray/util/queue.py`` — an asyncio.Queue inside a
+detached-able actor, blocking put/get with timeouts from any process).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+
+        self.q = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None):
+        import asyncio
+
+        try:
+            if timeout is None:
+                await self.q.put(item)
+            else:
+                await asyncio.wait_for(self.q.put(item), timeout)
+        except asyncio.TimeoutError:
+            return False
+        return True
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+
+        try:
+            if timeout is None:
+                return True, await self.q.get()
+            return True, await asyncio.wait_for(self.q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def put_nowait(self, item):
+        if self.q.full():
+            return False
+        self.q.put_nowait(item)
+        return True
+
+    def get_nowait(self):
+        if self.q.empty():
+            return False, None
+        return True, self.q.get_nowait()
+
+    def qsize(self) -> int:
+        return self.q.qsize()
+
+    def empty(self) -> bool:
+        return self.q.empty()
+
+    def full(self) -> bool:
+        return self.q.full()
+
+
+class Queue:
+    """Cross-process queue; handles are picklable (they carry the actor)."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: dict = None,
+                 _actor=None):
+        import ray_tpu as rt
+
+        if _actor is not None:
+            self.actor = _actor
+        else:
+            opts = dict(actor_options or {})
+            opts.setdefault("max_concurrency", 8)  # blocking put+get mix
+            self.actor = rt.remote(_QueueActor).options(**opts).remote(
+                maxsize)
+
+    @classmethod
+    def _attach(cls, actor):
+        return cls(_actor=actor)
+
+    def __reduce__(self):
+        return (Queue._attach, (self.actor,))
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None):
+        import ray_tpu as rt
+
+        if not block:
+            if not rt.get(self.actor.put_nowait.remote(item)):
+                raise Full
+            return
+        if not rt.get(self.actor.put.remote(item, timeout)):
+            raise Full
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu as rt
+
+        if not block:
+            ok, item = rt.get(self.actor.get_nowait.remote())
+        else:
+            ok, item = rt.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        import ray_tpu as rt
+
+        return rt.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        import ray_tpu as rt
+
+        return rt.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        import ray_tpu as rt
+
+        return rt.get(self.actor.full.remote())
+
+    def put_batch(self, items: List[Any]):
+        for it in items:
+            self.put(it)
+
+    def shutdown(self):
+        import ray_tpu as rt
+
+        try:
+            rt.kill(self.actor)
+        except Exception:
+            pass

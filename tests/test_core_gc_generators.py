@@ -24,12 +24,21 @@ def _shm_used():
 
 
 def test_put_del_frees_memory_store(rt_cluster):
+    # Grace-delayed borrow releases from earlier tests can free entries
+    # mid-test; settle first, then allow only shrinkage.
     before = _store_size()
+    deadline = time.time() + 8
+    while time.time() < deadline:
+        time.sleep(0.5)
+        now = _store_size()
+        if now == before:
+            break
+        before = now
     ref = rt.put({"some": "value"})
     assert _store_size() == before + 1
     del ref
     gc.collect()
-    assert _store_size() == before
+    assert _store_size() <= before
 
 
 def test_put_del_frees_shm(rt_cluster):
