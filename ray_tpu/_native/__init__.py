@@ -1,0 +1,63 @@
+"""Native codec loader: compile-on-first-use with a pure-Python fallback.
+
+The reference ships its data plane as prebuilt C++ (bazel targets under
+``src/ray/``); this runtime compiles its single-file extension lazily with
+the system compiler and caches the .so next to the source, keyed by the
+python ABI. If no compiler is available the callers fall back to the
+Python implementations in ``_private/serialization.py``.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def _so_path() -> str:
+    tag = sysconfig.get_config_var("SOABI") or "generic"
+    return os.path.join(_here, f"_rt_native.{tag}.so")
+
+
+def _build() -> str:
+    src = os.path.join(_here, "codec.cpp")
+    out = _so_path()
+    if os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    include = sysconfig.get_paths()["include"]
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+           f"-I{include}", src, "-o", out + ".tmp"]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(out + ".tmp", out)
+    return out
+
+
+def load():
+    """The native module, or None when unavailable."""
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    with _lock:
+        if _mod is not None or _tried:
+            return _mod
+        _tried = True
+        if os.environ.get("RT_DISABLE_NATIVE", "") == "1":
+            return None
+        try:
+            so = _build()
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location("_rt_native", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _mod = mod
+        except Exception:  # noqa: BLE001 - fall back to pure python
+            _mod = None
+        return _mod

@@ -162,6 +162,14 @@ class HeadService:
         self._loop = asyncio.get_running_loop()
         os.makedirs(os.path.join(self.session_dir, "workers"), exist_ok=True)
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        # Head restart on an existing session dir adopts the durable
+        # control-plane state (GCS-restart analogue).
+        state_path = os.path.join(self.session_dir, "head_state.pkl")
+        if os.path.exists(state_path):
+            try:
+                self.restore_state(state_path)
+            except Exception:  # noqa: BLE001 - a bad snapshot can't brick
+                pass
         self._server = rpc.RpcServer(self._handle, path=self.sock_path)
         await self._server.start()
         # TCP listener for remote node daemons / workers / drivers
@@ -194,6 +202,10 @@ class HeadService:
 
     async def stop(self):
         self._shutting_down = True
+        try:
+            self.persist_state()
+        except Exception:  # noqa: BLE001
+            pass
         if self.dashboard is not None:
             await self.dashboard.stop()
         if self._reaper_task:
@@ -228,9 +240,23 @@ class HeadService:
 
     async def _reap_loop(self):
         period = self.config.health_check_period_s
+        last_persist = time.time()
         while True:
             await asyncio.sleep(period)
             self._poll_jobs()
+            if time.time() - last_persist > 10.0:
+                last_persist = time.time()
+                try:
+                    # Dict walk on the loop (no concurrent mutation);
+                    # only pickle+write leave the thread.
+                    data = self.snapshot_state()
+                    await self._loop.run_in_executor(
+                        None, self._write_snapshot, data)
+                except Exception:  # noqa: BLE001 - keep the reaper alive
+                    import traceback as _tb
+
+                    print("head: state persist failed:",
+                          _tb.format_exc(limit=2), file=sys.stderr)
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None:
                     await self._on_worker_death(
@@ -1195,6 +1221,113 @@ class HeadService:
         except OSError:
             data = b""
         return {"logs": data.decode("utf-8", "replace")}
+
+    # -------------------------------------------------------- persistence
+    def snapshot_state(self) -> dict:
+        """Durable control-plane state (reference: GCS tables behind
+        Redis, ``store_client/redis_store_client.h``): KV, named actors +
+        actor metadata, placement-group specs, job records, job counter.
+        Live worker processes are NOT part of it — like a GCS restart,
+        compute is re-created, metadata survives.
+
+        MUST run on the event-loop thread (it iterates live dicts);
+        pickling/writing the result may be offloaded."""
+        actors = [{
+            "actor_id": a.actor_id.hex(), "name": a.name, "state": a.state,
+            "resources": dict(a.resources), "max_restarts": a.max_restarts,
+            "spec_meta": a.creation_spec_meta, "strategy": a.strategy,
+            "detached": a.detached, "death_cause": a.death_cause,
+        } for a in list(self.actors.values())]
+        pgs = [{
+            "pg_id": pg.pg_id.hex(), "strategy": pg.strategy,
+            "name": pg.name,
+            "bundles": [dict(b.resources) for b in pg.bundles],
+        } for pg in list(self.pgs.values()) if pg.state != "REMOVED"]
+        return {
+            "kv": {ns: dict(store) for ns, store in list(self.kv.items())},
+            "actors": actors,
+            "pgs": pgs,
+            "jobs": [self._job_public(j) for j in list(self.jobs.values())],
+            "job_counter": self.job_counter,
+            "timestamp": time.time(),
+        }
+
+    def _write_snapshot(self, data: dict) -> str:
+        """Blocking half (pickle + atomic write) — executor-safe."""
+        import cloudpickle
+
+        path = os.path.join(self.session_dir, "head_state.pkl")
+        with open(path + ".tmp", "wb") as f:
+            f.write(cloudpickle.dumps(data))
+        os.replace(path + ".tmp", path)
+        return path
+
+    def persist_state(self) -> str:
+        return self._write_snapshot(self.snapshot_state())
+
+    def restore_state(self, path: str) -> None:
+        """Adopt a previous head's durable state. Actors whose processes
+        died with the old head are recorded DEAD (their names stay
+        resolvable for diagnosis until re-created); PGs re-enter PENDING
+        and re-reserve once nodes attach."""
+        import cloudpickle
+
+        with open(path, "rb") as f:
+            st = cloudpickle.loads(f.read())
+        for ns, store in st["kv"].items():
+            self.kv[ns].update(store)
+        for rec in st["actors"]:
+            actor_id = ActorID.from_hex(rec["actor_id"])
+            a = ActorInfo(
+                actor_id=actor_id, name=rec["name"], state="DEAD",
+                worker=None, resources=rec["resources"],
+                max_restarts=rec["max_restarts"],
+                creation_spec_meta=rec["spec_meta"],
+                strategy=rec["strategy"], detached=rec["detached"],
+                # Actors already dead before the restart keep their real
+                # death cause; live ones died with their processes.
+                death_cause=(rec["death_cause"]
+                             if rec["state"] == "DEAD"
+                             else "head restarted (process lost)"),
+                registered_at=time.time(),
+            )
+            self.actors[actor_id] = a
+            if a.name and a.name not in self.named_actors:
+                self.named_actors[a.name] = actor_id
+        for rec in st["pgs"]:
+            pg_id = PlacementGroupID.from_hex(rec["pg_id"])
+            bundles = [Bundle(i, dict(r))
+                       for i, r in enumerate(rec["bundles"])]
+            self.pgs[pg_id] = PlacementGroupInfo(
+                pg_id=pg_id, bundles=bundles, strategy=rec["strategy"],
+                state="PENDING", name=rec["name"],
+                remaining=[dict(b.resources) for b in bundles],
+                bundle_nodes=[None] * len(bundles))
+        for job in st["jobs"]:
+            job = dict(job)
+            if job["status"] in ("PENDING", "RUNNING"):
+                job["status"] = "FAILED"
+                job["finished_at"] = job.get("finished_at") or time.time()
+            self.jobs[job["job_id"]] = job
+        self.job_counter = max(self.job_counter, st.get("job_counter", 0))
+
+    async def _rpc_persist_state(self, payload, bufs):
+        return {"path": self.persist_state()}
+
+    async def _rpc_autoscaler_state(self, payload, bufs):
+        """Demand signals for the autoscaler loop (reference: v2 instance
+        manager reads cluster resource state from the GCS)."""
+        unplaced = 0
+        for pg in self.pgs.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                unplaced += sum(1 for n in pg.bundle_nodes if n is None)
+        return {
+            "pending_lease_requests": len(self._pending_leases),
+            "unplaced_pg_bundles": unplaced,
+            "node_utilization": {
+                n.node_id: n.utilization()
+                for n in self._alive_nodes() if not n.is_head},
+        }
 
     def metrics_text(self) -> str:
         """Cluster-merged prometheus exposition."""

@@ -26,7 +26,25 @@ _counter_lock = threading.Lock()
 _counter = 0
 
 
+# Burst-submission hot path: os.urandom per ID costs ~0.1ms via a
+# syscall. A per-process random seed + atomic counter keeps IDs unique
+# at ~no cost per ID. Layout matters: ``ObjectID.for_task_return``
+# truncates the FINAL 2 bytes, so both the counter (intra-process
+# uniqueness) and the seed (cross-process uniqueness, 4 bytes + pid mixed
+# in) must sit in the first 8 of these 10 bytes.
+_proc_seed = bytes(a ^ b for a, b in zip(
+    os.urandom(6), os.getpid().to_bytes(6, "big", signed=False)))
+_seq_lock = threading.Lock()
+_seq = 0
+
+
 def _rand_bytes(n: int) -> bytes:
+    global _seq
+    if n == 10:
+        with _seq_lock:
+            _seq += 1
+            s = _seq & 0xFFFFFFFF
+        return _proc_seed[:4] + s.to_bytes(4, "big") + _proc_seed[4:6]
     return os.urandom(n)
 
 

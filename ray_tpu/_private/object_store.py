@@ -137,9 +137,13 @@ class SharedMemoryStore:
         )
 
     def create(self, object_id: ObjectID, frames: List[bytes]) -> int:
-        """Write frames into a new segment. Returns total bytes."""
-        blob = pack_frames(frames)
-        n = len(blob)
+        """Write frames into a new segment. Returns total bytes.
+
+        Frames scatter straight into the mapped segment (native codec)
+        — no intermediate packed blob, one copy total."""
+        from .serialization import pack_frames_into, packed_size
+
+        n = packed_size(frames)
         with self._lock:
             if self._used + n > self._capacity:
                 self._spill_lru(self._used + n - self._capacity)
@@ -147,7 +151,7 @@ class SharedMemoryStore:
                 shm = _open_shm(_shm_name(object_id), create=True, size=n)
             except FileExistsError:
                 return n  # already stored (idempotent put)
-            shm.buf[:n] = blob
+            pack_frames_into(shm.buf, 0, frames)
             self._owned[object_id] = (shm, n, None)
             self._used += n
         return n

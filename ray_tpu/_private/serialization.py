@@ -73,16 +73,45 @@ def get_context() -> SerializationContext:
     return _ctx
 
 
+def _native():
+    from ray_tpu import _native as native_pkg
+
+    return native_pkg.load()
+
+
 def pack_frames(frames: List[bytes]) -> bytes:
-    """Concatenate frames with a length-prefixed index for single-blob storage."""
+    """Concatenate frames with a length-prefixed index for single-blob
+    storage. Hot path: the native codec does it in one pass/one copy."""
+    nat = _native()
+    if nat is not None:
+        return nat.pack_frames(list(frames))
     head = struct.pack("<I", len(frames)) + b"".join(
         struct.pack("<Q", len(f)) for f in frames
     )
     return head + b"".join(bytes(f) for f in frames)
 
 
+def pack_frames_into(dst, offset: int, frames: List[bytes]) -> int:
+    """Scatter frames straight into a writable buffer (shm segment),
+    skipping the intermediate blob. Returns bytes written."""
+    nat = _native()
+    if nat is not None:
+        return nat.write_into(dst, offset, list(frames))
+    blob = pack_frames(frames)
+    dst[offset:offset + len(blob)] = blob
+    return len(blob)
+
+
+def packed_size(frames: List[bytes]) -> int:
+    return 4 + 8 * len(frames) + sum(len(f) for f in frames)
+
+
 def unpack_frames(blob) -> List[memoryview]:
     mv = memoryview(blob)
+    nat = _native()
+    if nat is not None:
+        return [mv[off:off + size]
+                for off, size in nat.frame_offsets(mv)]
     (n,) = struct.unpack("<I", mv[:4])
     sizes = struct.unpack(f"<{n}Q", mv[4 : 4 + 8 * n])
     out = []

@@ -118,6 +118,9 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
         core.start()
         _global_state["core"] = core
         atexit.register(_atexit_shutdown)
+        from ._private.usage_stats import record_feature
+
+        record_feature("core_init")
         return core
 
 
@@ -149,6 +152,12 @@ def shutdown():
     with _init_lock:
         core: CoreWorker = _global_state.get("core")
         if core is not None:
+            try:
+                from ._private.usage_stats import write_report
+
+                write_report(core.session_dir)
+            except Exception:
+                pass
             try:
                 core.release_all_leases()
             except Exception:

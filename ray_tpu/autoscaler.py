@@ -1,0 +1,136 @@
+"""Autoscaler: demand-driven node scale-up/down with pluggable providers.
+
+Capability parity with the reference's autoscaler v2 (reference:
+``python/ray/autoscaler/v2/`` — an instance manager reconciling resource
+demand from the GCS against a cloud NodeProvider; the v1 loop lives in
+``autoscaler/_private/autoscaler.py:181``). Re-designed for this runtime:
+
+- demand = the head's queued lease requests + unplaced PG bundles
+  (``autoscaler_state`` RPC),
+- an :class:`Autoscaler` loop launches nodes through a
+  :class:`NodeProvider` when demand cannot fit in current capacity and
+  retires nodes idle past ``idle_timeout_s``,
+- :class:`LocalNodeProvider` spawns real node-daemon subprocesses (the
+  test/laptop provider); cloud/k8s providers implement the same three
+  methods against their APIs. A TPU provider maps node types to slice
+  topologies (one provider request = one slice gang, never partial).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider surface (reference: ``node_provider.py``)."""
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        """Launch a node that will attach to the head; returns node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Node daemons as local subprocesses (in-process cluster analogue)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster  # ray_tpu.cluster_utils.Cluster
+        self._nodes: Dict[str, Any] = {}
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        res = dict(resources)
+        cpus = res.pop("CPU", 1)
+        tpus = res.pop("TPU", 0)
+        handle = self.cluster.add_node(num_cpus=cpus, num_tpus=tpus,
+                                       resources=res or None)
+        self._nodes[handle.node_id] = handle
+        return handle.node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        handle = self._nodes.pop(node_id, None)
+        if handle is not None:
+            self.cluster.remove_node(handle)
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+
+class Autoscaler:
+    """Reconciling loop: head demand → provider node count."""
+
+    def __init__(self, provider: NodeProvider, *,
+                 node_resources: Optional[Dict[str, float]] = None,
+                 min_nodes: int = 0, max_nodes: int = 4,
+                 idle_timeout_s: float = 30.0,
+                 poll_period_s: float = 1.0):
+        self.provider = provider
+        self.node_resources = node_resources or {"CPU": 2.0}
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_period_s = poll_period_s
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.events: List[str] = []  # human-readable scaling decisions
+
+    # ------------------------------------------------------------- state
+    def _demand(self) -> dict:
+        import ray_tpu as rt
+        from ray_tpu.core.worker import CoreWorker
+
+        return CoreWorker.current().head_call("autoscaler_state")
+
+    def reconcile_once(self) -> None:
+        state = self._demand()
+        nodes = self.provider.non_terminated_nodes()
+        pending = state["pending_lease_requests"] + \
+            state["unplaced_pg_bundles"]
+        if pending > 0 and len(nodes) < self.max_nodes:
+            n_new = min(self.max_nodes - len(nodes),
+                        max(1, pending // 2))
+            for _ in range(n_new):
+                node_id = self.provider.create_node(self.node_resources)
+                self.events.append(
+                    f"scale-up {node_id[:12]} (pending={pending})")
+            return
+        # Scale down: retire provider nodes idle past the timeout.
+        util = state["node_utilization"]  # node_id -> busy fraction
+        now = time.time()
+        for node_id in nodes:
+            busy = util.get(node_id, 1.0)
+            if busy > 0:
+                self._idle_since.pop(node_id, None)
+                continue
+            first_idle = self._idle_since.setdefault(node_id, now)
+            if (now - first_idle > self.idle_timeout_s
+                    and len(self.provider.non_terminated_nodes())
+                    > self.min_nodes):
+                self.provider.terminate_node(node_id)
+                self._idle_since.pop(node_id, None)
+                self.events.append(f"scale-down {node_id[:12]} (idle)")
+
+    # -------------------------------------------------------------- loop
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rt-autoscaler")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.poll_period_s):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 - transient head hiccups
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

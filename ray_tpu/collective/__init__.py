@@ -18,3 +18,7 @@ from .collective import (  # noqa: F401
     reducescatter,
     send,
 )
+
+from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
+_rf("collective")
+del _rf

@@ -454,6 +454,11 @@ class CoreWorker:
         self._capture_tls = threading.local()  # nested-ref capture stack
         self._prepared_envs: Dict[str, dict] = {}  # env hash → wire form
         self._applied_envs: set = set()  # env hashes live in this process
+        # Burst submission: one loop wake drains many queued submissions
+        # (run_coroutine_threadsafe per task costs ~0.3ms of loop churn).
+        self._submit_queue: deque = deque()
+        self._task_batch_queue: deque = deque()
+        self._submit_wake_scheduled = False
         self._actor_gc_enabled = (
             os.environ.get("RT_DISABLE_ACTOR_GC", "") != "1")
 
@@ -608,6 +613,110 @@ class CoreWorker:
     def run_sync(self, coro, timeout=None):
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         return fut.result(timeout)
+
+    def _enqueue_submission(self, coro) -> None:
+        """Fire-and-forget a submission coroutine with batched loop wakes:
+        deque.append per call, call_soon_threadsafe only when no drain is
+        pending — a 5000-task burst costs ~1 wake, not 5000."""
+        self._submit_queue.append(coro)
+        try:
+            self._wake_drain()
+        except RuntimeError:
+            try:
+                self._submit_queue.remove(coro)
+            except ValueError:
+                pass
+            coro.close()
+            raise
+
+    def _enqueue_batchable(self, shape, spec, borrowed) -> None:
+        """Normal tasks group per shape into multi-spec RPCs (reference:
+        the lease/push pipelining of the direct task submitter, taken one
+        step further — a burst shares wire messages, not just workers)."""
+        item = (shape, spec, borrowed)
+        self._task_batch_queue.append(item)
+        try:
+            self._wake_drain()
+        except RuntimeError:
+            try:
+                self._task_batch_queue.remove(item)
+            except ValueError:
+                pass
+            raise
+
+    def _wake_drain(self) -> None:
+        if not self._submit_wake_scheduled:
+            self._submit_wake_scheduled = True
+            try:
+                self._loop.call_soon_threadsafe(self._drain_submissions)
+            except RuntimeError:
+                # Loop closed (shutdown race): the submission can never
+                # run — surface it instead of returning dead refs.
+                self._submit_wake_scheduled = False
+                raise RuntimeError(
+                    "cannot submit: core worker is shutting down")
+
+    def _drain_submissions(self) -> None:
+        # Reset the flag BEFORE draining: a concurrent append that sees
+        # False schedules a (harmless) extra wake instead of stranding.
+        self._submit_wake_scheduled = False
+        while self._submit_queue:
+            self._loop.create_task(self._submit_queue.popleft())
+        if not self._task_batch_queue:
+            return
+        by_shape: Dict[tuple, list] = {}
+        while self._task_batch_queue:
+            shape, spec, borrowed = self._task_batch_queue.popleft()
+            by_shape.setdefault(shape, []).append((spec, borrowed))
+        for shape, items in by_shape.items():
+            if len(items) == 1:
+                spec, borrowed = items[0]
+                self._loop.create_task(
+                    self._submit_normal(spec, borrowed))
+            else:
+                self._loop.create_task(self._submit_group(shape, items))
+
+    _BATCH_CHUNK = 64
+
+    async def _submit_group(self, shape, items) -> None:
+        """Submit many same-shape specs as chunked multi-spec RPCs,
+        spreading chunks over the lease pool."""
+        chunks = [items[i:i + self._BATCH_CHUNK]
+                  for i in range(0, len(items), self._BATCH_CHUNK)]
+        await asyncio.gather(
+            *(self._submit_chunk(shape, c) for c in chunks))
+
+    async def _submit_chunk(self, shape, chunk) -> None:
+        lease = None
+        try:
+            lease = await self._acquire_lease(shape, chunk[0][0])
+            lease["inflight"] += len(chunk)
+            try:
+                metas = [self._spec_meta(spec) for spec, _ in chunk]
+                reply, bufs = await lease["conn"].call(
+                    "push_task_batch", {"specs": metas})
+            finally:
+                lease["inflight"] -= len(chunk)
+                lease["last_used"] = time.time()
+            offset = 0
+            for (spec, _), res in zip(chunk, reply["results"]):
+                n = res["nbufs"]
+                self._ingest_results(spec, res,
+                                     bufs[offset:offset + n])
+                offset += n
+            for _, borrowed in chunk:
+                self._release_borrows_later(borrowed)
+        except Exception as e:  # noqa: BLE001 - degrade to per-task path
+            # Per-task execution errors never surface here (the worker
+            # packages them into results) — this is transport/placement
+            # failure. Mark a lost connection's lease dead so the retries
+            # don't re-pick it, then re-run each spec via the retrying
+            # single-task path, which owns the borrow release.
+            if isinstance(e, rpc.ConnectionLost) and lease is not None:
+                lease["dead"] = True
+                await self._drop_lease(shape, lease, kill=True)
+            for spec, borrowed in chunk:
+                self._loop.create_task(self._submit_normal(spec, borrowed))
 
     # ------------------------------------------------------------- connections
     async def _get_conn(self, address) -> rpc.Connection:
@@ -954,8 +1063,24 @@ class CoreWorker:
         else:
             out = [ObjectRef(oid, self.address)
                    for oid in spec.return_object_ids()]
-        asyncio.run_coroutine_threadsafe(
-            self._submit_normal(spec, borrowed), self._loop)
+        # Tasks whose args carry ObjectRefs must NOT share a batch: a
+        # chunk's results ingest only when the whole chunk replies, so a
+        # task waiting on a sibling's pending result would deadlock the
+        # chunk until the pull times out. Non-DEFAULT strategies (SPREAD,
+        # affinity, PG bundles) place per task — a shared chunk would
+        # collapse them onto one lease.
+        has_ref_args = any(kind == "ref" for kind, _ in ser_args) \
+            or bool(borrowed)  # borrowed ⊇ refs nested in pickled args
+        if streaming or has_ref_args or \
+                spec.scheduling_strategy.kind != "DEFAULT":
+            self._enqueue_submission(self._submit_normal(spec, borrowed))
+        else:
+            from .._private.runtime_env import env_hash
+
+            shape = _LeaseCache.shape_key(spec.resources,
+                                          spec.scheduling_strategy,
+                                          env_hash(spec.runtime_env))
+            self._enqueue_batchable(shape, spec, borrowed)
         return out
 
     async def _submit_normal(self, spec: TaskSpec, borrowed=()):
@@ -1362,8 +1487,7 @@ class CoreWorker:
         else:
             out = [ObjectRef(oid, self.address)
                    for oid in spec.return_object_ids()]
-        asyncio.run_coroutine_threadsafe(
-            self._submit_actor_task(spec, borrowed), self._loop)
+        self._enqueue_submission(self._submit_actor_task(spec, borrowed))
         return out
 
     async def _submit_actor_task(self, spec: TaskSpec, borrowed=()):
@@ -1462,6 +1586,8 @@ class CoreWorker:
     async def _handle(self, method, payload, bufs, conn):
         if method == "push_task":
             return await self._exec_push_task(payload, bufs, conn)
+        if method == "push_task_batch":
+            return await self._exec_push_task_batch(payload, conn)
         if method == "get_object":
             return await self._exec_get_object(payload)
         if method == "ref_inc":
@@ -1624,6 +1750,59 @@ class CoreWorker:
         cm["tasks_finished"].inc()
         cm["task_duration"].observe(end - t0)
         return {"returns": returns_meta}, out_bufs
+
+    async def _exec_push_task_batch(self, payload, conn):
+        """Run a chunk of same-shape normal tasks; one combined reply
+        (driver slices bufs by count). A few executor threads each run a
+        slice sequentially — per-task executor hops dominate trivial
+        tasks, while slices keep long tasks overlapping."""
+        loop = asyncio.get_running_loop()
+        specs = payload["specs"]
+        lanes = min(4, len(specs))
+
+        from .._private.metrics import core_metrics
+
+        duration = core_metrics()["task_duration"]
+
+        def run_slice(metas):
+            out = []
+            for meta in metas:
+                t0 = time.time()
+                try:
+                    res = self._run_normal_task(meta, conn)
+                except Exception as e:  # noqa: BLE001 - e.g. unpicklable
+                    # One task's packaging failure must not error the
+                    # whole chunk (its siblings already ran side effects).
+                    err = TaskError(type(e).__name__, str(e),
+                                    traceback.format_exc())
+                    res = self._package_returns(
+                        meta, [err] * max(1, meta["num_returns"]))
+                out.append(res)
+                end = time.time()
+                duration.observe(end - t0)
+                self._task_events.append(
+                    {"task_id": meta["task_id"].hex(),
+                     "name": meta.get("name", ""),
+                     "start": t0, "end": end,
+                     "worker_id": self.worker_id.hex()})
+            return out
+
+        slices = [specs[i::lanes] for i in range(lanes)]
+        lane_outs = await asyncio.gather(*(
+            loop.run_in_executor(self._exec_pool, run_slice, s)
+            for s in slices))
+        # restitch round-robin slices back into spec order
+        outs: list = [None] * len(specs)
+        for lane, lane_out in enumerate(lane_outs):
+            for j, res in enumerate(lane_out):
+                outs[lane + j * lanes] = res
+        core_metrics()["tasks_finished"].inc(len(outs))
+        results, all_bufs = [], []
+        for returns_meta, out_bufs in outs:
+            results.append({"returns": returns_meta,
+                            "nbufs": len(out_bufs)})
+            all_bufs.extend(out_bufs)
+        return {"results": results}, all_bufs
 
     def _execute_function(self, meta):
         """Fetch + run the task function; returns its raw result."""

@@ -18,3 +18,7 @@ from .datasource import (  # noqa: F401
     read_text,
 )
 from .executor import ActorPoolStrategy, DataIterator  # noqa: F401
+
+from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
+_rf("data")
+del _rf

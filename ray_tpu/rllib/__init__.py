@@ -31,3 +31,7 @@ from .replay_buffer import (  # noqa: F401
     ReplayBuffer,
 )
 from .rl_module import DiscreteMLPModule, RLModuleSpec  # noqa: F401
+
+from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
+_rf("rllib")
+del _rf

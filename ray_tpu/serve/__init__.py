@@ -21,3 +21,7 @@ __all__ = [
     "get_app_handle", "get_deployment_handle", "pad_to_bucket", "run",
     "shutdown", "start", "status",
 ]
+
+from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
+_rf("serve")
+del _rf

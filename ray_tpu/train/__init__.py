@@ -32,3 +32,7 @@ from .trainer import (  # noqa: F401
     TrainingIterator,
 )
 from .worker_group import RayTrainWorker, WorkerGroup  # noqa: F401
+
+from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
+_rf("train")
+del _rf

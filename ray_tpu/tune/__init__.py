@@ -40,3 +40,7 @@ from .search import (  # noqa: F401
     uniform,
 )
 from .tuner import ResultGrid, TuneConfig, Tuner, run  # noqa: F401
+
+from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
+_rf("tune")
+del _rf

@@ -1,0 +1,116 @@
+"""Head persistence/HA, autoscaler, usage stats.
+
+Mirrors the reference's coverage (GCS fault-tolerance tests over Redis
+restarts, ``autoscaler/v2/tests``, ``test_usage_stats.py``): durable
+control-plane state survives a head restart, demand scales nodes up and
+idleness scales them down, and the usage report is local-only.
+"""
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt_mod
+from ray_tpu._private import usage_stats
+
+
+def test_head_state_snapshot_restore(tmp_path):
+    """KV + named-actor metadata + jobs survive a head restart on the
+    same session dir (GCS+Redis restart analogue)."""
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    from ray_tpu.api import _HeadThread
+    from ray_tpu._private.config import Config
+
+    session = str(tmp_path / "session")
+    os.makedirs(session)
+    ht = _HeadThread(session, Config({}), {"CPU": 4.0}).start()
+    rt.init(address=ht.head.sock_path)
+
+    @rt.remote
+    class Named:
+        def ping(self):
+            return 1
+
+    Named.options(name="survivor").remote()
+    core = __import__("ray_tpu.core.worker",
+                      fromlist=["CoreWorker"]).CoreWorker.current()
+    core.kv_put("durable_key", b"durable_value", ns="app")
+    time.sleep(0.5)
+    rt.shutdown()
+    ht.stop()  # head persists its state on stop
+    assert os.path.exists(os.path.join(session, "head_state.pkl"))
+
+    # Second head on the SAME session dir adopts the state.
+    ht2 = _HeadThread(session, Config({}), {"CPU": 4.0}).start()
+    rt.init(address=ht2.head.sock_path)
+    try:
+        core2 = __import__("ray_tpu.core.worker",
+                           fromlist=["CoreWorker"]).CoreWorker.current()
+        assert core2.kv_get("durable_key", ns="app") == b"durable_value"
+        actors = rt.state("actors")
+        survivor = [a for a in actors if a["name"] == "survivor"]
+        assert survivor and survivor[0]["state"] == "DEAD"
+        assert "head restarted" in survivor[0]["death_cause"]
+    finally:
+        rt.shutdown()
+        ht2.stop()
+
+
+def test_autoscaler_scales_up_and_down(tmp_path):
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 0.0},
+                      system_config={"worker_lease_timeout_s": 60.0})
+    rt = cluster.connect()
+    provider = LocalNodeProvider(cluster)
+    scaler = Autoscaler(provider, node_resources={"CPU": 2.0},
+                        min_nodes=0, max_nodes=2, idle_timeout_s=4.0,
+                        poll_period_s=0.5).start()
+    try:
+        @rt.remote
+        def work(x):
+            time.sleep(0.3)
+            return x
+
+        # 0 CPUs in the cluster → demand queues → scaler must add nodes.
+        refs = [work.remote(i) for i in range(6)]
+        assert rt.get(refs, timeout=90) == list(range(6))
+        assert len(provider.non_terminated_nodes()) >= 1
+        assert any("scale-up" in e for e in scaler.events)
+
+        # Idle long enough → scale back down to min_nodes.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(1.0)
+        assert not provider.non_terminated_nodes(), scaler.events
+        assert any("scale-down" in e for e in scaler.events)
+    finally:
+        scaler.stop()
+        cluster.shutdown()
+
+
+def test_usage_stats_local_only(tmp_path):
+    usage_stats.record_feature("unit_test_feature")
+    rep = usage_stats.report()
+    assert rep["features"]["unit_test_feature"] >= 1
+    path = usage_stats.write_report(str(tmp_path))
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["schema_version"] == 1
+
+    os.environ["RT_USAGE_STATS_DISABLED"] = "1"
+    try:
+        assert usage_stats.write_report(str(tmp_path / "nope")) == ""
+    finally:
+        del os.environ["RT_USAGE_STATS_DISABLED"]

@@ -171,7 +171,6 @@ def test_impala_cnn_aggregator_smoke(rt_cluster):
         algo.stop()
 
 
-@pytest.mark.timeout(600)
 def test_ppo_solves_cartpole(rt_cluster):
     """The reference tuned-example gate (cartpole_ppo.py: return ≥ 450)."""
     config = (rllib.PPOConfig()
