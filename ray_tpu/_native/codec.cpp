@@ -14,6 +14,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 
@@ -144,7 +145,6 @@ PyObject* write_into(PyObject* /*self*/, PyObject* args) {
   uint32_t n32 = static_cast<uint32_t>(n);
   uint64_t pos = offset;
   if (pos + total > cap) goto overflow;
-  std::memcpy(base + pos, &n32, 4);
   pos += 4 + 8ull * n;  // sizes written in the loop below
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
@@ -165,6 +165,11 @@ PyObject* write_into(PyObject* /*self*/, PyObject* args) {
     total += len;
     PyBuffer_Release(&v);
   }
+  // Publish-after-write: the frame count lands LAST, so a concurrent
+  // reader of a shared segment sees either count=0 (not ready → retry)
+  // or a fully written table + data — never a torn structure.
+  std::atomic_thread_fence(std::memory_order_release);
+  std::memcpy(base + offset, &n32, 4);
   Py_DECREF(seq);
   PyBuffer_Release(&dst);
   return PyLong_FromUnsignedLongLong(total);

@@ -156,18 +156,33 @@ class SharedMemoryStore:
             self._used += n
         return n
 
+    @staticmethod
+    def _safe_unpack(buf) -> Optional[List[memoryview]]:
+        """A reader can attach between the owner's segment create and its
+        frame write and observe zeros or a half-written size table.
+        Serialized values always carry ≥2 frames (header + pickle body),
+        so fewer — or a malformed table — means not-ready → None, letting
+        the caller's wait/pull path retry."""
+        try:
+            frames = unpack_frames(buf)
+        except ValueError:
+            return None
+        if len(frames) < 2:
+            return None
+        return frames
+
     def get(self, object_id: ObjectID) -> Optional[List[memoryview]]:
         with self._lock:
             ent = self._owned.get(object_id)
             if ent is not None:
                 shm, n, path = ent
                 if shm is not None:
-                    return unpack_frames(shm.buf[:n])
+                    return self._safe_unpack(shm.buf[:n])
                 with open(path, "rb") as f:  # spilled
-                    return unpack_frames(f.read())
+                    return self._safe_unpack(f.read())
             if object_id in self._attached:
                 shm = self._attached[object_id]
-                return unpack_frames(shm.buf)
+                return self._safe_unpack(shm.buf)
         # Attach to a segment owned by another process on this host.
         try:
             shm = _open_shm(_shm_name(object_id))
@@ -175,7 +190,7 @@ class SharedMemoryStore:
             return None
         with self._lock:
             self._attached[object_id] = shm
-        return unpack_frames(shm.buf)
+        return self._safe_unpack(shm.buf)
 
     def contains(self, object_id: ObjectID) -> bool:
         if object_id in self._owned or object_id in self._attached:

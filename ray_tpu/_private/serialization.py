@@ -98,7 +98,11 @@ def pack_frames_into(dst, offset: int, frames: List[bytes]) -> int:
     if nat is not None:
         return nat.write_into(dst, offset, list(frames))
     blob = pack_frames(frames)
-    dst[offset:offset + len(blob)] = blob
+    # Publish-after-write (matches the native codec): body first, the
+    # 4-byte frame count last, so a reader attached to a shared segment
+    # mid-write sees count=0 (not ready) instead of a torn structure.
+    dst[offset + 4:offset + len(blob)] = blob[4:]
+    dst[offset:offset + 4] = blob[:4]
     return len(blob)
 
 

@@ -44,7 +44,9 @@ class Channel:
             self.name = _name
             self._shm = _open_shm(self.name)
         else:
-            self.name = "rtchan_" + ObjectID.from_random().hex()[:24]
+            # FULL hex: ids are counter-based and a truncated prefix can
+            # collide within a burst (the counter sits mid-id).
+            self.name = "rtchan_" + ObjectID.from_random().hex()
             size = self._data_off() + 8 + capacity_bytes
             self._shm = _open_shm(self.name, create=True, size=size)
             self._shm.buf[:self._data_off()] = b"\x00" * self._data_off()
