@@ -87,6 +87,11 @@ PyObject* frame_offsets(PyObject* /*self*/, PyObject* arg) {
   }
   uint32_t n;
   std::memcpy(&n, p, 4);
+  // Pairs with the writer's release fence in write_into: once a nonzero
+  // count is observed, the size table and frame bytes published before
+  // it must be visible too (matters on weakly-ordered CPUs; x86 TSO
+  // gets this for free).
+  std::atomic_thread_fence(std::memory_order_acquire);
   if (remaining < 4 + 8ull * n) {
     PyBuffer_Release(&view);
     PyErr_SetString(PyExc_ValueError, "blob too short for size table");
@@ -101,7 +106,9 @@ PyObject* frame_offsets(PyObject* /*self*/, PyObject* arg) {
   for (uint32_t i = 0; i < n; i++) {
     uint64_t len;
     std::memcpy(&len, p + 4 + 8ull * i, 8);
-    if (off + len > remaining) {
+    // Subtraction form: `off + len` can wrap for a torn/corrupt u64 size
+    // (off <= remaining holds inductively, so the subtraction is safe).
+    if (len > remaining - off) {
       Py_DECREF(out);
       PyBuffer_Release(&view);
       PyErr_SetString(PyExc_ValueError, "frame overruns blob");

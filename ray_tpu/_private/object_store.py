@@ -162,7 +162,9 @@ class SharedMemoryStore:
         frame write and observe zeros or a half-written size table.
         Serialized values always carry ≥2 frames (header + pickle body),
         so fewer — or a malformed table — means not-ready → None, letting
-        the caller's wait/pull path retry."""
+        the caller's wait/pull path retry. Only valid on *attach* paths:
+        owned/spilled entries are fully written before registration, so
+        malformed data there is corruption and must raise."""
         try:
             frames = unpack_frames(buf)
         except ValueError:
@@ -177,9 +179,9 @@ class SharedMemoryStore:
             if ent is not None:
                 shm, n, path = ent
                 if shm is not None:
-                    return self._safe_unpack(shm.buf[:n])
+                    return unpack_frames(shm.buf[:n])
                 with open(path, "rb") as f:  # spilled
-                    return self._safe_unpack(f.read())
+                    return unpack_frames(f.read())
             if object_id in self._attached:
                 shm = self._attached[object_id]
                 return self._safe_unpack(shm.buf)

@@ -101,8 +101,12 @@ def pack_frames_into(dst, offset: int, frames: List[bytes]) -> int:
     # Publish-after-write (matches the native codec): body first, the
     # 4-byte frame count last, so a reader attached to a shared segment
     # mid-write sees count=0 (not ready) instead of a torn structure.
-    dst[offset + 4:offset + len(blob)] = blob[4:]
-    dst[offset:offset + 4] = blob[:4]
+    # Pure Python cannot issue a release fence, so this ordering is only
+    # guaranteed on TSO hardware (x86); the native codec carries the
+    # proper release/acquire pair for weakly-ordered CPUs.
+    mv = memoryview(blob)
+    dst[offset + 4:offset + len(blob)] = mv[4:]
+    dst[offset:offset + 4] = mv[:4]
     return len(blob)
 
 
@@ -116,11 +120,20 @@ def unpack_frames(blob) -> List[memoryview]:
     if nat is not None:
         return [mv[off:off + size]
                 for off, size in nat.frame_offsets(mv)]
+    # Same error contract as the native frame_offsets: ValueError on a
+    # short header/table or a frame overrunning the blob (never
+    # struct.error, never silently truncated frames).
+    if len(mv) < 4:
+        raise ValueError("blob too short for header")
     (n,) = struct.unpack("<I", mv[:4])
+    if len(mv) < 4 + 8 * n:
+        raise ValueError("blob too short for size table")
     sizes = struct.unpack(f"<{n}Q", mv[4 : 4 + 8 * n])
     out = []
     off = 4 + 8 * n
     for s in sizes:
+        if off + s > len(mv):
+            raise ValueError("frame overruns blob")
         out.append(mv[off : off + s])
         off += s
     return out

@@ -263,6 +263,14 @@ class RemoteFunction:
         rf._fn_key = self._fn_key
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Build a workflow DAG node (reference: ``fn.bind`` →
+        ``python/ray/dag/function_node.py``); consumed by
+        :mod:`ray_tpu.workflow`."""
+        from .workflow.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         core = _core()
         if self._fn_key is None:

@@ -1,0 +1,27 @@
+"""Durable workflows (reference: ``python/ray/workflow/`` — DAGs of
+remote tasks with storage-backed step checkpoints and crash resume)."""
+from .api import (init, run, run_async, resume, resume_async, resume_all,
+                  cancel, delete, list_all, get_output, get_status,
+                  get_metadata, sleep, wait_for_event, continuation,
+                  options, EventListener)
+from .common import (Continuation, WorkflowCancellationError, WorkflowError,
+                     WorkflowExecutionError, WorkflowNotFoundError,
+                     WorkflowStatus)
+from .node import FunctionNode
+
+RUNNING = WorkflowStatus.RUNNING
+PENDING = WorkflowStatus.PENDING
+SUCCESSFUL = WorkflowStatus.SUCCESSFUL
+FAILED = WorkflowStatus.FAILED
+RESUMABLE = WorkflowStatus.RESUMABLE
+CANCELED = WorkflowStatus.CANCELED
+
+__all__ = [
+    "init", "run", "run_async", "resume", "resume_async", "resume_all",
+    "cancel", "delete", "list_all", "get_output", "get_status",
+    "get_metadata", "sleep", "wait_for_event", "continuation", "options",
+    "EventListener", "FunctionNode", "Continuation", "WorkflowStatus",
+    "WorkflowError", "WorkflowExecutionError", "WorkflowCancellationError",
+    "WorkflowNotFoundError", "RUNNING", "PENDING", "SUCCESSFUL", "FAILED",
+    "RESUMABLE", "CANCELED",
+]

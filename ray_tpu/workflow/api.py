@@ -1,0 +1,279 @@
+"""Public workflow API (reference: ``python/ray/workflow/api.py``).
+
+Usage::
+
+    import ray_tpu as rt
+    from ray_tpu import workflow
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    out = workflow.run(add.bind(add.bind(1, 2), 3), workflow_id="sum3")
+    workflow.resume("sum3")      # no-op: every task checkpointed
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .common import (WorkflowCancellationError, WorkflowError,
+                     WorkflowExecutionError, WorkflowNotFoundError,
+                     WorkflowStatus, Continuation)
+from .executor import WorkflowExecutor
+from .node import FunctionNode
+from .storage import WorkflowStorage
+
+_lock = threading.Lock()
+_storage: Optional[WorkflowStorage] = None
+# Runs owned by this process: workflow_id -> (thread, result-holder).
+_running: Dict[str, "_Run"] = {}
+
+
+class _Run:
+    def __init__(self, thread: threading.Thread):
+        self.thread = thread
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Bind workflow storage to a directory (default
+    ``$RT_WORKFLOW_STORAGE`` or ``~/ray_tpu_workflows``)."""
+    global _storage
+    with _lock:
+        _storage = WorkflowStorage(storage)
+
+
+def _store() -> WorkflowStorage:
+    global _storage
+    with _lock:
+        if _storage is None:
+            _storage = WorkflowStorage()
+        return _storage
+
+
+def options(**kw) -> Dict[str, Any]:
+    """Per-task workflow options, spliced through ``fn.options``::
+
+        fn.options(**workflow.options(max_retries=3, checkpoint=False))
+
+    Known keys: ``name``, ``max_retries``, ``catch_exceptions``,
+    ``checkpoint`` (reference: ``workflow.options`` metadata dict).
+    """
+    bad = set(kw) - {"name", "max_retries", "catch_exceptions", "checkpoint"}
+    if bad:
+        raise ValueError(f"unknown workflow options: {sorted(bad)}")
+    return {"workflow_options": kw}
+
+
+def continuation(node: FunctionNode) -> Continuation:
+    """Return from a task to dynamically extend the workflow."""
+    if not isinstance(node, FunctionNode):
+        raise TypeError("continuation() takes a bound DAG node")
+    return Continuation(node)
+
+
+# ----------------------------------------------------------------------
+def run(dag: FunctionNode, *, workflow_id: Optional[str] = None,
+        metadata: Optional[dict] = None) -> Any:
+    """Run a DAG durably to completion; blocks and returns the output."""
+    return get_output(run_async(dag, workflow_id=workflow_id,
+                                metadata=metadata))
+
+
+def run_async(dag: FunctionNode, *, workflow_id: Optional[str] = None,
+              metadata: Optional[dict] = None) -> str:
+    """Start a durable run in the background; returns the workflow id."""
+    if not isinstance(dag, FunctionNode):
+        raise TypeError("workflow.run takes a DAG built with fn.bind(...)")
+    wid = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+    store = _store()
+    if wid in _running and _running[wid].thread.is_alive():
+        raise WorkflowError(f"Workflow[id={wid}] is already running")
+    store.create(wid, dag, metadata or {})
+    return _launch(store, wid, dag)
+
+
+def _launch(store: WorkflowStorage, wid: str, dag: FunctionNode) -> str:
+    run_rec = _Run(None)  # type: ignore[arg-type]
+
+    def body():
+        try:
+            run_rec.result = WorkflowExecutor(store, wid).run(dag)
+        except BaseException as e:  # noqa: BLE001 - stored for get_output
+            run_rec.error = e
+
+    t = threading.Thread(target=body, name=f"workflow-{wid}", daemon=True)
+    run_rec.thread = t
+    _running[wid] = run_rec
+    t.start()
+    return wid
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run from storage, skipping checkpointed tasks; blocks."""
+    return get_output(resume_async(workflow_id))
+
+
+def resume_async(workflow_id: str) -> str:
+    store = _store()
+    status = store.get_status(workflow_id)
+    if status is None:
+        raise WorkflowNotFoundError(workflow_id)
+    if status == WorkflowStatus.SUCCESSFUL:
+        return workflow_id
+    rec = _running.get(workflow_id)
+    if rec is not None and rec.thread.is_alive():
+        return workflow_id  # still running here
+    dag = store.load_dag(workflow_id)
+    store.set_status(workflow_id, WorkflowStatus.RUNNING,
+                     metadata={"resumed_at": time.time()})
+    return _launch(store, workflow_id, dag)
+
+
+def resume_all() -> List[str]:
+    """Resume every workflow that is not terminal (reference:
+    ``workflow.resume_all`` after cluster restart)."""
+    out = []
+    for wid in list_all():
+        st = get_status(wid)
+        if st in (WorkflowStatus.RESUMABLE, WorkflowStatus.RUNNING):
+            rec = _running.get(wid)
+            if rec is not None and rec.thread.is_alive():
+                continue
+            resume_async(wid)
+            out.append(wid)
+    return out
+
+
+def get_output(workflow_id: str, timeout: Optional[float] = None) -> Any:
+    store = _store()
+    rec = _running.get(workflow_id)
+    if rec is not None:
+        rec.thread.join(timeout)
+        if rec.thread.is_alive():
+            raise TimeoutError(
+                f"Workflow[id={workflow_id}] still running after {timeout}s")
+        if rec.error is not None:
+            raise rec.error
+        return rec.result
+    status = store.get_status(workflow_id)
+    if status is None:
+        raise WorkflowNotFoundError(workflow_id)
+    if status == WorkflowStatus.SUCCESSFUL:
+        return store.load_output(workflow_id)
+    if status == WorkflowStatus.FAILED:
+        err = store.load_error(workflow_id)
+        wrapped = WorkflowExecutionError(workflow_id)
+        wrapped.__cause__ = err
+        raise wrapped
+    if status == WorkflowStatus.CANCELED:
+        raise WorkflowCancellationError(workflow_id)
+    raise WorkflowError(
+        f"Workflow[id={workflow_id}] has no output yet "
+        f"(status {status.value}; resume() it first)")
+
+
+def get_status(workflow_id: str) -> WorkflowStatus:
+    status = _store().get_status(workflow_id)
+    if status is None:
+        raise WorkflowNotFoundError(workflow_id)
+    if status == WorkflowStatus.RUNNING:
+        rec = _running.get(workflow_id)
+        if rec is None or not rec.thread.is_alive():
+            # RUNNING in storage but no live executor in this process:
+            # the owning process died → resumable (reference maps stale
+            # RUNNING the same way on recovery).
+            return WorkflowStatus.RESUMABLE
+    return status
+
+
+def get_metadata(workflow_id: str) -> dict:
+    meta = _store().get_meta(workflow_id)
+    if meta is None:
+        raise WorkflowNotFoundError(workflow_id)
+    return {"workflow_id": workflow_id,
+            "status": get_status(workflow_id).value, **meta}
+
+
+def list_all(status_filter=None) -> List[str]:
+    wids = _store().list_all()
+    if status_filter is None:
+        return wids
+    want = {WorkflowStatus(s) for s in (
+        status_filter if isinstance(status_filter, (list, set, tuple))
+        else [status_filter])}
+    return [w for w in wids if get_status(w) in want]
+
+
+def cancel(workflow_id: str) -> None:
+    store = _store()
+    if store.get_status(workflow_id) is None:
+        raise WorkflowNotFoundError(workflow_id)
+    store.set_status(workflow_id, WorkflowStatus.CANCELED)
+
+
+def delete(workflow_id: str) -> None:
+    store = _store()
+    if store.get_status(workflow_id) is None:
+        raise WorkflowNotFoundError(workflow_id)
+    rec = _running.get(workflow_id)
+    if rec is not None and rec.thread.is_alive():
+        raise WorkflowError(
+            f"Workflow[id={workflow_id}] is running; cancel it first")
+    store.delete(workflow_id)
+    _running.pop(workflow_id, None)
+
+
+# ----------------------------------------------------------------------
+def sleep(duration: float) -> FunctionNode:
+    """A durable sleep task: the wakeup deadline is checkpointed, so a
+    resumed run sleeps only the remainder."""
+    from .. import api as rt_api
+
+    @rt_api.remote
+    def __rt_workflow_sleep(deadline: float):
+        time.sleep(max(0.0, deadline - time.time()))
+        return None
+
+    node = __rt_workflow_sleep.bind(duration)
+    node.is_sleep = True
+    node.name = "sleep"
+    return node
+
+
+class EventListener:
+    """Subclass and implement :meth:`poll_for_event`; pass to
+    :func:`wait_for_event` (reference:
+    ``python/ray/workflow/event_listener.py``)."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> FunctionNode:
+    """A task that blocks until ``listener_cls().poll_for_event(*args)``
+    returns; its return value becomes the task output."""
+    from .. import api as rt_api
+
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event takes an EventListener subclass")
+
+    @rt_api.remote
+    def __rt_workflow_event(cls, a, kw):
+        res = cls().poll_for_event(*a, **kw)
+        import inspect
+
+        if inspect.iscoroutine(res):
+            import asyncio
+
+            res = asyncio.run(res)
+        return res
+
+    node = __rt_workflow_event.bind(listener_cls, args, kwargs)
+    node.name = "wait_for_event"
+    return node

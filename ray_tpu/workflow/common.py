@@ -1,0 +1,59 @@
+"""Workflow common types (reference: ``python/ray/workflow/common.py``
+``WorkflowStatus``, ``python/ray/workflow/exceptions.py``).
+
+A workflow is a DAG of task nodes (built with ``fn.bind(...)``) executed
+durably: every task's result is checkpointed to storage so a crashed or
+cancelled run can ``resume`` and skip completed work.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any
+
+
+class WorkflowStatus(str, enum.Enum):
+    # Values mirror the reference's states so user code matching on strings
+    # ports over unchanged.
+    RUNNING = "RUNNING"
+    PENDING = "PENDING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+    CANCELED = "CANCELED"
+
+
+class WorkflowError(Exception):
+    """Base class for workflow errors."""
+
+
+class WorkflowExecutionError(WorkflowError):
+    """A workflow task raised; carries the original cause as __cause__."""
+
+    def __init__(self, workflow_id: str, task_id: str = ""):
+        self.workflow_id = workflow_id
+        self.task_id = task_id
+        super().__init__(
+            f"Workflow[id={workflow_id}] failed"
+            + (f" at task [{task_id}]" if task_id else ""))
+
+
+class WorkflowCancellationError(WorkflowError):
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        super().__init__(f"Workflow[id={workflow_id}] was cancelled")
+
+
+class WorkflowNotFoundError(WorkflowError):
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        super().__init__(f"Workflow[id={workflow_id}] not found in storage")
+
+
+@dataclass
+class Continuation:
+    """Returned by a task to dynamically extend the workflow
+    (reference: ``workflow.continuation`` — the returned DAG runs as a
+    sub-workflow and its output becomes the task's output)."""
+
+    node: Any  # a FunctionNode

@@ -1,0 +1,164 @@
+"""Workflow executor (reference:
+``python/ray/workflow/workflow_executor.py`` — drives the task DAG,
+checkpointing each task's output and skipping already-checkpointed tasks
+on resume).
+
+Independent ready tasks are submitted concurrently as ordinary remote
+tasks; completion is event-driven via ``rt.wait``. A task returning a
+:class:`Continuation` dynamically extends the run — its sub-DAG executes
+under the parent task's id prefix so nested checkpoints resume too.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+from .. import api as rt
+from .common import (Continuation, WorkflowCancellationError,
+                     WorkflowExecutionError, WorkflowStatus)
+from .node import FunctionNode, assign_task_ids, substitute
+from .storage import WorkflowStorage
+
+
+class WorkflowExecutor:
+    def __init__(self, storage: WorkflowStorage, workflow_id: str):
+        self.storage = storage
+        self.workflow_id = workflow_id
+        self._cancel_poll = 0.0
+
+    # ------------------------------------------------------------------
+    def run(self, root: FunctionNode) -> Any:
+        try:
+            out = self._run_dag(root, prefix="")
+            self.storage.save_output(self.workflow_id, out)
+            self.storage.set_status(self.workflow_id,
+                                    WorkflowStatus.SUCCESSFUL,
+                                    metadata={"finished_at": time.time()})
+            return out
+        except WorkflowCancellationError:
+            self.storage.set_status(self.workflow_id,
+                                    WorkflowStatus.CANCELED)
+            raise
+        except WorkflowExecutionError as e:
+            self.storage.save_error(self.workflow_id,
+                                    e.__cause__ or e)
+            self.storage.set_status(self.workflow_id, WorkflowStatus.FAILED,
+                                    metadata={"finished_at": time.time()})
+            raise
+
+    # ------------------------------------------------------------------
+    def _check_cancel(self):
+        # Cancellation lands in storage (cross-process); throttle the read.
+        now = time.time()
+        if now - self._cancel_poll < 0.2:
+            return
+        self._cancel_poll = now
+        if self.storage.get_status(self.workflow_id) == \
+                WorkflowStatus.CANCELED:
+            raise WorkflowCancellationError(self.workflow_id)
+
+    def _run_dag(self, root: FunctionNode, prefix: str) -> Any:
+        ids = assign_task_ids(root, prefix)
+        # Gather every node + dependency edges.
+        nodes: Dict[int, FunctionNode] = {}
+        deps: Dict[int, List[int]] = {}
+        dependents: Dict[int, List[int]] = {}
+
+        def collect(n: FunctionNode):
+            if id(n) in nodes:
+                return
+            nodes[id(n)] = n
+            ups = n.upstream()
+            deps[id(n)] = [id(u) for u in ups]
+            for u in ups:
+                collect(u)
+                dependents.setdefault(id(u), []).append(id(n))
+
+        collect(root)
+
+        values: Dict[int, Any] = {}
+        remaining: Dict[int, int] = {}
+        ready: List[int] = []
+        for nid, n in nodes.items():
+            tid = ids[nid]
+            if n.checkpoint and self.storage.has_result(self.workflow_id,
+                                                        tid):
+                values[nid] = self.storage.load_result(self.workflow_id, tid)
+        for nid in nodes:
+            missing = sum(1 for d in deps[nid] if d not in values)
+            remaining[nid] = missing
+            if nid not in values and missing == 0:
+                ready.append(nid)
+
+        inflight: Dict[Any, int] = {}       # ObjectRef -> node id
+        started: Dict[int, float] = {}
+        retries_left: Dict[int, int] = {}
+
+        def submit(nid: int):
+            n = nodes[nid]
+            self._check_cancel()
+            args = substitute(n.args, values)
+            kwargs = substitute(n.kwargs, values)
+            if getattr(n, "is_sleep", False):
+                # Durable sleep: the wakeup deadline is checkpointed on
+                # first submission so a resumed run sleeps only the
+                # remainder (reference: ``workflow.sleep``).
+                meta = self.storage.task_meta(self.workflow_id, ids[nid])
+                deadline = meta.get("deadline")
+                if deadline is None:
+                    deadline = time.time() + float(args[0])
+                    self.storage.save_task_meta(
+                        self.workflow_id, ids[nid], {"deadline": deadline})
+                args = (deadline,)
+            started[nid] = time.time()
+            retries_left.setdefault(nid, n.max_retries)
+            inflight[n.execute(*args, **kwargs)] = nid
+
+        def complete(nid: int, value: Any):
+            n = nodes[nid]
+            if isinstance(value, Continuation):
+                # Nested DAG runs under "<task_id>/" so its own
+                # checkpoints are stable across resumes.
+                value = self._run_dag(value.node, prefix=f"{ids[nid]}/")
+            if n.checkpoint:
+                self.storage.save_result(self.workflow_id, ids[nid], value,
+                                         time.time() - started.get(nid, 0))
+            values[nid] = value
+            for dn in dependents.get(nid, []):
+                remaining[dn] -= 1
+                if remaining[dn] == 0:
+                    submit(dn)
+
+        for nid in ready:
+            submit(nid)
+
+        while id(root) not in values:
+            if not inflight:
+                raise RuntimeError(
+                    f"workflow {self.workflow_id}: no tasks in flight but "
+                    f"root not computed (cycle in DAG?)")
+            done, _ = rt.wait(list(inflight), num_returns=1, timeout=1.0)
+            self._check_cancel()
+            if not done:
+                continue
+            ref = done[0]
+            nid = inflight.pop(ref)
+            n = nodes[nid]
+            try:
+                value = rt.get(ref)
+            except Exception as e:  # noqa: BLE001 - retry policy below
+                if retries_left.get(nid, 0) > 0:
+                    retries_left[nid] -= 1
+                    submit(nid)
+                    continue
+                if n.catch_exceptions:
+                    complete(nid, (None, e))
+                    continue
+                err = WorkflowExecutionError(self.workflow_id, ids[nid])
+                err.__cause__ = e
+                raise err
+            if n.catch_exceptions:
+                value = (value, None)
+            complete(nid, value)
+
+        return values[id(root)]

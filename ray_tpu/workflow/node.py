@@ -1,0 +1,94 @@
+"""Workflow DAG nodes (reference: ``python/ray/dag/function_node.py`` —
+``fn.bind(*args)`` builds a static task DAG later consumed by
+``workflow.run``).
+
+Unlike :mod:`ray_tpu.dag` (actor-channel compiled graphs), these nodes
+describe plain remote *functions*; upstream nodes appearing anywhere in
+``args``/``kwargs`` are dependencies whose checkpointed results are
+substituted before submission.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+
+class FunctionNode:
+    """One task in a workflow DAG. Built via ``RemoteFunction.bind``."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict[str, Any]):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+        wf_opts = dict(remote_fn._options.get("workflow_options") or {})
+        self.name: str = wf_opts.get("name") or remote_fn._fn.__name__
+        self.max_retries: int = int(wf_opts.get("max_retries", 0))
+        self.catch_exceptions: bool = bool(
+            wf_opts.get("catch_exceptions", False))
+        self.checkpoint: bool = bool(wf_opts.get("checkpoint", True))
+
+    def execute(self, *resolved_args, **resolved_kwargs):
+        """Submit the underlying remote function with upstream nodes already
+        substituted by their values; returns an ObjectRef."""
+        return self.remote_fn.remote(*resolved_args, **resolved_kwargs)
+
+    def upstream(self) -> List["FunctionNode"]:
+        found: List[FunctionNode] = []
+        _scan(self.args, found)
+        _scan(self.kwargs, found)
+        return found
+
+    def __repr__(self):
+        return f"FunctionNode({self.name})"
+
+
+def _scan(obj: Any, out: List[FunctionNode]):
+    """Collect FunctionNodes from (possibly nested) containers. Only the
+    containers the reference's DAG scanner descends into — tuples, lists,
+    dicts — are searched; nodes hidden inside arbitrary objects are not
+    dependencies."""
+    if isinstance(obj, FunctionNode):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for x in obj:
+            _scan(x, out)
+    elif isinstance(obj, dict):
+        for x in obj.values():
+            _scan(x, out)
+
+
+def substitute(obj: Any, values: Dict[int, Any]) -> Any:
+    """Replace every FunctionNode (by identity) with its computed value."""
+    if isinstance(obj, FunctionNode):
+        return values[id(obj)]
+    if isinstance(obj, list):
+        return [substitute(x, values) for x in obj]
+    if isinstance(obj, tuple):
+        return tuple(substitute(x, values) for x in obj)
+    if isinstance(obj, dict):
+        return {k: substitute(v, values) for k, v in obj.items()}
+    return obj
+
+
+def assign_task_ids(root: FunctionNode, prefix: str = "") -> Dict[int, str]:
+    """Deterministic task ids via DFS postorder so a resumed run maps the
+    same DAG onto the same checkpoint keys (reference:
+    ``workflow_state_from_dag.py`` — stable names from the DAG walk)."""
+    order: List[FunctionNode] = []
+    seen: set = set()
+
+    def visit(n: FunctionNode):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for up in n.upstream():
+            visit(up)
+        order.append(n)
+
+    visit(root)
+    ids: Dict[int, str] = {}
+    counts: Dict[str, int] = {}
+    for n in order:
+        k = counts.get(n.name, 0)
+        counts[n.name] = k + 1
+        ids[id(n)] = f"{prefix}{n.name}_{k}" if k or prefix else n.name
+    return ids

@@ -1,0 +1,173 @@
+"""Durable workflow storage (reference:
+``python/ray/workflow/workflow_storage.py`` — step results and workflow
+metadata persisted under a filesystem prefix so any process can resume).
+
+Layout::
+
+    <base>/<workflow_id>/
+        status.json            {"status": ..., "metadata": {...}}
+        dag.pkl                cloudpickled root FunctionNode (for resume)
+        output.pkl             final output (on success)
+        error.pkl              terminal exception (on failure)
+        tasks/<task_id>/
+            result.pkl         checkpointed task output
+            meta.json          {"duration_s": ..., "deadline": ...}
+
+Writes land via tmp-file + ``os.replace`` so a crash mid-write never
+leaves a half-written checkpoint that a resume would trust.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from .common import WorkflowStatus
+
+
+def _default_base() -> str:
+    return os.environ.get(
+        "RT_WORKFLOW_STORAGE",
+        os.path.join(os.path.expanduser("~"), "ray_tpu_workflows"))
+
+
+class WorkflowStorage:
+    def __init__(self, base: Optional[str] = None):
+        self.base = base or _default_base()
+        os.makedirs(self.base, exist_ok=True)
+
+    # -- paths ----------------------------------------------------------
+    def _wf(self, workflow_id: str) -> str:
+        return os.path.join(self.base, workflow_id)
+
+    def _task(self, workflow_id: str, task_id: str) -> str:
+        return os.path.join(self._wf(workflow_id), "tasks", task_id)
+
+    # -- atomic helpers -------------------------------------------------
+    @staticmethod
+    def _write_bytes(path: str, data: bytes):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def _write_json(self, path: str, obj: dict):
+        self._write_bytes(path, json.dumps(obj).encode())
+
+    # -- workflow lifecycle --------------------------------------------
+    def create(self, workflow_id: str, root_node, metadata: dict):
+        wf = self._wf(workflow_id)
+        os.makedirs(wf, exist_ok=True)
+        self._write_bytes(os.path.join(wf, "dag.pkl"),
+                          cloudpickle.dumps(root_node))
+        self.set_status(workflow_id, WorkflowStatus.RUNNING,
+                        metadata={"created_at": time.time(), **metadata})
+
+    def load_dag(self, workflow_id: str):
+        with open(os.path.join(self._wf(workflow_id), "dag.pkl"), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def set_status(self, workflow_id: str, status: WorkflowStatus,
+                   metadata: Optional[dict] = None):
+        path = os.path.join(self._wf(workflow_id), "status.json")
+        cur = self.get_meta(workflow_id) or {}
+        if metadata:
+            cur.update(metadata)
+        self._write_json(path, {"status": status.value, "metadata": cur})
+
+    def get_status(self, workflow_id: str) -> Optional[WorkflowStatus]:
+        try:
+            with open(os.path.join(self._wf(workflow_id),
+                                   "status.json")) as f:
+                return WorkflowStatus(json.load(f)["status"])
+        except (FileNotFoundError, ValueError, KeyError):
+            return None
+
+    def get_meta(self, workflow_id: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self._wf(workflow_id),
+                                   "status.json")) as f:
+                return json.load(f).get("metadata", {})
+        except FileNotFoundError:
+            return None
+
+    def list_all(self) -> List[str]:
+        try:
+            return sorted(
+                d for d in os.listdir(self.base)
+                if os.path.isdir(os.path.join(self.base, d)))
+        except FileNotFoundError:
+            return []
+
+    def delete(self, workflow_id: str):
+        import shutil
+
+        shutil.rmtree(self._wf(workflow_id), ignore_errors=True)
+
+    # -- outputs --------------------------------------------------------
+    def save_output(self, workflow_id: str, value: Any):
+        self._write_bytes(os.path.join(self._wf(workflow_id), "output.pkl"),
+                          cloudpickle.dumps(value))
+
+    def load_output(self, workflow_id: str) -> Any:
+        with open(os.path.join(self._wf(workflow_id), "output.pkl"),
+                  "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def save_error(self, workflow_id: str, exc: BaseException):
+        try:
+            data = cloudpickle.dumps(exc)
+        except Exception:  # noqa: BLE001 - unpicklable exception
+            data = cloudpickle.dumps(RuntimeError(repr(exc)))
+        self._write_bytes(os.path.join(self._wf(workflow_id), "error.pkl"),
+                          data)
+
+    def load_error(self, workflow_id: str) -> Optional[BaseException]:
+        try:
+            with open(os.path.join(self._wf(workflow_id), "error.pkl"),
+                      "rb") as f:
+                return cloudpickle.loads(f.read())
+        except FileNotFoundError:
+            return None
+
+    # -- task checkpoints ----------------------------------------------
+    def has_result(self, workflow_id: str, task_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._task(workflow_id, task_id), "result.pkl"))
+
+    def save_result(self, workflow_id: str, task_id: str, value: Any,
+                    duration_s: float):
+        d = self._task(workflow_id, task_id)
+        self._write_bytes(os.path.join(d, "result.pkl"),
+                          cloudpickle.dumps(value))
+        self._write_json(os.path.join(d, "meta.json"),
+                         {"duration_s": duration_s, "ts": time.time()})
+
+    def load_result(self, workflow_id: str, task_id: str) -> Any:
+        with open(os.path.join(self._task(workflow_id, task_id),
+                               "result.pkl"), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def task_meta(self, workflow_id: str, task_id: str) -> Dict[str, Any]:
+        try:
+            with open(os.path.join(self._task(workflow_id, task_id),
+                                   "meta.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+
+    def save_task_meta(self, workflow_id: str, task_id: str, meta: dict):
+        cur = self.task_meta(workflow_id, task_id)
+        cur.update(meta)
+        self._write_json(
+            os.path.join(self._task(workflow_id, task_id), "meta.json"), cur)

@@ -1,0 +1,185 @@
+"""Workflow tests (reference: ``python/ray/workflow/tests/`` —
+run/resume/continuation/cancel/event semantics)."""
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def wf(rt_cluster, tmp_path):
+    workflow.init(str(tmp_path / "wf"))
+    yield workflow
+
+
+@rt.remote
+def add(a, b):
+    return a + b
+
+
+@rt.remote
+def double(x):
+    return 2 * x
+
+
+def test_run_dag(wf):
+    # (1 + 2) * 2 + 3
+    dag = add.bind(double.bind(add.bind(1, 2)), 3)
+    assert wf.run(dag, workflow_id="sum") == 9
+    assert wf.get_status("sum") == wf.SUCCESSFUL
+    assert wf.get_output("sum") == 9
+    assert "sum" in wf.list_all()
+
+
+def test_diamond_parallel_deps(wf):
+    @rt.remote
+    def fan(x):
+        return x + 1
+
+    @rt.remote
+    def join(a, b, c):
+        return a + b + c
+
+    src = add.bind(1, 1)
+    dag = join.bind(fan.bind(src), fan.bind(src), double.bind(src))
+    assert wf.run(dag, workflow_id="diamond") == 10  # 3 + 3 + 4
+
+
+def test_resume_skips_checkpointed_tasks(wf, tmp_path):
+    marker = tmp_path / "ran"
+
+    @rt.remote
+    def count_runs(x):
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        return x
+
+    @rt.remote
+    def boom(x, should_fail_file):
+        import os
+
+        if os.path.exists(should_fail_file):
+            raise RuntimeError("injected")
+        return x * 10
+
+    fail_flag = tmp_path / "fail"
+    fail_flag.write_text("1")
+    dag = boom.bind(count_runs.bind(7), str(fail_flag))
+    with pytest.raises(workflow.WorkflowExecutionError):
+        wf.run(dag, workflow_id="crashy")
+    assert wf.get_status("crashy") == wf.FAILED
+    assert marker.read_text() == "1"
+
+    fail_flag.unlink()
+    assert wf.resume("crashy") == 70
+    # count_runs was checkpointed — resume must not re-run it.
+    assert marker.read_text() == "1"
+    assert wf.get_status("crashy") == wf.SUCCESSFUL
+
+
+def test_max_retries_and_catch_exceptions(wf, tmp_path):
+    flaky_file = tmp_path / "attempts"
+
+    @rt.remote
+    def flaky():
+        n = int(flaky_file.read_text()) if flaky_file.exists() else 0
+        flaky_file.write_text(str(n + 1))
+        if n < 2:
+            raise ValueError("try again")
+        return "ok"
+
+    node = flaky.options(**workflow.options(max_retries=3)).bind()
+    assert wf.run(node, workflow_id="retry") == "ok"
+    assert flaky_file.read_text() == "3"
+
+    @rt.remote
+    def always_fails():
+        raise KeyError("nope")
+
+    node = always_fails.options(
+        **workflow.options(catch_exceptions=True)).bind()
+    value, err = wf.run(node, workflow_id="caught")
+    assert value is None and isinstance(err, Exception)
+
+
+def test_continuation(wf):
+    @rt.remote
+    def fib(n):
+        if n <= 1:
+            return n
+        return workflow.continuation(add.bind(fib.bind(n - 1),
+                                              fib.bind(n - 2)))
+
+    assert wf.run(fib.bind(6), workflow_id="fib") == 8
+
+
+def test_cancel(wf):
+    @rt.remote
+    def slow(x):
+        time.sleep(0.3)
+        return x
+
+    # Chain long enough that cancel lands mid-run.
+    node = slow.bind(0)
+    for i in range(20):
+        node = slow.bind(node)
+    wid = wf.run_async(node, workflow_id="tocancel")
+    time.sleep(0.4)
+    wf.cancel(wid)
+    with pytest.raises(workflow.WorkflowCancellationError):
+        wf.get_output(wid)
+    assert wf.get_status(wid) == wf.CANCELED
+
+
+def test_sleep_is_durable(wf):
+    @rt.remote
+    def after(_sleep, x):
+        return x
+
+    t0 = time.time()
+    assert wf.run(after.bind(workflow.sleep(0.2), 5),
+                  workflow_id="zzz") == 5
+    assert time.time() - t0 >= 0.2
+    # Checkpointed deadline: resuming a finished run is instant.
+    t1 = time.time()
+    assert wf.resume("zzz") == 5
+    assert time.time() - t1 < 0.2
+
+
+def test_wait_for_event(wf, tmp_path):
+    sentinel = str(tmp_path / "event")
+
+    class FileEvent(workflow.EventListener):
+        def poll_for_event(self, path):
+            import os
+            import time as _t
+
+            while not os.path.exists(path):
+                _t.sleep(0.02)
+            return open(path).read()
+
+    (tmp_path / "event").write_text("fired")
+    ev = workflow.wait_for_event(FileEvent, sentinel)
+    assert wf.run(ev, workflow_id="evt") == "fired"
+
+
+def test_metadata_and_delete(wf):
+    wf.run(add.bind(1, 1), workflow_id="meta", metadata={"owner": "test"})
+    md = wf.get_metadata("meta")
+    assert md["status"] == "SUCCESSFUL" and md["owner"] == "test"
+    wf.delete("meta")
+    with pytest.raises(workflow.WorkflowNotFoundError):
+        wf.get_status("meta")
+    assert "meta" not in wf.list_all()
+
+
+def test_resume_all_and_stale_running(wf, tmp_path):
+    # Simulate a crashed owner: storage says RUNNING, no local thread.
+    store = workflow.api._store()
+    store.create("stale", add.bind(2, 3), {})
+    assert wf.get_status("stale") == wf.RESUMABLE
+    resumed = wf.resume_all()
+    assert "stale" in resumed
+    assert wf.get_output("stale") == 5
