@@ -3,8 +3,9 @@ stack, SURVEY.md §2.8): AlgorithmConfig → Algorithm with EnvRunnerGroup
 (CPU sampling actors, numpy inference) and jax LearnerGroup (jitted
 losses, mesh-sharded batches). Algorithms: PPO (sync on-policy), IMPALA
 (async + aggregators), APPO (async clipped surrogate), DQN (prioritized
-replay + double-Q), BC (offline). Modules: MLP + Nature-CNN. Connectors
-V2 preprocess env→module observations.
+replay + double-Q), SAC (continuous control), CQL + BC (offline).
+Modules: MLP + Nature-CNN + squashed-Gaussian. Connectors V2 preprocess
+env→module observations.
 """
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .appo import APPO, APPOConfig  # noqa: F401
@@ -23,9 +24,12 @@ from .env_runner import (  # noqa: F401
     SampleBatch,
     SingleAgentEnvRunner,
 )
+from .cql import CQL, CQLConfig  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .learner import LearnerGroup, PPOLearner, compute_gae  # noqa: F401
+from .offline_data import OfflineData, rollout_to_rows, to_columns  # noqa: F401,E501
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .sac import SAC, SACConfig, SACLearner, SquashedGaussianModule  # noqa: F401,E501
 from .replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,
     ReplayBuffer,

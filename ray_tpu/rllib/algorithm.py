@@ -108,12 +108,24 @@ class AlgorithmConfig:
         if self.env_to_module_connector is not None:
             # The module sees post-connector observations.
             obs_shape = self.env_to_module_connector().out_shape(obs_shape)
-        spec = RLModuleSpec(
-            obs_dim=int(np.prod(obs_shape)),
-            num_actions=int(env.action_space.n),
-            hidden=self.hidden,
-            obs_shape=obs_shape if self.use_conv else (),
-            conv=self.use_conv)
+        space = env.action_space
+        if hasattr(space, "n"):
+            spec = RLModuleSpec(
+                obs_dim=int(np.prod(obs_shape)),
+                num_actions=int(space.n),
+                hidden=self.hidden,
+                obs_shape=obs_shape if self.use_conv else (),
+                conv=self.use_conv)
+        else:  # Box: continuous control (SAC/CQL family)
+            spec = RLModuleSpec(
+                obs_dim=int(np.prod(obs_shape)),
+                num_actions=int(np.prod(space.shape)),
+                hidden=self.hidden,
+                obs_shape=obs_shape if self.use_conv else (),
+                conv=self.use_conv,
+                continuous=True,
+                action_low=np.asarray(space.low, np.float32),
+                action_high=np.asarray(space.high, np.float32))
         env.close() if hasattr(env, "close") else None
         return spec
 

@@ -33,15 +33,9 @@ class BCConfig(AlgorithmConfig):
 
 
 def _to_columns(data) -> Dict[str, np.ndarray]:
-    if hasattr(data, "take_all"):          # ray_tpu.data Dataset
-        data = data.take_all()
-    if isinstance(data, list):             # row dicts
-        return {
-            "obs": np.asarray([r["obs"] for r in data], np.float32),
-            "actions": np.asarray([r["actions"] for r in data], np.int64),
-        }
-    return {"obs": np.asarray(data["obs"], np.float32),
-            "actions": np.asarray(data["actions"], np.int64)}
+    from .offline_data import to_columns
+
+    return to_columns(data, keys=("obs", "actions"), discrete_actions=True)
 
 
 class BC:

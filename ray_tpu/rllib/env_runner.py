@@ -69,7 +69,12 @@ class SingleAgentEnvRunner:
     def sample(self) -> SampleBatch:
         N, T = len(self.envs), self.T
         obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
-        act_buf = np.zeros((T, N), np.int64)
+        continuous = getattr(self.module.spec, "continuous", False)
+        if continuous:
+            act_buf = np.zeros((T, N, self.module.spec.num_actions),
+                               np.float32)
+        else:
+            act_buf = np.zeros((T, N), np.int64)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), bool)
         trunc_buf = np.zeros((T, N), bool)
@@ -86,7 +91,8 @@ class SingleAgentEnvRunner:
             logp_buf[t] = logp
             val_buf[t] = values
             for i, env in enumerate(self.envs):
-                o, r, term, trunc, _ = env.step(int(actions[i]))
+                o, r, term, trunc, _ = env.step(
+                    actions[i] if continuous else int(actions[i]))
                 rew_buf[t, i] = r
                 done_buf[t, i] = term
                 trunc_buf[t, i] = trunc

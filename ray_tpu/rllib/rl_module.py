@@ -20,13 +20,19 @@ class RLModuleSpec:
                  hidden: Tuple[int, ...] = (64, 64),
                  obs_shape: Tuple[int, ...] = (),
                  conv: bool = False,
-                 module_cls: Any = None):
+                 module_cls: Any = None,
+                 continuous: bool = False,
+                 action_low=None, action_high=None):
         self.obs_dim = obs_dim
+        # For continuous (Box) spaces num_actions is the action dimension.
         self.num_actions = num_actions
         self.hidden = tuple(hidden)
         self.obs_shape = tuple(obs_shape)  # (H, W, C) for conv torsos
         self.conv = conv
         self.module_cls = module_cls
+        self.continuous = continuous
+        self.action_low = action_low
+        self.action_high = action_high
 
     def build(self, seed: int = 0):
         if self.module_cls is not None:
@@ -38,14 +44,19 @@ class RLModuleSpec:
         return DiscreteMLPModule(self, seed)
 
 
+def dense_init(rng, fan_in: int, fan_out: int, scale=None) -> Params:
+    """Scaled-normal dense layer init shared by every module family."""
+    s = scale if scale is not None else np.sqrt(2.0 / fan_in)
+    return {"w": (rng.standard_normal((fan_in, fan_out)) * s
+                  ).astype(np.float32),
+            "b": np.zeros((fan_out,), np.float32)}
+
+
 def _init_mlp(spec: RLModuleSpec, seed: int) -> Params:
     rng = np.random.default_rng(seed)
 
     def dense(fan_in, fan_out, scale=None):
-        s = scale if scale is not None else np.sqrt(2.0 / fan_in)
-        return {"w": (rng.standard_normal((fan_in, fan_out)) * s
-                      ).astype(np.float32),
-                "b": np.zeros((fan_out,), np.float32)}
+        return dense_init(rng, fan_in, fan_out, scale)
 
     sizes = (spec.obs_dim,) + spec.hidden
     # SEPARATE policy and value trunks: a shared trunk lets the large

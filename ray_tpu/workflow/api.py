@@ -125,9 +125,10 @@ def resume_async(workflow_id: str) -> str:
         raise WorkflowNotFoundError(workflow_id)
     if status == WorkflowStatus.SUCCESSFUL:
         return workflow_id
-    rec = _running.get(workflow_id)
-    if rec is not None and rec.thread.is_alive():
-        return workflow_id  # still running here
+    if get_status(workflow_id) == WorkflowStatus.RUNNING:
+        # Live here or in another process (fresh heartbeat) — never
+        # start a second executor over the same checkpoints.
+        return workflow_id
     dag = store.load_dag(workflow_id)
     store.set_status(workflow_id, WorkflowStatus.RUNNING,
                      metadata={"resumed_at": time.time()})
@@ -135,17 +136,19 @@ def resume_async(workflow_id: str) -> str:
 
 
 def resume_all() -> List[str]:
-    """Resume every workflow that is not terminal (reference:
-    ``workflow.resume_all`` after cluster restart)."""
+    """Resume every workflow whose owner died (reference:
+    ``workflow.resume_all`` after cluster restart). Broken storage
+    entries (e.g. a crash between dag write and status write) are
+    skipped, never fatal — recovery must recover what it can."""
     out = []
     for wid in list_all():
-        st = get_status(wid)
-        if st in (WorkflowStatus.RESUMABLE, WorkflowStatus.RUNNING):
-            rec = _running.get(wid)
-            if rec is not None and rec.thread.is_alive():
+        try:
+            if get_status(wid) != WorkflowStatus.RESUMABLE:
                 continue
             resume_async(wid)
             out.append(wid)
+        except WorkflowError:
+            continue
     return out
 
 
@@ -177,16 +180,25 @@ def get_output(workflow_id: str, timeout: Optional[float] = None) -> Any:
         f"(status {status.value}; resume() it first)")
 
 
+# An executor heartbeats every ~0.2s; a beacon older than this means the
+# owning process (local or remote) is gone and the run is resumable.
+_HEARTBEAT_STALE_S = 10.0
+
+
 def get_status(workflow_id: str) -> WorkflowStatus:
-    status = _store().get_status(workflow_id)
+    store = _store()
+    status = store.get_status(workflow_id)
     if status is None:
         raise WorkflowNotFoundError(workflow_id)
     if status == WorkflowStatus.RUNNING:
         rec = _running.get(workflow_id)
-        if rec is None or not rec.thread.is_alive():
-            # RUNNING in storage but no live executor in this process:
-            # the owning process died → resumable (reference maps stale
-            # RUNNING the same way on recovery).
+        if rec is not None and rec.thread.is_alive():
+            return status
+        # Not running in this process — a fresh heartbeat means another
+        # process owns it (still RUNNING); stale/absent means the owner
+        # died → resumable (reference maps stale RUNNING the same way).
+        age = store.heartbeat_age(workflow_id)
+        if age is None or age > _HEARTBEAT_STALE_S:
             return WorkflowStatus.RESUMABLE
     return status
 
@@ -206,13 +218,26 @@ def list_all(status_filter=None) -> List[str]:
     want = {WorkflowStatus(s) for s in (
         status_filter if isinstance(status_filter, (list, set, tuple))
         else [status_filter])}
-    return [w for w in wids if get_status(w) in want]
+    out = []
+    for w in wids:
+        try:
+            if get_status(w) in want:
+                out.append(w)
+        except WorkflowError:
+            # Stray/broken dir under the storage base (e.g. crash before
+            # status.json landed) — not listable by status, not fatal.
+            continue
+    return out
 
 
 def cancel(workflow_id: str) -> None:
     store = _store()
-    if store.get_status(workflow_id) is None:
+    status = store.get_status(workflow_id)
+    if status is None:
         raise WorkflowNotFoundError(workflow_id)
+    if status in (WorkflowStatus.SUCCESSFUL, WorkflowStatus.FAILED,
+                  WorkflowStatus.CANCELED):
+        return  # terminal — nothing to cancel; keep the real outcome
     store.set_status(workflow_id, WorkflowStatus.CANCELED)
 
 

@@ -53,6 +53,9 @@ class WorkflowExecutor:
         if now - self._cancel_poll < 0.2:
             return
         self._cancel_poll = now
+        # Same cadence doubles as the liveness beacon other processes use
+        # to tell RUNNING-elsewhere from RESUMABLE.
+        self.storage.touch_heartbeat(self.workflow_id)
         if self.storage.get_status(self.workflow_id) == \
                 WorkflowStatus.CANCELED:
             raise WorkflowCancellationError(self.workflow_id)
@@ -114,12 +117,17 @@ class WorkflowExecutor:
             retries_left.setdefault(nid, n.max_retries)
             inflight[n.execute(*args, **kwargs)] = nid
 
-        def complete(nid: int, value: Any):
+        def complete(nid: int, value: Any, error: bool = False):
             n = nodes[nid]
             if isinstance(value, Continuation):
                 # Nested DAG runs under "<task_id>/" so its own
                 # checkpoints are stable across resumes.
                 value = self._run_dag(value.node, prefix=f"{ids[nid]}/")
+            # catch_exceptions wraps AFTER continuation resolution so a
+            # caught task returning a continuation yields (sub_dag_out,
+            # None), not the raw Continuation object.
+            if n.catch_exceptions:
+                value = (None, value) if error else (value, None)
             if n.checkpoint:
                 self.storage.save_result(self.workflow_id, ids[nid], value,
                                          time.time() - started.get(nid, 0))
@@ -152,13 +160,11 @@ class WorkflowExecutor:
                     submit(nid)
                     continue
                 if n.catch_exceptions:
-                    complete(nid, (None, e))
+                    complete(nid, e, error=True)
                     continue
                 err = WorkflowExecutionError(self.workflow_id, ids[nid])
                 err.__cause__ = e
                 raise err
-            if n.catch_exceptions:
-                value = (value, None)
             complete(nid, value)
 
         return values[id(root)]

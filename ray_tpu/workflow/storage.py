@@ -101,6 +101,20 @@ class WorkflowStorage:
         except FileNotFoundError:
             return None
 
+    def touch_heartbeat(self, workflow_id: str):
+        """Liveness beacon from a running executor (any process); lets
+        get_status distinguish RUNNING-elsewhere from RESUMABLE."""
+        self._write_bytes(os.path.join(self._wf(workflow_id), "heartbeat"),
+                          repr(time.time()).encode())
+
+    def heartbeat_age(self, workflow_id: str) -> Optional[float]:
+        try:
+            with open(os.path.join(self._wf(workflow_id),
+                                   "heartbeat")) as f:
+                return time.time() - float(f.read())
+        except (FileNotFoundError, ValueError):
+            return None
+
     def list_all(self) -> List[str]:
         try:
             return sorted(

@@ -104,6 +104,23 @@ def test_max_retries_and_catch_exceptions(wf, tmp_path):
     assert value is None and isinstance(err, Exception)
 
 
+def test_catch_exceptions_with_continuation(wf):
+    @rt.remote
+    def extend():
+        return workflow.continuation(add.bind(1, 2))
+
+    node = extend.options(**workflow.options(catch_exceptions=True)).bind()
+    value, err = wf.run(node, workflow_id="caught-cont")
+    assert value == 3 and err is None
+
+
+def test_cancel_terminal_is_noop(wf):
+    wf.run(add.bind(1, 1), workflow_id="done")
+    wf.cancel("done")  # must not clobber the SUCCESSFUL outcome
+    assert wf.get_status("done") == wf.SUCCESSFUL
+    assert wf.get_output("done") == 2
+
+
 def test_continuation(wf):
     @rt.remote
     def fib(n):
