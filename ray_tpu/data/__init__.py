@@ -17,7 +17,14 @@ from .datasource import (  # noqa: F401
     read_parquet,
     read_text,
 )
-from .executor import ActorPoolStrategy, DataIterator  # noqa: F401
+from .executor import (  # noqa: F401
+    ActorPoolStrategy,
+    AdaptiveConcurrencyPolicy,
+    BackpressurePolicy,
+    ConcurrencyCapPolicy,
+    DataContext,
+    DataIterator,
+)
 
 from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
 _rf("data")

@@ -17,38 +17,162 @@ from typing import Any, Callable, Iterator, List, Optional
 import ray_tpu as rt
 
 
+class BackpressurePolicy:
+    """Decides when a stage may launch another task (reference:
+    ``execution/backpressure_policy/backpressure_policy.py``). The pull
+    pipeline consults the policy before each submission and reports
+    completions, so policies can adapt to observed progress."""
+
+    def can_add_input(self, num_in_flight: int) -> bool:
+        raise NotImplementedError
+
+    def on_task_finished(self, duration_s: float) -> None:
+        pass
+
+
+class ConcurrencyCapPolicy(BackpressurePolicy):
+    """Fixed in-flight window (reference
+    ``concurrency_cap_backpressure_policy.py``)."""
+
+    def __init__(self, cap: int = 8):
+        self.cap = cap
+
+    def can_add_input(self, num_in_flight: int) -> bool:
+        return num_in_flight < self.cap
+
+
+class AdaptiveConcurrencyPolicy(BackpressurePolicy):
+    """AIMD window (reference streaming-output backpressure intent:
+    launch more while the stage keeps up, back off when completions
+    slow): grow the cap by one per completed task while completions stay
+    under ``target_task_s``, halve it when a task runs long."""
+
+    def __init__(self, initial: int = 4, min_cap: int = 1,
+                 max_cap: int = 64, target_task_s: float = 10.0):
+        self.cap = initial
+        self.min_cap = min_cap
+        self.max_cap = max_cap
+        self.target_task_s = target_task_s
+
+    def can_add_input(self, num_in_flight: int) -> bool:
+        return num_in_flight < self.cap
+
+    def on_task_finished(self, duration_s: float) -> None:
+        if duration_s > self.target_task_s:
+            self.cap = max(self.min_cap, self.cap // 2)
+        else:
+            self.cap = min(self.max_cap, self.cap + 1)
+
+
+class DataContext:
+    """Process-wide execution knobs (reference ``data/context.py`` —
+    ``DataContext.get_current()``); the default backpressure policy for
+    stateless stages is configured here."""
+
+    _current: Optional["DataContext"] = None
+
+    def __init__(self):
+        self.max_tasks_in_flight = 8
+        self.backpressure_policy_factory: Callable[[], BackpressurePolicy] \
+            = lambda: ConcurrencyCapPolicy(self.max_tasks_in_flight)
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = DataContext()
+        return cls._current
+
+
 class ActorPoolStrategy:
     """compute= argument for stateful map_batches (reference
-    ``ActorPoolMapOperator``)."""
+    ``ActorPoolMapOperator``). ``size`` pins a fixed pool; ``min_size``/
+    ``max_size`` enable autoscaling (reference ``execution/autoscaler``:
+    grow when every actor is saturated, reap idle actors down to
+    ``min_size``)."""
 
-    def __init__(self, size: int = 2, num_cpus: float = 1,
-                 num_tpus: int = 0):
-        self.size = size
+    def __init__(self, size: Optional[int] = None, num_cpus: float = 1,
+                 num_tpus: int = 0, min_size: Optional[int] = None,
+                 max_size: Optional[int] = None,
+                 idle_timeout_s: float = 30.0):
+        if size is None and min_size is None:
+            size = 2
+        self.min_size = min_size if min_size is not None else size
+        self.max_size = max_size if max_size is not None else \
+            (size if size is not None else self.min_size)
+        if self.min_size < 1 or self.max_size < self.min_size:
+            raise ValueError(
+                f"bad pool bounds [{self.min_size}, {self.max_size}]")
+        self.size = self.min_size
         self.num_cpus = num_cpus
         self.num_tpus = num_tpus
+        self.idle_timeout_s = idle_timeout_s
 
 
 def task_pool_stage(ref_iter: Iterator, transform: Callable,
-                    max_in_flight: int = 8,
-                    num_cpus: float = 1) -> Iterator:
+                    max_in_flight: Optional[int] = None,
+                    num_cpus: float = 1,
+                    backpressure: Optional[BackpressurePolicy] = None
+                    ) -> Iterator:
     """Apply ``transform(block) -> block`` to each block via remote tasks,
-    with a bounded in-flight window; yields refs in order."""
+    gated by a backpressure policy; yields refs in order. Precedence:
+    explicit ``backpressure`` > explicit ``max_in_flight`` cap >
+    ``DataContext`` default.
+
+    In-flight means *unfinished*: completions are detected with
+    wait-any (out of order) so the policy sees true task durations and
+    true concurrency, while yields stay strictly FIFO.
+    """
+    import time
+
+    if backpressure is not None:
+        policy = backpressure
+    elif max_in_flight is not None:
+        policy = ConcurrencyCapPolicy(max_in_flight)
+    else:
+        policy = DataContext.get_current().backpressure_policy_factory()
     remote_fn = rt.remote(transform) if not hasattr(
         transform, "remote") else transform
     remote_fn = remote_fn.options(num_cpus=num_cpus)
-    pending: List = []
+    pending: List = []          # refs in submission order (yield order)
+    submit_ts = {}              # ref -> submit time
+    finished = set()
+
+    def wait_one_completion():
+        live = [r for r in pending if r not in finished]
+        done, _ = rt.wait(live, num_returns=1)
+        r = done[0]
+        finished.add(r)
+        policy.on_task_finished(time.time() - submit_ts.pop(r))
+
     for ref in ref_iter:
-        pending.append(remote_fn.remote(ref))
-        if len(pending) >= max_in_flight:
+        # Opportunistic head yields keep the consumer fed.
+        while pending and pending[0] in finished:
+            finished.discard(pending[0])
             yield pending.pop(0)
-    yield from pending
+        while not policy.can_add_input(len(pending) - len(finished)):
+            wait_one_completion()
+            while pending and pending[0] in finished:
+                finished.discard(pending[0])
+                yield pending.pop(0)
+        out = remote_fn.remote(ref)
+        submit_ts[out] = time.time()
+        pending.append(out)
+    while pending:
+        if pending[0] not in finished:
+            wait_one_completion()
+            continue
+        finished.discard(pending[0])
+        yield pending.pop(0)
 
 
 def actor_pool_stage(ref_iter: Iterator, fn_constructor: Callable,
                      transform: Callable, pool: ActorPoolStrategy,
                      max_in_flight_per_actor: int = 2) -> Iterator:
-    """Stateful transform over a fixed actor pool; round-robin dispatch
-    with per-actor in-flight caps; yields refs in submission order."""
+    """Stateful transform over an autoscaling actor pool: dispatch to the
+    least-loaded actor, add actors when all are saturated (up to
+    ``pool.max_size``), reap actors idle past ``pool.idle_timeout_s``
+    (down to ``pool.min_size``); yields refs in submission order."""
+    import time
 
     class _MapWorker:
         def __init__(self):
@@ -61,22 +185,74 @@ def actor_pool_stage(ref_iter: Iterator, fn_constructor: Callable,
     opts = {"num_cpus": pool.num_cpus}
     if pool.num_tpus:
         opts["num_tpus"] = pool.num_tpus
-    actors = [cls.options(**opts).remote() for _ in range(pool.size)]
+
+    def spawn():
+        # value = [actor, in_flight_count, idle_since_ts]
+        return [cls.options(**opts).remote(), 0, time.time()]
+
+    actors: List[list] = [spawn() for _ in range(pool.min_size)]
+    pool.peak_size = len(actors)
     try:
-        pending: List = []
-        rr = 0
-        window = pool.size * max_in_flight_per_actor
+        pending: List = []      # refs in submission order (yield order)
+        owner = {}              # ref -> actor entry
+        finished = set()
+
+        def absorb_completions(block: bool):
+            """Decrement in-flight counts for completed refs so scaling
+            decisions see actual load, not submitted-not-yet-yielded."""
+            live = [r for r in pending if r not in finished]
+            if not live:
+                return
+            done, _ = rt.wait(live, num_returns=1 if block else len(live),
+                              timeout=None if block else 0)
+            now = time.time()
+            for r in done:
+                finished.add(r)
+                entry = owner.pop(r)
+                entry[1] -= 1
+                if entry[1] == 0:
+                    entry[2] = now
+
         for ref in ref_iter:
-            actor = actors[rr % len(actors)]
-            rr += 1
-            pending.append(actor.apply.remote(ref))
-            if len(pending) >= window:
+            absorb_completions(block=False)
+            while pending and pending[0] in finished:
+                finished.discard(pending[0])
                 yield pending.pop(0)
-        yield from pending
+            entry = min(actors, key=lambda e: e[1])
+            while entry[1] >= max_in_flight_per_actor:
+                if len(actors) < pool.max_size:
+                    entry = spawn()
+                    actors.append(entry)
+                    pool.peak_size = max(pool.peak_size, len(actors))
+                else:
+                    absorb_completions(block=True)
+                    entry = min(actors, key=lambda e: e[1])
+            entry[1] += 1
+            out = entry[0].apply.remote(ref)
+            owner[out] = entry
+            pending.append(out)
+            # Downscale: reap actors idle past the timeout, keeping
+            # min_size alive.
+            if len(actors) > pool.min_size:
+                now = time.time()
+                for e in list(actors):
+                    if e[1] == 0 and now - e[2] > pool.idle_timeout_s \
+                            and len(actors) > pool.min_size:
+                        actors.remove(e)
+                        try:
+                            rt.kill(e[0])
+                        except Exception:  # noqa: BLE001
+                            pass
+        while pending:
+            if pending[0] not in finished:
+                absorb_completions(block=True)
+                continue
+            finished.discard(pending[0])
+            yield pending.pop(0)
     finally:
-        for a in actors:
+        for e in actors:
             try:
-                rt.kill(a)
+                rt.kill(e[0])
             except Exception:
                 pass
 

@@ -17,7 +17,7 @@ import numpy as np
 from .algorithm import AlgorithmConfig
 from .offline_data import OfflineData
 from .rl_module import RLModuleSpec
-from .sac import SACLearner, SquashedGaussianModule, actor_forward
+from .sac import SACLearner, SquashedGaussianModule
 
 
 class CQLConfig(AlgorithmConfig):
@@ -86,12 +86,12 @@ class CQL:
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
         import jax
 
-        params = jax.tree.map(np.asarray, self.learner.params)
-        mean, _ = actor_forward(params, np.asarray(obs, np.float32), np)
-        low = np.asarray(self.module_spec.action_low, np.float32)
-        high = np.asarray(self.module_spec.action_high, np.float32)
-        return (np.tanh(mean) * (high - low) / 2.0
-                + (high + low) / 2.0).astype(np.float32)
+        # Same inference path as SAC rollouts: one squash/rescale
+        # convention lives in SquashedGaussianModule only.
+        module = SquashedGaussianModule(self.module_spec,
+                                        seed=self.config.seed)
+        module.set_weights(jax.tree.map(np.asarray, self.learner.params))
+        return module.forward_inference(np.asarray(obs, np.float32))
 
     def save_to_path(self, path: str) -> str:
         import os

@@ -25,6 +25,7 @@ from .schedulers import (  # noqa: F401
     TrialScheduler,
 )
 from .search import (  # noqa: F401
+    BayesOptSearch,
     BasicVariantGenerator,
     Categorical,
     ConcurrencyLimiter,

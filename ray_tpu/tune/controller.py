@@ -82,6 +82,14 @@ class TuneController:
         self.trainable = trainable
         self.searcher = searcher or BasicVariantGenerator(
             num_samples=num_samples)
+        # An explicit open-ended searcher (e.g. BayesOpt) proposes
+        # indefinitely; num_samples bounds the total trial count,
+        # reference-style. Self-limiting searchers (grid/random variants)
+        # exhaust on their own and are never capped here.
+        self._suggest_cap = (
+            num_samples if searcher is not None
+            and not getattr(searcher, "self_limited", False) else None)
+        self._num_suggested = 0
         self.searcher.set_search_space(param_space or {})
         self.scheduler = scheduler or FIFOScheduler()
         self.max_concurrent = max_concurrent_trials
@@ -103,7 +111,14 @@ class TuneController:
         # from their latest checkpoint.
         self._resume_queue: List[Trial] = []
         for rec in restored_trials or []:
-            self.searcher.suggest(rec["trial_id"])  # keep sample counting
+            # Model-based searchers learn the restored (config, result)
+            # pair truthfully; sampling searchers just keep counting.
+            if hasattr(self.searcher, "register_trial"):
+                self.searcher.register_trial(rec["trial_id"],
+                                             rec["config"])
+            else:
+                self.searcher.suggest(rec["trial_id"])
+            self._num_suggested += 1
             trial = Trial(rec["trial_id"], rec["config"], exp_dir)
             trial.iteration = rec.get("iteration", 0)
             trial.last_result = rec.get("last_result") or {}
@@ -204,6 +219,10 @@ class TuneController:
                 cb.on_trial_start(trial)
         while len(self._running()) < self.max_concurrent and \
                 not self._exhausted:
+            if self._suggest_cap is not None and \
+                    self._num_suggested >= self._suggest_cap:
+                self._exhausted = True
+                return
             trial_id = f"trial_{len(self.trials):04d}_{uuid.uuid4().hex[:6]}"
             cfg = self.searcher.suggest(trial_id)
             if cfg is None:
@@ -211,6 +230,7 @@ class TuneController:
                 return
             if cfg == PENDING_SUGGESTION:
                 return
+            self._num_suggested += 1
             trial = Trial(trial_id, cfg, self.exp_dir)
             self.trials.append(trial)
             self._launch(trial)

@@ -100,6 +100,10 @@ PENDING_SUGGESTION = "__PENDING__"
 class Searcher:
     """Suggest configs; learn from results (reference ``search/searcher.py``)."""
 
+    # True when the searcher exhausts on its own (returns None), so the
+    # controller must NOT cap it at TuneConfig.num_samples.
+    self_limited = False
+
     def set_search_space(self, param_space: Dict[str, Any]) -> None:
         self.param_space = param_space
 
@@ -118,6 +122,8 @@ class Searcher:
 class BasicVariantGenerator(Searcher):
     """Grid axes fully expanded, random axes sampled ``num_samples`` times
     (reference ``search/basic_variant.py``)."""
+
+    self_limited = True
 
     def __init__(self, num_samples: int = 1, seed: Optional[int] = None):
         self.num_samples = num_samples
@@ -185,12 +191,199 @@ class RandomSearch(BasicVariantGenerator):
     pass
 
 
+class BayesOptSearch(Searcher):
+    """Gaussian-process Bayesian optimization (reference:
+    ``tune/search/bayesopt/bayesopt_search.py`` — GP surrogate + an
+    acquisition function over the search space; rebuilt numpy-only
+    instead of wrapping the ``bayes_opt`` package).
+
+    Continuous (``uniform``/``loguniform``), integer, and categorical
+    domains are mapped into the unit cube; an RBF-kernel GP posterior
+    scores ``num_candidates`` uniform proposals by expected improvement.
+    Grid axes are not supported (use BasicVariantGenerator for grids).
+    """
+
+    def __init__(self, metric: str, mode: str = "max", *,
+                 num_initial_random: int = 8, num_candidates: int = 1024,
+                 xi: float = 0.01, length_scale: float = 0.25,
+                 noise: float = 1e-4, seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.num_initial_random = num_initial_random
+        self.num_candidates = num_candidates
+        self.xi = xi
+        self.length_scale = length_scale
+        self.noise = noise
+        import numpy as np
+
+        self._np = np
+        self._rng = np.random.default_rng(seed)
+        self._dims: List[tuple] = []       # (path, Domain)
+        self._consts: Dict[tuple, Any] = {}
+        self._x: List = []                 # observed unit-cube points
+        self._y: List[float] = []          # observed (max-oriented) scores
+        self._pending: Dict[str, Any] = {} # trial_id -> unit point
+
+    # -- search-space mapping ------------------------------------------
+    def set_search_space(self, param_space):
+        super().set_search_space(param_space)
+        self._dims, self._consts = [], {}
+
+        def walk(prefix, space):
+            for k, v in space.items():
+                path = prefix + (k,)
+                if isinstance(v, GridSearch):
+                    raise ValueError(
+                        "BayesOptSearch does not support grid_search axes")
+                if isinstance(v, Domain):
+                    self._dims.append((path, v))
+                elif isinstance(v, dict):
+                    walk(path, v)
+                else:
+                    self._consts[path] = v
+
+        walk((), param_space)
+        if not self._dims:
+            raise ValueError("BayesOptSearch needs at least one Domain")
+
+    def _from_unit(self, x) -> Dict[str, Any]:
+        import math
+
+        cfg: Dict[str, Any] = {}
+
+        def set_path(path, val):
+            node = cfg
+            for p in path[:-1]:
+                node = node.setdefault(p, {})
+            node[path[-1]] = val
+
+        for (path, dom), xi_ in zip(self._dims, x):
+            if isinstance(dom, Float):
+                if dom.log:
+                    val = math.exp(math.log(dom.low) + xi_ *
+                                   (math.log(dom.high) - math.log(dom.low)))
+                else:
+                    val = dom.low + xi_ * (dom.high - dom.low)
+            elif isinstance(dom, Integer):
+                val = min(dom.high - 1,
+                          int(dom.low + xi_ * (dom.high - dom.low)))
+            elif isinstance(dom, Categorical):
+                val = dom.categories[
+                    min(len(dom.categories) - 1,
+                        int(xi_ * len(dom.categories)))]
+            else:  # Function and friends: sample fresh, outside the GP
+                val = dom.sample(random.Random(int(xi_ * 2**31)))
+            set_path(path, val)
+        for path, v in self._consts.items():
+            set_path(path, v)
+        return cfg
+
+    def _to_unit(self, cfg: Dict[str, Any]):
+        """Inverse of :meth:`_from_unit` — maps a concrete config back
+        into the unit cube so restored trials train the GP on truthful
+        (x, y) pairs."""
+        import math
+
+        np = self._np
+
+        def get_path(path):
+            node = cfg
+            for p in path:
+                node = node[p]
+            return node
+
+        x = np.zeros(len(self._dims))
+        for i, (path, dom) in enumerate(self._dims):
+            try:
+                val = get_path(path)
+            except (KeyError, TypeError):
+                x[i] = 0.5
+                continue
+            if isinstance(dom, Float):
+                if dom.log:
+                    x[i] = ((math.log(val) - math.log(dom.low))
+                            / (math.log(dom.high) - math.log(dom.low)))
+                else:
+                    x[i] = (val - dom.low) / (dom.high - dom.low)
+            elif isinstance(dom, Integer):
+                x[i] = (val - dom.low) / max(1, dom.high - dom.low)
+            elif isinstance(dom, Categorical):
+                try:
+                    idx = dom.categories.index(val)
+                except ValueError:
+                    idx = 0
+                x[i] = (idx + 0.5) / len(dom.categories)
+            else:
+                x[i] = 0.5
+        return np.clip(x, 0.0, 1.0)
+
+    def register_trial(self, trial_id: str, config: Dict[str, Any]):
+        """Adopt a trial this searcher did not suggest (experiment
+        restore): its real config becomes the pending point so the
+        following on_trial_complete records a truthful observation."""
+        self._pending[trial_id] = self._to_unit(config)
+
+    # -- GP posterior ---------------------------------------------------
+    def _kernel(self, a, b):
+        np = self._np
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-d2 / (2.0 * self.length_scale ** 2))
+
+    def _suggest_unit(self):
+        np = self._np
+        d = len(self._dims)
+        if len(self._y) < self.num_initial_random:
+            return self._rng.random(d)
+        X = np.asarray(self._x)
+        y = np.asarray(self._y)
+        y_mean, y_std = y.mean(), y.std() + 1e-9
+        yn = (y - y_mean) / y_std
+        K = self._kernel(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        cand = self._rng.random((self.num_candidates, d))
+        Ks = self._kernel(cand, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sigma = np.sqrt(var)
+        # expected improvement over the best observed (normalized) score
+        best = yn.max()
+        z = (mu - best - self.xi) / sigma
+        from math import erf, sqrt
+
+        cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+        pdf = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+        ei = (mu - best - self.xi) * cdf + sigma * pdf
+        return cand[int(ei.argmax())]
+
+    # -- Searcher protocol ---------------------------------------------
+    def suggest(self, trial_id):
+        x = self._suggest_unit()
+        self._pending[trial_id] = x
+        return self._from_unit(x)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        x = self._pending.pop(trial_id, None)
+        if x is None or error or result is None:
+            return
+        val = result.get(self.metric)
+        if val is None:
+            return
+        score = float(val) if self.mode == "max" else -float(val)
+        self._x.append(x)
+        self._y.append(score)
+
+
 class ConcurrencyLimiter(Searcher):
     """Caps in-flight suggestions (reference ``concurrency_limiter.py``)."""
 
     def __init__(self, searcher: Searcher, max_concurrent: int):
         self.searcher = searcher
         self.max_concurrent = max_concurrent
+        self.self_limited = searcher.self_limited
         self._live: set = set()
 
     def set_search_space(self, param_space):

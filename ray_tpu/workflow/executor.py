@@ -121,8 +121,15 @@ class WorkflowExecutor:
             n = nodes[nid]
             if isinstance(value, Continuation):
                 # Nested DAG runs under "<task_id>/" so its own
-                # checkpoints are stable across resumes.
-                value = self._run_dag(value.node, prefix=f"{ids[nid]}/")
+                # checkpoints are stable across resumes. A caught task's
+                # failing sub-DAG becomes its error outcome.
+                try:
+                    value = self._run_dag(value.node,
+                                          prefix=f"{ids[nid]}/")
+                except WorkflowExecutionError as sub_err:
+                    if not n.catch_exceptions:
+                        raise
+                    value, error = sub_err.__cause__ or sub_err, True
             # catch_exceptions wraps AFTER continuation resolution so a
             # caught task returning a continuation yields (sub_dag_out,
             # None), not the raw Continuation object.

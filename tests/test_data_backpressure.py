@@ -1,0 +1,118 @@
+"""Backpressure policies + actor-pool autoscaling (reference:
+``data/_internal/execution/backpressure_policy/``, ``execution/
+autoscaler/`` — bounded in-flight work and demand-sized actor pools)."""
+import numpy as np
+import pytest
+
+from ray_tpu.data import (ActorPoolStrategy, AdaptiveConcurrencyPolicy,
+                          ConcurrencyCapPolicy, DataContext)
+
+
+def test_concurrency_cap_policy():
+    p = ConcurrencyCapPolicy(3)
+    assert p.can_add_input(2)
+    assert not p.can_add_input(3)
+
+
+def test_adaptive_policy_aimd():
+    p = AdaptiveConcurrencyPolicy(initial=4, min_cap=1, max_cap=8,
+                                  target_task_s=1.0)
+    assert p.cap == 4
+    p.on_task_finished(0.1)   # fast → grow
+    assert p.cap == 5
+    p.on_task_finished(5.0)   # slow → halve
+    assert p.cap == 2
+    for _ in range(20):
+        p.on_task_finished(0.1)
+    assert p.cap == 8         # clamped at max
+
+    q = AdaptiveConcurrencyPolicy(initial=1, min_cap=1, target_task_s=1.0)
+    q.on_task_finished(99.0)
+    assert q.cap == 1         # clamped at min
+
+
+def test_pool_strategy_bounds():
+    p = ActorPoolStrategy(min_size=1, max_size=4)
+    assert p.min_size == 1 and p.max_size == 4
+    fixed = ActorPoolStrategy(size=3)
+    assert fixed.min_size == 3 and fixed.max_size == 3
+    with pytest.raises(ValueError):
+        ActorPoolStrategy(min_size=3, max_size=1)
+    with pytest.raises(ValueError):
+        ActorPoolStrategy(min_size=0)
+
+
+def test_task_pool_respects_custom_policy(rt_cluster):
+    from ray_tpu.data.executor import task_pool_stage
+
+    class SpyPolicy(ConcurrencyCapPolicy):
+        def __init__(self):
+            super().__init__(2)
+            self.max_seen = 0
+            self.finished = 0
+
+        def can_add_input(self, n):
+            self.max_seen = max(self.max_seen, n)
+            return super().can_add_input(n)
+
+        def on_task_finished(self, duration_s):
+            self.finished += 1
+
+    import ray_tpu as rt
+
+    spy = SpyPolicy()
+    blocks = [rt.put([i]) for i in range(6)]
+    out = list(task_pool_stage(iter(blocks), lambda b: [b[0] * 10],
+                               backpressure=spy))
+    assert [rt.get(r) for r in out] == [[i * 10] for i in range(6)]
+    assert spy.max_seen <= 2       # window never exceeded the cap
+    assert spy.finished == 6       # every completion reported
+
+
+def test_dataset_map_with_data_context(rt_cluster):
+    from ray_tpu import data as rtd
+
+    ctx = DataContext.get_current()
+    old = ctx.backpressure_policy_factory
+    try:
+        ctx.backpressure_policy_factory = \
+            lambda: AdaptiveConcurrencyPolicy(initial=2, max_cap=4)
+        ds = rtd.range(40, block_size=5).map(lambda r: {"v": r["id"] * 2})
+        assert sum(r["v"] for r in ds.take_all()) == 2 * sum(range(40))
+    finally:
+        ctx.backpressure_policy_factory = old
+
+
+def test_actor_pool_autoscales_up(rt_cluster):
+    from ray_tpu import data as rtd
+
+    pool = ActorPoolStrategy(min_size=1, max_size=3)
+
+    def slow_echo(state, batch):
+        import time
+
+        time.sleep(0.15)  # real backlog: tasks outlive dispatch
+        return batch
+
+    ds = rtd.range(64, block_size=4).map_batches(
+        slow_echo,
+        compute=pool,
+        fn_constructor=lambda: {},
+        batch_format="numpy")
+    assert len(ds.take_all()) == 64
+    # 16 slow blocks at in-flight cap 2/actor must force growth past 1.
+    assert pool.peak_size > 1
+    assert pool.peak_size <= 3
+
+
+def test_actor_pool_fixed_size_does_not_scale(rt_cluster):
+    from ray_tpu import data as rtd
+
+    pool = ActorPoolStrategy(size=2)
+    ds = rtd.range(32, block_size=4).map_batches(
+        lambda state, batch: batch,
+        compute=pool,
+        fn_constructor=lambda: {},
+        batch_format="numpy")
+    assert len(ds.take_all()) == 32
+    assert pool.peak_size == 2

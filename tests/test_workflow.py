@@ -114,6 +114,20 @@ def test_catch_exceptions_with_continuation(wf):
     assert value == 3 and err is None
 
 
+def test_catch_exceptions_with_failing_continuation(wf):
+    @rt.remote
+    def boom():
+        raise RuntimeError("sub-dag failure")
+
+    @rt.remote
+    def extend():
+        return workflow.continuation(boom.bind())
+
+    node = extend.options(**workflow.options(catch_exceptions=True)).bind()
+    value, err = wf.run(node, workflow_id="caught-cont-fail")
+    assert value is None and isinstance(err, Exception)
+
+
 def test_cancel_terminal_is_noop(wf):
     wf.run(add.bind(1, 1), workflow_id="done")
     wf.cancel("done")  # must not clobber the SUCCESSFUL outcome
