@@ -137,20 +137,28 @@ def task_pool_stage(ref_iter: Iterator, transform: Callable,
     submit_ts = {}              # ref -> submit time
     finished = set()
 
-    def wait_one_completion():
+    def absorb_completions(block: bool):
+        """Timestamp completions promptly (non-blocking poll each
+        iteration) so a slow *consumer* pulling blocks lazily doesn't
+        inflate the durations the policy adapts on."""
         live = [r for r in pending if r not in finished]
-        done, _ = rt.wait(live, num_returns=1)
-        r = done[0]
-        finished.add(r)
-        policy.on_task_finished(time.time() - submit_ts.pop(r))
+        if not live:
+            return
+        done, _ = rt.wait(live, num_returns=1 if block else len(live),
+                          timeout=None if block else 0)
+        now = time.time()
+        for r in done:
+            finished.add(r)
+            policy.on_task_finished(now - submit_ts.pop(r))
 
     for ref in ref_iter:
+        absorb_completions(block=False)
         # Opportunistic head yields keep the consumer fed.
         while pending and pending[0] in finished:
             finished.discard(pending[0])
             yield pending.pop(0)
         while not policy.can_add_input(len(pending) - len(finished)):
-            wait_one_completion()
+            absorb_completions(block=True)
             while pending and pending[0] in finished:
                 finished.discard(pending[0])
                 yield pending.pop(0)
@@ -159,7 +167,7 @@ def task_pool_stage(ref_iter: Iterator, transform: Callable,
         pending.append(out)
     while pending:
         if pending[0] not in finished:
-            wait_one_completion()
+            absorb_completions(block=True)
             continue
         finished.discard(pending[0])
         yield pending.pop(0)

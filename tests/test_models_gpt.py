@@ -91,3 +91,37 @@ def test_graft_entry_dryrun():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_chunked_ce_matches_unchunked():
+    """loss_chunk>0 reroutes the loss through _chunked_ce (the '1b'
+    preset relies on it); loss AND grads must match the unchunked path,
+    including a non-dividing chunk (tail) and chunk > S (fallback)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import gpt
+
+    cfg0 = gpt.CONFIGS["nano"]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg0)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg0.vocab_size, (2, 65)),
+        jnp.int32)}
+
+    def loss_and_grad(chunk):
+        cfg = dataclasses.replace(cfg0, loss_chunk=chunk)
+        loss, _ = gpt.loss_fn(params, batch, cfg)
+        g = jax.grad(lambda p: gpt.loss_fn(p, batch, cfg)[0])(params)
+        return float(loss), g
+
+    base_loss, base_g = loss_and_grad(0)
+    for chunk in (16, 24, 1000):   # divides, tail, larger-than-S
+        loss, g = loss_and_grad(chunk)
+        assert abs(loss - base_loss) < 1e-4, (chunk, loss, base_loss)
+        diff = max(float(jnp.abs(a - b).max())
+                   for a, b in zip(jax.tree.leaves(base_g),
+                                   jax.tree.leaves(g)))
+        assert diff < 5e-3, (chunk, diff)
