@@ -15,7 +15,7 @@ import json
 import signal
 
 
-def main():
+def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--head", required=True,
                         help="head TCP address host:port")
@@ -26,7 +26,7 @@ def main():
                         help="extra resources as JSON")
     parser.add_argument("--shm-domain", default=None)
     parser.add_argument("--labels", default="{}")
-    args = parser.parse_args()
+    args = parser.parse_args(argv)
 
     from ray_tpu._private.node import NodeService
 
@@ -56,6 +56,7 @@ def main():
         await node.stop()
 
     asyncio.run(run())
+    return 0
 
 
 if __name__ == "__main__":

@@ -223,14 +223,22 @@ class RpcServer:
             self.on_connect(conn)
 
     async def stop(self):
+        # Order matters on 3.12 where ``Server.wait_closed`` blocks until
+        # every connection handler finishes: first stop ACCEPTING (so no
+        # connection can slip in mid-drain), then close live connections,
+        # then wait (timeout as a backstop for handlers that ignore the
+        # close). The old drain-after-wait order deadlocked shutdown
+        # whenever a client had attached.
         if self._server:
             self._server.close()
-            try:
-                await self._server.wait_closed()
-            except Exception:
-                pass
         for c in list(self.connections):
             await c.close()
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       timeout=5.0)
+            except Exception:
+                pass
 
 
 async def connect(address, handler: Optional[Handler] = None,

@@ -86,6 +86,7 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
         config = Config(cfg_overrides)
         set_global_config(config)
 
+        listen_tcp = False
         if address is None:
             session_dir = os.path.join(
                 os.environ.get("TMPDIR", "/tmp"), "ray_tpu",
@@ -110,11 +111,38 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
             head_sock = head_thread.head.sock_path
             _global_state["head_thread"] = head_thread
         else:
-            head_sock = address
-            session_dir = os.path.dirname(address)
+            if address == "auto":
+                # Discover the newest live local session (reference:
+                # ``ray.init(address="auto")``).
+                from .cli import _find_session
+
+                try:
+                    address = _find_session()["head_sock"]
+                except SystemExit:
+                    raise RayTpuError(
+                        "address='auto' found no live session; start one "
+                        "with `python -m ray_tpu start --head` or call "
+                        "rt.init() with no address") from None
+            if isinstance(address, str) and ":" in address and \
+                    not os.path.exists(address):
+                # Remote client: "host:port" → TCP attach; this driver
+                # must itself serve over TCP so workers on the cluster
+                # can pull objects it owns (reference: Ray Client /
+                # ``ray.init("ray://host:port")``).
+                host, _, port = address.rpartition(":")
+                head_sock = (host, int(port))
+                session_dir = os.path.join(
+                    os.environ.get("TMPDIR", "/tmp"), "ray_tpu",
+                    f"client_{int(time.time() * 1000)}_{os.getpid()}")
+                os.makedirs(session_dir, exist_ok=True)
+                listen_tcp = True
+            else:
+                head_sock = address
+                session_dir = os.path.dirname(address)
 
         core = CoreWorker(session_dir=session_dir, head_sock=head_sock,
-                          mode="driver", config=config)
+                          mode="driver", config=config,
+                          listen_tcp=listen_tcp)
         core.start()
         _global_state["core"] = core
         atexit.register(_atexit_shutdown)

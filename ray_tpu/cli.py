@@ -6,11 +6,18 @@ CLI (reference: ``python/ray/scripts/scripts.py``,
 ``session.json`` discovery file each head writes at startup.
 
 Commands:
+    python -m ray_tpu start --head            # standalone head daemon
+    python -m ray_tpu start --address H:P     # node daemon joining a head
     python -m ray_tpu status                  # cluster summary
     python -m ray_tpu list nodes|workers|actors|placement_groups|tasks
     python -m ray_tpu metrics                 # prometheus text
     python -m ray_tpu timeline out.json       # chrome-trace export
     python -m ray_tpu dashboard               # print dashboard URL
+
+``start --head`` keeps the control plane alive independently of any
+driver (reference: ``ray start --head``); drivers then attach with
+``rt.init(address="auto")`` locally, ``rt.init(address=<sock>)`` on the
+same host, or ``rt.init(address="host:port")`` from another machine.
 """
 from __future__ import annotations
 
@@ -54,11 +61,92 @@ def _connect(info: dict):
     return rt
 
 
+def _cmd_start(args) -> int:
+    if args.address:   # join an existing head as a node daemon
+        import tempfile
+
+        from ._private import node_main
+
+        session_dir = args.session_dir or tempfile.mkdtemp(
+            prefix="ray_tpu_node_")
+        argv = ["--head", args.address, "--session-dir", session_dir,
+                "--num-cpus", str(args.num_cpus)]
+        if args.num_tpus:
+            argv += ["--num-tpus", str(args.num_tpus)]
+        return node_main.main(argv)
+    if not args.head:
+        raise SystemExit("start requires --head or --address")
+    # Standalone head (reference `ray start --head`): the control plane
+    # outlives any driver; session.json is the discovery file.
+    import asyncio
+    import time
+
+    from ._private.accelerators import gang_resources
+    from ._private.config import Config, set_global_config
+    from ._private.head import HeadService
+    from .api import _detect_tpu_chips
+
+    session_dir = args.session_dir or os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "ray_tpu",
+        f"session_{int(time.time() * 1000)}_{os.getpid()}")
+    os.makedirs(session_dir, exist_ok=True)
+    config = Config({})
+    set_global_config(config)
+    total = {"CPU": float(args.num_cpus),
+             "TPU": float(args.num_tpus if args.num_tpus is not None
+                          else _detect_tpu_chips()),
+             # Same default total as rt.init()'s embedded head — a
+             # missing "memory" resource would strand memory-requesting
+             # leases forever.
+             "memory": float(os.sysconf("SC_PAGE_SIZE")
+                             * os.sysconf("SC_PHYS_PAGES"))}
+    for k, v in gang_resources(total["TPU"]).items():
+        total.setdefault(k, v)
+
+    async def run():
+        import signal
+
+        head = HeadService(session_dir, config, total)
+        await head.start()
+        print(f"head started\n  session: {session_dir}\n"
+              f"  sock:    {head.sock_path}\n"
+              f"  tcp:     {head.tcp_address[0]}:{head.tcp_address[1]}",
+              flush=True)
+        # SIGTERM (systemd/docker stop) must run head.stop() like the
+        # node daemon does, not die mid-loop with a stale session.json.
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+        try:
+            await stop.wait()
+        finally:
+            await head.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu")
     parser.add_argument("--session-dir", default="",
                         help="session directory (default: newest live)")
     sub = parser.add_subparsers(dest="cmd", required=True)
+    p_start = sub.add_parser("start")
+    p_start.add_argument("--head", action="store_true")
+    # SUPPRESS: without it the subparser's default would clobber a
+    # --session-dir passed before the subcommand.
+    p_start.add_argument("--session-dir", dest="session_dir",
+                         default=argparse.SUPPRESS,
+                         help="where session.json lands")
+    p_start.add_argument("--address", default="",
+                         help="join an existing head at host:port")
+    p_start.add_argument("--num-cpus", type=float,
+                         default=float(os.cpu_count() or 1))
+    p_start.add_argument("--num-tpus", type=float, default=None)
     sub.add_parser("status")
     p_list = sub.add_parser("list")
     p_list.add_argument("kind", choices=[
@@ -78,6 +166,8 @@ def main(argv=None) -> int:
     job_sub.add_parser("list")
     args = parser.parse_args(argv)
 
+    if args.cmd == "start":
+        return _cmd_start(args)
     info = _find_session(args.session_dir)
     if args.cmd == "job":
         from .job_submission import JobSubmissionClient

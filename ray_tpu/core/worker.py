@@ -549,6 +549,19 @@ class CoreWorker:
             self._server = rpc.RpcServer(self._handle, path=self.sock_path)
             await self._server.start()
         self._head = await rpc.connect(self.head_sock, self._handle)
+        if self.listen_tcp and isinstance(self.head_sock, tuple) and \
+                "RT_NODE_IP" not in os.environ:
+            # Remote client with no node daemon to export RT_NODE_IP:
+            # advertise the interface that actually reaches the head
+            # (getsockname of the head connection), else cluster workers
+            # dial 127.0.0.1 — their own host — to pull driver objects.
+            try:
+                sock = self._head._writer.get_extra_info("socket")
+                local_ip = sock.getsockname()[0]
+                if local_ip and local_ip != "0.0.0.0":
+                    self.address = (local_ip, self._server._port)
+            except Exception:  # noqa: BLE001 - keep the env/loopback default
+                pass
         self._reaper = asyncio.get_running_loop().create_task(
             self._lease_reaper())
         self._gc_sweeper = asyncio.get_running_loop().create_task(

@@ -315,12 +315,18 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
     x = _rmsnorm(x, params["ln_f_scale"])
     if final_hidden:
         return (x, {"moe_aux": aux}) if return_aux else x
-    logits = lax.dot_general(
-        x.astype(cfg.dtype), params["embed"]["kernel"].astype(cfg.dtype),
-        (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    logits = _project_vocab(x, params["embed"]["kernel"], cfg)
     if return_aux:
         return logits, {"moe_aux": aux}
     return logits
+
+
+def _project_vocab(x, embed, cfg: GPTConfig):
+    """Tied-embedding vocab projection, f32 logits out."""
+    return lax.dot_general(
+        x.astype(cfg.dtype), embed.astype(cfg.dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _ce_from_logits(logits, targets):
@@ -343,9 +349,7 @@ def _chunked_ce(x, embed, targets, cfg: GPTConfig):
 
     def body(carry, xt):
         xc, tc = xt  # [B, chunk, d], [B, chunk]
-        logits = lax.dot_general(
-            xc.astype(cfg.dtype), embed.astype(cfg.dtype),
-            (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        logits = _project_vocab(xc, embed, cfg)
         return carry + _ce_from_logits(logits, tc) * tc.size, None
 
     xs = x[:, :n * chunk].reshape(B, n, chunk, d).swapaxes(0, 1)
@@ -353,11 +357,9 @@ def _chunked_ce(x, embed, targets, cfg: GPTConfig):
     total, _ = lax.scan(jax.checkpoint(body), jnp.zeros((), jnp.float32),
                         (xs, ts))
     if rem:
-        logits = lax.dot_general(
-            x[:, n * chunk:].astype(cfg.dtype), embed.astype(cfg.dtype),
-            (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         tail = targets[:, n * chunk:]
-        tail_loss = _ce_from_logits(logits, tail) * tail.size
+        tail_loss = _ce_from_logits(
+            _project_vocab(x[:, n * chunk:], embed, cfg), tail) * tail.size
     return (total + tail_loss) / (B * S)
 
 

@@ -221,6 +221,11 @@ def _flash_bwd(qs, k, v, o, lse, do, *, sm_scale, causal, block_q, block_k,
     Sk = k.shape[2]
     bq = min(block_q, S)
     bk = min(block_k, Sk)
+    if causal:
+        # The fused kernel masks exactly one diagonal-straddling q-block
+        # per k-block, which is only the full causal boundary when the
+        # blocks match (same invariant _flash_fwd enforces).
+        assert bq == bk, "causal backward requires block_q == block_k"
     # delta = rowsum(dO * O): tiny, let XLA fuse it. Kept [B,H,S,1] like lse.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)

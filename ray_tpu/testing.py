@@ -56,3 +56,105 @@ def assert_device_count(n: int) -> None:
 
     got = len(jax.devices())
     assert got >= n, f"need >= {n} devices, have {got}"
+
+
+class WorkerKiller:
+    """Chaos harness: kill random worker processes while a workload runs
+    (reference: ``_private/test_utils.py:1429`` ``ResourceKillerActor`` /
+    ``WorkerKillerActor`` — assert progress under induced failures).
+
+    Runs a driver-side thread that periodically SIGKILLs a random
+    registered worker process (from the head's state listing). The
+    driver's own pid and an optional protect-list are never touched.
+
+    Usage::
+
+        with WorkerKiller(interval_s=0.2) as killer:
+            ... run workload with retries ...
+        assert killer.kills > 0
+    """
+
+    def __init__(self, interval_s: float = 0.2, max_kills: int = 1_000_000,
+                 kill_actors: bool = True, protect_pids=()):
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.kill_actors = kill_actors
+        self.protect = set(protect_pids) | {os.getpid()}
+        self.kills = 0
+        self.killed_pids: list = []
+        self._stop = None
+        self._thread = None
+
+    def _loop(self):
+        import random
+        import signal
+
+        import ray_tpu as rt
+
+        while not self._stop.is_set() and self.kills < self.max_kills:
+            self._stop.wait(self.interval_s)
+            if self._stop.is_set():
+                return
+            try:
+                workers = rt.state("workers")
+            except Exception:  # noqa: BLE001 - cluster tearing down
+                return
+            def is_local_worker(pid: int) -> bool:
+                # Safety: the listing is cluster-wide but os.kill is
+                # local — a remote worker's pid could collide with an
+                # unrelated local process. Only kill pids whose local
+                # cmdline is actually a ray_tpu worker.
+                try:
+                    import psutil
+
+                    cmd = " ".join(psutil.Process(pid).cmdline())
+                    return "worker_main" in cmd or "ray_tpu" in cmd
+                except ImportError:
+                    try:
+                        with open(f"/proc/{pid}/cmdline", "rb") as f:
+                            cmd = f.read().decode(errors="replace")
+                        return "worker_main" in cmd or "ray_tpu" in cmd
+                    except OSError:
+                        return False
+                except Exception:  # noqa: BLE001 - process vanished
+                    return False
+
+            def eligible(w):
+                if w["pid"] in self.protect:
+                    return False
+                # assignment is "None" (idle) | "lease" | an ActorID repr
+                is_actor = str(w["assignment"]) not in ("None", "lease")
+                if not self.kill_actors and is_actor:
+                    return False
+                return is_local_worker(w["pid"])
+
+            victims = [w for w in workers if eligible(w)]
+            if not victims:
+                continue
+            victim = random.choice(victims)
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+                self.kills += 1
+                self.killed_pids.append(victim["pid"])
+            except ProcessLookupError:
+                pass
+
+    def start(self) -> "WorkerKiller":
+        import threading
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="worker-killer", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

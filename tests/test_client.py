@@ -1,0 +1,135 @@
+"""Standalone head + client attach (reference: ``ray start --head`` +
+``ray.init(address=...)`` / Ray Client ``ray://host:port``)."""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def standalone_head():
+    session_dir = tempfile.mkdtemp(prefix="rt_head_")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "4", "--num-tpus", "0",
+         "--session-dir", session_dir],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    info = None
+    deadline = time.time() + 30
+    path = os.path.join(session_dir, "session.json")
+    while time.time() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                info = json.load(f)
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(f"head died:\n{proc.stdout.read()}")
+        time.sleep(0.1)
+    assert info, "head never wrote session.json"
+    info["session_dir"] = session_dir
+    yield info
+    proc.terminate()
+    proc.wait(timeout=15)
+
+
+def _driver(code: str, timeout=120) -> str:
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, f"driver failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+def test_driver_attaches_over_uds(standalone_head):
+    out = _driver(f"""
+import ray_tpu as rt
+rt.init(address={standalone_head["head_sock"]!r})
+
+@rt.remote
+def f(x):
+    return x + 1
+
+assert rt.get(f.remote(41)) == 42
+print("uds-attach-ok")
+rt.shutdown()
+""")
+    assert "uds-attach-ok" in out
+
+
+def test_remote_client_attaches_over_tcp(standalone_head):
+    host, port = standalone_head["tcp_address"]
+    out = _driver(f"""
+import ray_tpu as rt
+rt.init(address="{host}:{port}")
+
+@rt.remote
+def f(x):
+    return x * 2
+
+@rt.remote
+class C:
+    def __init__(self):
+        self.v = 0
+    def add(self, x):
+        self.v += x
+        return self.v
+
+refs = [f.remote(i) for i in range(8)]
+assert rt.get(refs) == [i * 2 for i in range(8)]
+c = C.remote()
+assert rt.get([c.add.remote(1) for _ in range(3)]) == [1, 2, 3]
+# driver-owned object consumed by a cluster worker (TCP pull-back)
+big = rt.put(list(range(1000)))
+@rt.remote
+def total(x):
+    return sum(x)
+assert rt.get(total.remote(big)) == sum(range(1000))
+print("tcp-attach-ok")
+rt.shutdown()
+""")
+    assert "tcp-attach-ok" in out
+
+
+def test_two_drivers_share_named_actor(standalone_head):
+    sock = standalone_head["head_sock"]
+    _driver(f"""
+import ray_tpu as rt
+rt.init(address={sock!r})
+
+@rt.remote
+class KV:
+    def __init__(self):
+        self.d = {{}}
+    def put(self, k, v):
+        self.d[k] = v
+        return True
+    def get(self, k):
+        return self.d.get(k)
+
+kv = KV.options(name="shared-kv", lifetime="detached").remote()
+assert rt.get(kv.put.remote("answer", 42))
+rt.shutdown()
+""")
+    out = _driver(f"""
+import ray_tpu as rt
+rt.init(address={sock!r})
+kv = rt.get_actor("shared-kv")
+print("got:", rt.get(kv.get.remote("answer")))
+rt.shutdown()
+""")
+    assert "got: 42" in out
+
+
+def test_cli_status_against_standalone_head(standalone_head):
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu",
+         "--session-dir", standalone_head["session_dir"], "status"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "nodes" in r.stdout
