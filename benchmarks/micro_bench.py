@@ -143,8 +143,10 @@ def main():
     bench_tasks(rt, n_async=5000 // scale, n_sync=1000 // scale)
     bench_actor_calls(rt, n_async=5000 // scale, n_sync=2000 // scale)
     bench_objects(rt, n=5000 // scale)
-    bench_wait(rt, rounds=50 // scale)
+    # PGs before wait: bench_wait leaves never-ready sleeper tasks
+    # holding CPU leases, which would starve PG bundle reservation.
     bench_pgs(rt, n=100 // scale)
+    bench_wait(rt, rounds=50 // scale)
     rt.shutdown()
 
 

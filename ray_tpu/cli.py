@@ -66,13 +66,18 @@ def _cmd_start(args) -> int:
         import tempfile
 
         from ._private import node_main
+        from .api import _detect_tpu_chips
 
         session_dir = args.session_dir or tempfile.mkdtemp(
             prefix="ray_tpu_node_")
+        # Same TPU autodetection as the head path: joining a TPU host
+        # without --num-tpus must still advertise its chips.
+        num_tpus = (args.num_tpus if args.num_tpus is not None
+                    else float(_detect_tpu_chips()))
         argv = ["--head", args.address, "--session-dir", session_dir,
                 "--num-cpus", str(args.num_cpus)]
-        if args.num_tpus:
-            argv += ["--num-tpus", str(args.num_tpus)]
+        if num_tpus:
+            argv += ["--num-tpus", str(num_tpus)]
         return node_main.main(argv)
     if not args.head:
         raise SystemExit("start requires --head or --address")

@@ -424,6 +424,19 @@ class CoreWorker:
         self._function_cache: Dict[str, Any] = {}
         self._actor_seq: Dict[bytes, int] = defaultdict(int)
         self._actor_send_locks: Dict[bytes, asyncio.Lock] = {}
+        # Wire batching for actor calls (same idea as the normal-task
+        # burst path): per-actor FIFO of pending specs drained by one
+        # pump coroutine into multi-spec push_task_batch RPCs.
+        self._actor_batch: Dict[bytes, deque] = {}
+        self._actor_pump_active: Dict[bytes, bool] = {}
+        self._actor_direct_inflight: Dict[bytes, int] = defaultdict(int)
+        self._actor_send_sems: Dict[bytes, asyncio.Semaphore] = {}
+        # Caller threads announce actors with queued calls here; the
+        # loop-side drain pops it instead of scanning every actor ever
+        # seen. The struct lock guards append-vs-prune on _actor_batch
+        # and the direct-inflight counter (user thread += vs loop -=).
+        self._actor_wake_queue: deque = deque()
+        self._actor_struct_lock = threading.Lock()
         self._actor_state: Dict[bytes, dict] = {}
         # worker-mode execution state
         self._actors_local: Dict[bytes, Any] = {}  # actor_id -> instance
@@ -675,6 +688,17 @@ class CoreWorker:
         self._submit_wake_scheduled = False
         while self._submit_queue:
             self._loop.create_task(self._submit_queue.popleft())
+        # Actor wire batches: one pump per announced actor (a whole
+        # burst costs one wake + one pump task, not one per call; no
+        # scan over every actor ever used).
+        woken = set()
+        while self._actor_wake_queue:
+            actor_id = self._actor_wake_queue.popleft()
+            key = actor_id.binary()
+            if key in woken or self._actor_pump_active.get(key):
+                continue
+            woken.add(key)
+            self._loop.create_task(self._pump_actor_batches(actor_id))
         if not self._task_batch_queue:
             return
         by_shape: Dict[tuple, list] = {}
@@ -1497,39 +1521,166 @@ class CoreWorker:
         # Refs before scheduling — same GC race as submit_task.
         if streaming:
             out = ObjectRefGenerator(task_id, self.address)
+            # Streaming replies ride a dedicated per-call exchange.
+            self._enqueue_submission(self._submit_actor_task(spec, borrowed))
+            return out
+        out = [ObjectRef(oid, self.address)
+               for oid in spec.return_object_ids()]
+        # Wire batching: consecutive calls to the same actor share one
+        # push_task_batch RPC (receiver-side seq streams keep ordering,
+        # so concurrency semantics are unchanged). A 1:1 async-call
+        # burst goes from one round-trip per call to one per chunk.
+        with self._actor_struct_lock:
+            q = self._actor_batch.setdefault(key, deque())
+            if not q and not self._actor_pump_active.get(key) and \
+                    not self._actor_direct_inflight[key]:
+                # Idle actor (the sync-call pattern): skip the
+                # queue+pump layer. The in-flight counter makes a
+                # burst's SECOND call take the batching path — without
+                # it every call of a burst would see an idle actor and
+                # degrade to per-call RPCs. Wire order vs the direct
+                # call is fixed up by the receiver's seq stream.
+                self._actor_direct_inflight[key] += 1
+                direct = True
+            else:
+                q.append((spec, borrowed, actor_id))
+                self._actor_wake_queue.append(actor_id)
+                direct = False
+        if direct:
+            self._enqueue_submission(
+                self._submit_actor_direct(spec, borrowed))
         else:
-            out = [ObjectRef(oid, self.address)
-                   for oid in spec.return_object_ids()]
-        self._enqueue_submission(self._submit_actor_task(spec, borrowed))
+            self._wake_drain()
         return out
+
+    async def _submit_actor_direct(self, spec: TaskSpec, borrowed=()):
+        key = spec.actor_id.binary()
+        try:
+            await self._submit_actor_task(spec, borrowed)
+        finally:
+            with self._actor_struct_lock:
+                self._actor_direct_inflight[key] -= 1
+                pending = bool(self._actor_batch.get(key))
+                if pending:
+                    self._actor_wake_queue.append(spec.actor_id)
+            if pending:
+                # Anything queued behind this direct call needs a pump.
+                self._wake_drain()
+
+    _ACTOR_BATCH_CHUNK = 128
+
+    # Chunks in flight per actor: >1 so round-trips overlap (an async
+    # actor's concurrency would otherwise be capped by send serialism);
+    # bounded so a million-call burst doesn't explode into tasks.
+    _ACTOR_CHUNKS_IN_FLIGHT = 4
+
+    async def _pump_actor_batches(self, actor_id: ActorID):
+        """Single drainer per actor (loop-side, so the active flag is
+        race-free): pops pending specs in FIFO chunks and PIPELINES the
+        chunk RPCs (semaphore-bounded) — the receiver's seq streams give
+        ordered actors FIFO regardless of wire interleaving. Extra pump
+        wakes for an already-active actor return immediately."""
+        key = actor_id.binary()
+        if self._actor_pump_active.get(key):
+            return
+        self._actor_pump_active[key] = True
+        sem = self._actor_send_sems.setdefault(
+            key, asyncio.Semaphore(self._ACTOR_CHUNKS_IN_FLIGHT))
+        loop = asyncio.get_running_loop()
+        try:
+            q = self._actor_batch.get(key)
+            while q:
+                chunk = [q.popleft()[:2]
+                         for _ in range(min(len(q),
+                                            self._ACTOR_BATCH_CHUNK))]
+                await sem.acquire()
+
+                async def ship(chunk=chunk):
+                    try:
+                        if len(chunk) == 1:
+                            # Lone call: the single-task RPC skips batch
+                            # packaging overhead.
+                            await self._submit_actor_task(*chunk[0])
+                        else:
+                            await self._send_actor_chunk(actor_id, chunk)
+                    finally:
+                        sem.release()
+
+                loop.create_task(ship())
+        finally:
+            with self._actor_struct_lock:
+                self._actor_pump_active[key] = False
+                # Close the strand race: an append that saw pump-active
+                # just before this flag flip would otherwise sit unwoken.
+                stranded = bool(q)
+                if stranded:
+                    self._actor_wake_queue.append(actor_id)
+                elif q is not None and not q:
+                    # Prune: short-lived actors must not accumulate
+                    # empty per-actor state forever. Safe under the
+                    # struct lock — a concurrent caller re-creates the
+                    # entries via setdefault.
+                    self._actor_batch.pop(key, None)
+                    self._actor_pump_active.pop(key, None)
+                    self._actor_send_sems.pop(key, None)
+                    if not self._actor_direct_inflight.get(key):
+                        self._actor_direct_inflight.pop(key, None)
+            if stranded:
+                self._wake_drain()
+
+    async def _actor_request(self, actor_id: ActorID, method: str,
+                             payload: dict):
+        """Resolve the actor's worker (cached-ALIVE fast path) and issue
+        one RPC. Writes must hit the socket in seq order, so resolve +
+        write happen under the per-actor lock; the reply is awaited
+        outside it. Shared by the single-call and chunked send paths."""
+        key = actor_id.binary()
+        lock = self._actor_send_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            st = self._actor_state.get(key)
+            if st is not None and st["state"] == "ALIVE" and \
+                    st["address"] is not None:
+                addr = st["address"]  # hot path: no executor hop
+            else:
+                addr = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: self.actor_address(actor_id))
+            conn = await self._get_conn(addr)
+            fut = conn.send_request(method, payload)
+        return await fut
+
+    def _store_actor_failure(self, actor_id: ActorID, specs, e):
+        """Map a transport/execution failure onto every spec's result
+        (ConnectionLost → ActorDiedError with the recorded cause)."""
+        if isinstance(e, rpc.ConnectionLost):
+            st = self._actor_state.get(actor_id.binary())
+            e = ActorDiedError(
+                (st or {}).get("error") or "worker connection lost")
+        for spec in specs:
+            self._store_error(spec, e)
+
+    async def _send_actor_chunk(self, actor_id: ActorID, chunk):
+        try:
+            reply, bufs = await self._actor_request(
+                actor_id, "push_task_batch",
+                {"specs": [self._spec_meta(s) for s, _ in chunk]})
+            offset = 0
+            for (spec, _), res in zip(chunk, reply["results"]):
+                n = res["nbufs"]
+                self._ingest_results(spec, res, bufs[offset:offset + n])
+                offset += n
+        except Exception as e:  # noqa: BLE001 - mapped onto every spec
+            self._store_actor_failure(actor_id, [s for s, _ in chunk], e)
+        finally:
+            for _, borrowed in chunk:
+                self._release_borrows_later(borrowed)
 
     async def _submit_actor_task(self, spec: TaskSpec, borrowed=()):
         try:
-            # Writes must hit the socket in seq order: resolve + write under
-            # a per-actor lock (FIFO), await the reply outside it.
-            key = spec.actor_id.binary()
-            lock = self._actor_send_locks.setdefault(key, asyncio.Lock())
-            async with lock:
-                st = self._actor_state.get(key)
-                if st is not None and st["state"] == "ALIVE" and \
-                        st["address"] is not None:
-                    addr = st["address"]  # hot path: no executor hop
-                else:
-                    addr = await asyncio.get_running_loop().run_in_executor(
-                        None, lambda: self.actor_address(spec.actor_id))
-                conn = await self._get_conn(addr)
-                fut = conn.send_request("push_task", self._spec_meta(spec))
-            reply, bufs = await fut
+            reply, bufs = await self._actor_request(
+                spec.actor_id, "push_task", self._spec_meta(spec))
             self._ingest_results(spec, reply, bufs)
-        except rpc.ConnectionLost:
-            # Actor worker died mid-call; report per actor state.
-            st = self._actor_state.get(spec.actor_id.binary())
-            cause = (st or {}).get("error") or "worker connection lost"
-            self._store_error(spec, ActorDiedError(cause))
-        except ActorDiedError as e:
-            self._store_error(spec, e)
-        except Exception as e:  # noqa: BLE001
-            self._store_error(spec, e)
+        except Exception as e:  # noqa: BLE001 - mapped onto the result
+            self._store_actor_failure(spec.actor_id, [spec], e)
         finally:
             self._release_borrows_later(borrowed)
 
@@ -1768,9 +1919,16 @@ class CoreWorker:
         """Run a chunk of same-shape normal tasks; one combined reply
         (driver slices bufs by count). A few executor threads each run a
         slice sequentially — per-task executor hops dominate trivial
-        tasks, while slices keep long tasks overlapping."""
+        tasks, while slices keep long tasks overlapping.
+
+        Actor-task chunks (the driver's per-actor wire batching) run as
+        concurrent ``_run_actor_task`` coroutines instead: the
+        receiver-side seq streams enforce FIFO for ordered actors while
+        async/concurrent actors keep their parallelism."""
         loop = asyncio.get_running_loop()
         specs = payload["specs"]
+        if specs and specs[0]["type"] == TaskType.ACTOR_TASK.value:
+            return await self._exec_actor_batch(specs, conn)
         lanes = min(4, len(specs))
 
         from .._private.metrics import core_metrics
@@ -1810,12 +1968,104 @@ class CoreWorker:
             for j, res in enumerate(lane_out):
                 outs[lane + j * lanes] = res
         core_metrics()["tasks_finished"].inc(len(outs))
+        return self._package_batch_reply(outs)
+
+    def _package_batch_reply(self, outs):
         results, all_bufs = [], []
         for returns_meta, out_bufs in outs:
             results.append({"returns": returns_meta,
                             "nbufs": len(out_bufs)})
             all_bufs.extend(out_bufs)
         return {"results": results}, all_bufs
+
+    async def _exec_actor_batch(self, specs, conn):
+        from .._private.metrics import core_metrics
+
+        duration = core_metrics()["task_duration"]
+        outs = await self._try_actor_batch_fast(specs, duration)
+        if outs is None:
+            async def run_one(meta):
+                t0 = time.time()
+                res = await self._run_actor_task(meta, conn)
+                end = time.time()
+                duration.observe(end - t0)
+                self._task_events.append(
+                    {"task_id": meta["task_id"].hex(),
+                     "name": meta.get("name", ""),
+                     "start": t0, "end": end,
+                     "worker_id": self.worker_id.hex()})
+                return res
+
+            outs = await asyncio.gather(*(run_one(m) for m in specs))
+        core_metrics()["tasks_finished"].inc(len(outs))
+        return self._package_batch_reply(outs)
+
+    async def _try_actor_batch_fast(self, specs, duration):
+        """Whole-chunk execution in ONE executor hop for the common case:
+        an ordered (max_concurrency=1) actor, plain sync methods, one
+        owner, contiguous seqs. Per-call asyncio round-trips dominate
+        trivial actor calls; running the chunk sequentially in the
+        actor's own thread removes them while preserving exactly the
+        FIFO the seq stream would enforce. Returns None to fall back."""
+        meta0 = specs[0]
+        actor_id_b = meta0["actor_id"]
+        instance = self._actors_local.get(actor_id_b)
+        order = self._actor_order.get(actor_id_b)
+        first, last = meta0["seq_no"], specs[-1]["seq_no"]
+        owner = meta0["owner_address"]
+        if (instance is None or order is None or not order["ordered"]
+                or first < 0 or last - first + 1 != len(specs)
+                or any(m.get("is_generator") for m in specs)
+                or any(m["owner_address"] != owner for m in specs)
+                or meta0["method_name"] == "__rt_drive__"):
+            return None
+        for m in specs:
+            method = getattr(instance, m["method_name"], None)
+            if method is None or asyncio.iscoroutinefunction(method):
+                return None
+        loop = asyncio.get_running_loop()
+        stream = order["streams"].setdefault(
+            owner, {"next": None, "events": {}})
+        if stream["next"] is None:
+            stream["next"] = first
+        if first > stream["next"]:
+            ev = stream["events"].setdefault(first, asyncio.Event())
+            await ev.wait()
+            stream["events"].pop(first, None)
+
+        def run_all():
+            outs = []
+            for meta in specs:
+                t0 = time.time()
+                try:
+                    args, kwargs = self._deserialize_args(
+                        meta["args"], meta["kwargs_keys"])
+                    out = getattr(instance, meta["method_name"])(
+                        *args, **kwargs)
+                    values = self._split_returns(out, meta["num_returns"])
+                except Exception as e:  # noqa: BLE001
+                    err = TaskError(type(e).__name__, str(e),
+                                    traceback.format_exc())
+                    values = [err] * max(1, meta["num_returns"])
+                outs.append(self._package_returns(meta, values))
+                end = time.time()
+                duration.observe(end - t0)
+                self._task_events.append(
+                    {"task_id": meta["task_id"].hex(),
+                     "name": meta.get("name", ""),
+                     "start": t0, "end": end,
+                     "worker_id": self.worker_id.hex()})
+            return outs
+
+        try:
+            return await loop.run_in_executor(
+                self._actor_executors[actor_id_b], run_all)
+        finally:
+            if last >= stream["next"]:
+                stream["next"] = last + 1
+                nxt = stream["events"].get(last + 1)
+                if nxt is not None:
+                    nxt.set()
 
     def _execute_function(self, meta):
         """Fetch + run the task function; returns its raw result."""

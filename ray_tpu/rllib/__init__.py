@@ -3,7 +3,8 @@ stack, SURVEY.md §2.8): AlgorithmConfig → Algorithm with EnvRunnerGroup
 (CPU sampling actors, numpy inference) and jax LearnerGroup (jitted
 losses, mesh-sharded batches). Algorithms: PPO (sync on-policy), IMPALA
 (async + aggregators), APPO (async clipped surrogate), DQN (prioritized
-replay + double-Q), SAC (continuous control), CQL + BC (offline).
+replay + double-Q), SAC (continuous control), CQL + BC + MARWIL
+(offline).
 Modules: MLP + Nature-CNN + squashed-Gaussian. Connectors V2 preprocess
 env→module observations.
 """
@@ -27,6 +28,7 @@ from .env_runner import (  # noqa: F401
 from .cql import CQL, CQLConfig  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .learner import LearnerGroup, PPOLearner, compute_gae  # noqa: F401
+from .marwil import MARWIL, MARWILConfig  # noqa: F401
 from .offline_data import OfflineData, rollout_to_rows, to_columns  # noqa: F401,E501
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .sac import SAC, SACConfig, SACLearner, SquashedGaussianModule  # noqa: F401,E501

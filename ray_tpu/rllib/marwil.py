@@ -1,0 +1,147 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Capability parity with the reference's MARWIL entry point (reference:
+``rllib/algorithms/marwil/marwil.py`` — behavior cloning weighted by
+``exp(beta * advantage)``, with a learned value baseline and a running
+normalizer for the advantage scale; beta=0 degrades to plain BC). One
+jitted step updates policy and value heads together.
+
+Offline data needs ``obs, actions, rewards, dones`` columns; Monte-Carlo
+returns are computed once at load (reference computes returns in its
+offline pre-processing).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .offline_data import to_columns
+from .rl_module import RLModuleSpec, module_forward
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0               # 0 → plain behavior cloning
+        self.vf_coeff = 1.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-2
+        self.offline_data: Any = None
+        self.obs_dim: Optional[int] = None
+        self.num_actions: Optional[int] = None
+
+    def offline(self, data, *, obs_dim: int, num_actions: int):
+        self.offline_data = data
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        return self
+
+
+def _monte_carlo_returns(rewards, dones, gamma):
+    out = np.zeros_like(rewards, np.float32)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        acc = rewards[i] + gamma * acc * (1.0 - dones[i])
+        out[i] = acc
+    return out
+
+
+class MARWIL:
+    """Offline Algorithm surface (env-free), Trainable-compatible."""
+
+    def __init__(self, config: MARWILConfig):
+        import jax
+        import optax
+
+        if config.offline_data is None:
+            raise ValueError("MARWILConfig.offline(data, ...) is required")
+        self.config = config
+        cols = to_columns(config.offline_data,
+                          keys=("obs", "actions", "rewards", "dones"),
+                          discrete_actions=True)
+        cols["returns"] = _monte_carlo_returns(
+            cols["rewards"], cols["dones"], config.gamma)
+        self._cols = cols
+        self.module_spec = RLModuleSpec(
+            obs_dim=config.obs_dim, num_actions=config.num_actions,
+            hidden=config.hidden)
+        module = self.module_spec.build(config.seed)
+        self.params = module.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        # Running ||A||² normalizer (reference keeps it as a learner
+        # state variable updated with a small rate).
+        self._ms_adv = np.asarray(1.0, np.float32)
+        self.iteration = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._step = self._build_step()
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        spec = self.module_spec
+        cfg = self.config
+        optimizer = self.optimizer
+        rate = cfg.moving_average_sqd_adv_norm_update_rate
+
+        def loss_fn(params, batch, ms_adv):
+            logits, value = module_forward(spec, params, batch["obs"], jnp)
+            adv = batch["returns"] - value
+            # normalize the exponent by the running advantage scale so
+            # exp() stays in range regardless of reward magnitude
+            weight = (jnp.exp(cfg.beta * adv
+                              / jnp.sqrt(ms_adv + 1e-8))
+                      if cfg.beta else jnp.ones_like(adv))
+            weight = jax.lax.stop_gradient(jnp.clip(weight, 0.0, 20.0))
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, batch["actions"][:, None], axis=-1)[:, 0]
+            policy_loss = jnp.mean(weight * nll)
+            vf_loss = jnp.mean(adv ** 2)
+            new_ms = ms_adv + rate * (jnp.mean(
+                jax.lax.stop_gradient(adv) ** 2) - ms_adv)
+            loss = policy_loss + cfg.vf_coeff * vf_loss
+            return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                          "weight_mean": weight.mean(), "ms_adv": new_ms}
+
+        def step(params, opt_state, batch, ms_adv):
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, ms_adv)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, aux
+
+        return jax.jit(step)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._cols["obs"])
+        bs = min(cfg.minibatch_size, n)
+        aux = {}
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n, bs):
+                idx = perm[lo:lo + bs]
+                mb = {k: v[idx] for k, v in self._cols.items()}
+                self.params, self.opt_state, aux = self._step(
+                    self.params, self.opt_state, mb, self._ms_adv)
+                self._ms_adv = np.asarray(aux["ms_adv"])
+        self.iteration += 1
+        out = {k: float(v) for k, v in aux.items()}
+        out["training_iteration"] = self.iteration
+        return out
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+
+        logits, _ = module_forward(
+            self.module_spec, jax.tree.map(np.asarray, self.params),
+            np.asarray(obs, np.float32), np)
+        return logits.argmax(-1)
+
+    def stop(self):
+        pass

@@ -1,0 +1,67 @@
+"""ResNet model tests (serving flagship; BASELINE.md:63 batched
+ResNet-50 serving replica)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import resnet
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = resnet.ResNetConfig(depth=18, num_classes=10, width=16)
+    params = resnet.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_forward_shapes(tiny):
+    cfg, params = tiny
+    x = np.random.default_rng(0).standard_normal((2, 32, 32, 3)).astype(
+        np.float32)
+    logits = resnet.forward(params, x, cfg)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_bottleneck_resnet50_builds():
+    cfg = resnet.ResNetConfig(depth=50, num_classes=10, width=8)
+    params = resnet.init_params(jax.random.PRNGKey(1), cfg)
+    x = np.zeros((1, 32, 32, 3), np.float32)
+    assert resnet.forward(params, x, cfg).shape == (1, 10)
+    # ~parameter count sanity: full-width resnet50 is ~25.6M params
+    full = resnet.ResNetConfig(depth=50)
+    assert 24e6 < full.num_params() < 27e6
+
+
+def test_bn_train_updates_running_stats(tiny):
+    cfg, params = tiny
+    x = np.random.default_rng(1).standard_normal((4, 32, 32, 3)).astype(
+        np.float32) * 3 + 1
+    logits, new_params = resnet.forward(params, x, cfg, train=True)
+    assert logits.shape == (4, 10)
+    before = np.asarray(params["stem"]["bn"]["mean"])
+    after = np.asarray(new_params["stem"]["bn"]["mean"])
+    assert not np.allclose(before, after)
+    # original params untouched (functional update)
+    assert np.allclose(np.asarray(params["stem"]["bn"]["mean"]), before)
+
+
+def test_predictor_jit_and_grads(tiny):
+    cfg, params = tiny
+    predict = resnet.make_predictor(cfg, params)
+    x = np.random.default_rng(2).standard_normal((2, 32, 32, 3)).astype(
+        np.float32)
+    out1 = np.asarray(predict(x))
+    out2 = np.asarray(resnet.forward(params, x, cfg))
+    # bf16 compute: jit fusion reassociates accumulations vs eager
+    np.testing.assert_allclose(out1, out2, rtol=0.05, atol=0.05)
+
+    def loss(p):
+        logits, _ = resnet.forward(p, x, cfg, train=True)
+        return jnp.mean((logits - 1.0) ** 2)
+
+    g = jax.grad(loss)(params)
+    gnorm = sum(float(jnp.abs(v).sum()) for v in jax.tree.leaves(g))
+    assert np.isfinite(gnorm) and gnorm > 0

@@ -1,0 +1,53 @@
+"""MARWIL tests (reference: ``rllib/algorithms/marwil/tests`` —
+advantage-weighted imitation must beat plain BC when the dataset mixes
+good and bad behavior)."""
+import numpy as np
+
+from ray_tpu.rllib import MARWILConfig
+
+
+def _mixed_quality_dataset(n=3000, seed=0):
+    """Bandit-style dataset: 4 states, 4 actions; the 'expert' half picks
+    action == state (reward 1), the 'random' half picks uniformly
+    (reward 1 only when it happens to match). MARWIL's advantage weights
+    should upweight the matching transitions; plain BC imitates the
+    marginal (noisy) action distribution."""
+    rng = np.random.default_rng(seed)
+    states = rng.integers(0, 4, n)
+    expert = rng.random(n) < 0.5
+    actions = np.where(expert, states, rng.integers(0, 4, n))
+    rewards = (actions == states).astype(np.float32)
+    obs = np.eye(4, dtype=np.float32)[states]
+    dones = np.ones(n, np.float32)  # one-step episodes
+    return {"obs": obs, "actions": actions.astype(np.int64),
+            "rewards": rewards, "dones": dones}
+
+
+def _accuracy(algo):
+    obs = np.eye(4, dtype=np.float32)
+    return float(np.mean(algo.compute_actions(obs) == np.arange(4)))
+
+
+def test_marwil_learns_from_mixed_data():
+    data = _mixed_quality_dataset()
+    cfg = (MARWILConfig()
+           .training(beta=2.0, lr=5e-3, num_epochs=40, minibatch_size=256)
+           .debugging(seed=1)
+           .offline(data, obs_dim=4, num_actions=4))
+    algo = cfg.build()
+    m = algo.train()
+    assert np.isfinite(m["policy_loss"]) and np.isfinite(m["vf_loss"])
+    assert _accuracy(algo) == 1.0, "MARWIL failed to recover the expert"
+    # the advantage normalizer must have moved off its init
+    assert m["ms_adv"] != 1.0
+
+
+def test_beta_zero_is_plain_bc():
+    data = _mixed_quality_dataset()
+    cfg = (MARWILConfig()
+           .training(beta=0.0, lr=5e-3, num_epochs=10, minibatch_size=256)
+           .debugging(seed=1)
+           .offline(data, obs_dim=4, num_actions=4))
+    algo = cfg.build()
+    m = algo.train()
+    assert m["weight_mean"] == 1.0  # uniform weights == BC
