@@ -64,8 +64,10 @@ def main():
                     (b, self.size, self.size, 3), np.uint8)))
             return "warm"
 
-        @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.005,
-                     pad_to_bucket=True)
+        # Class is defined inside main(), so the decorator can take the
+        # CLI's batch size — serving and warmup always agree on buckets.
+        @serve.batch(max_batch_size=args.max_batch,
+                     batch_wait_timeout_s=0.005, pad_to_bucket=True)
         def run_batch(self, images_list):
             batch = np.stack(images_list)
             out = np.asarray(self.predict(batch))

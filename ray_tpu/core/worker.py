@@ -1563,9 +1563,28 @@ class CoreWorker:
                 pending = bool(self._actor_batch.get(key))
                 if pending:
                     self._actor_wake_queue.append(spec.actor_id)
+                else:
+                    # Actors used only via the direct sync path never
+                    # run a pump, so prune their state here too.
+                    self._prune_actor_state_locked(key)
             if pending:
                 # Anything queued behind this direct call needs a pump.
                 self._wake_drain()
+
+    def _prune_actor_state_locked(self, key: bytes):
+        """Drop per-actor batching state once fully idle (empty queue,
+        no pump, no direct call in flight). Caller holds the struct
+        lock; a concurrent submitter re-creates entries via setdefault."""
+        if self._actor_batch.get(key):
+            return
+        if self._actor_pump_active.get(key):
+            return
+        if self._actor_direct_inflight.get(key):
+            return
+        self._actor_batch.pop(key, None)
+        self._actor_pump_active.pop(key, None)
+        self._actor_send_sems.pop(key, None)
+        self._actor_direct_inflight.pop(key, None)
 
     _ACTOR_BATCH_CHUNK = 128
 
@@ -1609,22 +1628,18 @@ class CoreWorker:
                 loop.create_task(ship())
         finally:
             with self._actor_struct_lock:
-                self._actor_pump_active[key] = False
+                self._actor_pump_active.pop(key, None)
                 # Close the strand race: an append that saw pump-active
                 # just before this flag flip would otherwise sit unwoken.
-                stranded = bool(q)
+                stranded = bool(self._actor_batch.get(key))
                 if stranded:
                     self._actor_wake_queue.append(actor_id)
-                elif q is not None and not q:
+                else:
                     # Prune: short-lived actors must not accumulate
                     # empty per-actor state forever. Safe under the
                     # struct lock — a concurrent caller re-creates the
                     # entries via setdefault.
-                    self._actor_batch.pop(key, None)
-                    self._actor_pump_active.pop(key, None)
-                    self._actor_send_sems.pop(key, None)
-                    if not self._actor_direct_inflight.get(key):
-                        self._actor_direct_inflight.pop(key, None)
+                    self._prune_actor_state_locked(key)
             if stranded:
                 self._wake_drain()
 

@@ -12,12 +12,14 @@ from .api import (Application, Deployment, delete, deployment,
 from .batching import batch, default_buckets, pad_to_bucket
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .handle import DeploymentHandle, DeploymentResponse
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .request import Request, Response
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "Request",
     "Response", "batch", "default_buckets", "delete", "deployment",
+    "get_multiplexed_model_id", "multiplexed",
     "get_app_handle", "get_deployment_handle", "pad_to_bucket", "run",
     "shutdown", "start", "status",
 ]

@@ -43,10 +43,16 @@ class Replica:
             self.reconfigure(user_config)
 
     # ------------------------------------------------------------ data plane
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict,
+                       ctx: dict = None):
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = None
+        if ctx and ctx.get("multiplexed_model_id"):
+            from .multiplex import _request_model_id
+
+            token = _request_model_id.set(ctx["multiplexed_model_id"])
         try:
             if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
                 method = self._user
@@ -60,6 +66,10 @@ class Replica:
                 out = asyncio.run(out)
             return out
         finally:
+            if token is not None:
+                from .multiplex import _request_model_id
+
+                _request_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
 

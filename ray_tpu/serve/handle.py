@@ -109,28 +109,37 @@ class DeploymentHandle:
     """Picklable handle to one deployment of one app."""
 
     def __init__(self, app_name: str, deployment_name: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.method_name = method_name
+        self.multiplexed_model_id = multiplexed_model_id
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.app_name, self.deployment_name, self.method_name))
+                (self.app_name, self.deployment_name, self.method_name,
+                 self.multiplexed_model_id))
 
-    def options(self, *, method_name: Optional[str] = None
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
                 ) -> "DeploymentHandle":
-        return DeploymentHandle(self.app_name, self.deployment_name,
-                                method_name or self.method_name)
+        return DeploymentHandle(
+            self.app_name, self.deployment_name,
+            method_name or self.method_name,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self.multiplexed_model_id)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.app_name, self.deployment_name, name)
+        return DeploymentHandle(self.app_name, self.deployment_name, name,
+                                self.multiplexed_model_id)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         router = get_router(self.app_name, self.deployment_name)
-        return router.submit(self.method_name, args, kwargs)
+        return router.submit(self.method_name, args, kwargs,
+                             model_id=self.multiplexed_model_id)
 
     def __repr__(self):
         return (f"DeploymentHandle(app={self.app_name!r}, "
@@ -141,6 +150,7 @@ class Router:
     """Power-of-two-choices replica scheduler with local admission control."""
 
     MEMBERSHIP_TTL_S = 1.0
+    _MODEL_AFFINITY_CAP = 1024
 
     def __init__(self, app_name: str, deployment_name: str):
         self.app_name = app_name
@@ -153,6 +163,12 @@ class Router:
         self._max_ongoing = 16
         self._last_refresh = 0.0
         self._outstanding: Dict[Any, str] = {}  # ObjectRef -> rid
+        # model_id -> replica ids that served it (multiplex affinity).
+        # Advisory only (the replica's LRU may have evicted the model);
+        # bounded LRU + pruned to live replicas on refresh.
+        from collections import OrderedDict
+
+        self._model_affinity: "OrderedDict[str, set]" = OrderedDict()
         self._waiter_wake = threading.Event()
         self._waiter = threading.Thread(
             target=self._completion_loop, daemon=True,
@@ -187,6 +203,13 @@ class Router:
             new = dict(info["replicas"])  # rid -> ActorHandle
             self._replicas = new
             self._ongoing = {rid: self._ongoing.get(rid, 0) for rid in new}
+            # Membership changed: drop affinity entries for dead replicas.
+            for mid in list(self._model_affinity):
+                kept = self._model_affinity[mid] & set(new)
+                if kept:
+                    self._model_affinity[mid] = kept
+                else:
+                    del self._model_affinity[mid]
             self._cond.notify_all()
 
     def mark_dead(self, rid: str):
@@ -202,14 +225,15 @@ class Router:
 
     # ----------------------------------------------------------- data plane
     def submit(self, method_name: str, args: tuple, kwargs: dict,
-               timeout_s: float = 60.0) -> DeploymentResponse:
+               timeout_s: float = 60.0,
+               model_id: str = "") -> DeploymentResponse:
         from .. import api as rt
 
         self.refresh()
         deadline = time.monotonic() + timeout_s
         while True:
             with self._cond:
-                rid = self._pick_locked()
+                rid = self._pick_locked(model_id)
                 if rid is not None:
                     self._ongoing[rid] += 1
                     handle = self._replicas[rid]
@@ -221,17 +245,35 @@ class Router:
                     f"request within {timeout_s}s")
             if not waited:
                 self.refresh()
-        ref = handle.handle_request.remote(method_name, args, kwargs)
+        if model_id:
+            with self._cond:
+                self._model_affinity.setdefault(model_id, set()).add(rid)
+                self._model_affinity.move_to_end(model_id)
+                while len(self._model_affinity) > self._MODEL_AFFINITY_CAP:
+                    self._model_affinity.popitem(last=False)
+            ref = handle.handle_request.remote(
+                method_name, args, kwargs, {"multiplexed_model_id":
+                                            model_id})
+        else:
+            ref = handle.handle_request.remote(method_name, args, kwargs)
         with self._cond:
             self._outstanding[ref] = rid
         self._waiter_wake.set()
         return DeploymentResponse(self, rid, ref, (method_name, args, kwargs))
 
-    def _pick_locked(self) -> Optional[str]:
+    def _pick_locked(self, model_id: str = "") -> Optional[str]:
         rids = [r for r in self._replicas
                 if self._ongoing.get(r, 0) < self._max_ongoing]
         if not rids:
             return None
+        if model_id:
+            # Model-affinity (reference multiplex routing): prefer a
+            # replica that has already served this model — its LRU cache
+            # likely still holds it, avoiding a reload.
+            warm = [r for r in rids
+                    if r in self._model_affinity.get(model_id, ())]
+            if warm:
+                rids = warm
         if len(rids) <= 2:
             return min(rids, key=lambda r: self._ongoing[r])
         a, b = random.sample(rids, 2)

@@ -1,0 +1,136 @@
+"""Model multiplexing: many models per replica with LRU eviction
+(reference: ``python/ray/serve/multiplex.py`` ``@serve.multiplexed`` +
+``serve.get_multiplexed_model_id`` — one deployment serves a fleet of
+per-tenant models, loading each on first use and evicting the least
+recently used when the per-replica budget is hit).
+
+Usage::
+
+    @serve.deployment
+    class ModelZoo:
+        @serve.multiplexed(max_num_models_per_replica=3)
+        def get_model(self, model_id: str):
+            return load_model_somehow(model_id)   # may be async
+
+        def __call__(self, request):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return model.predict(request)
+
+    handle.options(multiplexed_model_id="tenant-42").remote(x)
+"""
+from __future__ import annotations
+
+import contextvars
+import functools
+import inspect
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+# Set by the replica around each request (from the handle's options).
+_request_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """The ``multiplexed_model_id`` the current request was sent with
+    (empty string when the caller did not set one)."""
+    return _request_model_id.get()
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models. Loads are serialized per
+    model_id: concurrent first requests for the same tenant wait on one
+    loader call instead of loading (and transiently double-allocating)
+    the model twice."""
+
+    def __init__(self, loader: Callable, capacity: int):
+        self.loader = loader
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._models: OrderedDict = OrderedDict()
+        self._loading: dict = {}   # model_id -> threading.Event
+
+    def get(self, model_id: str):
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return True, self._models[model_id]
+        return False, None
+
+    def get_or_load(self, self_obj, model_id: str):
+        while True:
+            hit, model = self.get(model_id)
+            if hit:
+                return model
+            with self._lock:
+                if model_id in self._models:
+                    self._models.move_to_end(model_id)
+                    return self._models[model_id]
+                ev = self._loading.get(model_id)
+                if ev is None:
+                    ev = self._loading[model_id] = threading.Event()
+                    leader = True
+                else:
+                    leader = False
+            if not leader:
+                ev.wait()
+                continue  # loader finished (or failed) — re-check cache
+            try:
+                out = self.loader(self_obj, model_id)
+                if inspect.iscoroutine(out):
+                    import asyncio
+
+                    out = asyncio.run(out)
+                return self._put(model_id, out)
+            finally:
+                with self._lock:
+                    self._loading.pop(model_id, None)
+                ev.set()
+
+    def _put(self, model_id: str, model):
+        evicted = []
+        with self._lock:
+            self._models[model_id] = model
+            self._models.move_to_end(model_id)
+            while len(self._models) > self.capacity:
+                evicted.append(self._models.popitem(last=False))
+        # Dropped outside the lock: a model's __del__ may be heavy
+        # (freeing device buffers).
+        del evicted
+        return model
+
+    def model_ids(self):
+        with self._lock:
+            return list(self._models)
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for a replica's model-loader method: caches up to
+    ``max_num_models_per_replica`` loaded models per replica, LRU-evicted.
+    The wrapped loader may be sync or async; the wrapper is sync (our
+    replicas are thread-concurrent)."""
+    if max_num_models_per_replica < 1:
+        raise ValueError("max_num_models_per_replica must be >= 1")
+
+    def decorate(fn):
+        attr = f"__rt_model_cache_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(self, model_id: str):
+            # dict.setdefault is atomic under the GIL — no closure lock
+            # (a lock in the closure would make the deployment class
+            # unpicklable).
+            cache = self.__dict__.get(attr)
+            if cache is None:
+                cache = self.__dict__.setdefault(
+                    attr, _ModelCache(fn, max_num_models_per_replica))
+            return cache.get_or_load(self, model_id)
+
+        wrapper.__rt_is_multiplexed__ = True
+        return wrapper
+
+    if _fn is not None and callable(_fn):
+        return decorate(_fn)
+    return decorate

@@ -287,3 +287,49 @@ def test_jitted_model_serving(serve_instance):
     out = json.loads(body)
     assert len(out) == 3
     serve.delete("model")
+
+
+def test_multiplexed_models_lru_and_context(serve_instance):
+    @serve.deployment(num_replicas=1)
+    class Zoo:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": len(model_id)}
+
+        def __call__(self, x):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return {"model": model["id"], "y": x * model["scale"]}
+
+        def load_history(self, _):
+            return list(self.loads)
+
+    h = serve.run(Zoo.bind(), name="zoo", route_prefix=None)
+    # two tenants fit in the cache: one load each
+    assert h.options(multiplexed_model_id="a").remote(3).result() == \
+        {"model": "a", "y": 3}
+    assert h.options(multiplexed_model_id="bb").remote(3).result() == \
+        {"model": "bb", "y": 6}
+    assert h.options(multiplexed_model_id="a").remote(1).result() == \
+        {"model": "a", "y": 1}
+    assert h.options(method_name="load_history").remote(0).result() == \
+        ["a", "bb"]
+    # third tenant evicts LRU ("bb"); revisiting "bb" reloads it
+    assert h.options(multiplexed_model_id="ccc").remote(1).result() == \
+        {"model": "ccc", "y": 3}
+    assert h.options(multiplexed_model_id="bb").remote(1).result() == \
+        {"model": "bb", "y": 2}
+    assert h.options(method_name="load_history").remote(0).result() == \
+        ["a", "bb", "ccc", "bb"]
+    # no model id set -> empty string context
+    @serve.deployment
+    def whoami(_x):
+        return serve.get_multiplexed_model_id()
+
+    h2 = serve.run(whoami.bind(), name="whoami", route_prefix=None)
+    assert h2.remote(0).result() == ""
+    serve.delete("zoo")
+    serve.delete("whoami")
