@@ -1,0 +1,139 @@
+"""RL benchmark configs from BASELINE.md:63 — "PPO CartPole
+(single-process)" with the reference's ≥450-solved gate
+(``rllib/tuned_examples/ppo/cartpole_ppo.py``) and "IMPALA Atari Pong
+(async multi-learner)" as an async-pipeline throughput config (CNN
+module + aggregator actors; the Atari env itself is not bundled in this
+image, so the Pong-shaped pipeline runs on synthetic 84x84 frames).
+
+Run: ``python benchmarks/bench_rl.py [--skip-impala]``
+Prints one JSON line per config.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_ppo_cartpole():
+    """Train PPO until CartPole is solved (mean return >= 450, the
+    reference tuned-example stopper) and report time + env steps."""
+    from ray_tpu.rllib import PPOConfig
+
+    cfg = (PPOConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                        rollout_fragment_length=64)
+           .training(lr=3e-4, train_batch_size=512, minibatch_size=128,
+                     num_epochs=8, entropy_coeff=0.01)
+           .debugging(seed=0))
+    algo = cfg.build()
+    t0 = time.perf_counter()
+    solved_at = None
+    steps = 0
+    for i in range(400):
+        algo.train()
+        steps = algo._timesteps
+        m = algo.env_runner_group.get_metrics()
+        if m.get("num_episodes", 0) >= 20 and \
+                m["episode_return_mean"] >= 450:
+            solved_at = i + 1
+            break
+    dt = time.perf_counter() - t0
+    algo.stop()
+    print(json.dumps({
+        "metric": "ppo_cartpole_solved",
+        "value": round(dt, 1), "unit": "s",
+        "solved": solved_at is not None,
+        "iterations": solved_at, "env_steps": steps,
+        "env_steps_per_sec": round(steps / dt, 1),
+        "baseline_gate": ">=450 mean return "
+                         "(rllib/tuned_examples/ppo/cartpole_ppo.py)",
+    }))
+    return solved_at is not None
+
+
+def bench_impala_pong_shaped():
+    """Async IMPALA pipeline at Pong dimensions: remote CNN env runners
+    on a synthetic 84x84x4 env, aggregator actors, V-trace learner.
+    Reports env-steps/sec through the full async pipeline."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.rllib import IMPALAConfig
+
+    rt.init(num_cpus=8, num_tpus=0, ignore_reinit_error=True)
+
+    def synthetic_atari():
+        import gymnasium
+        from gymnasium import spaces
+
+        class SynthAtari(gymnasium.Env):
+            """84x84x4 frames, 6 actions, episodic — Pong-shaped load
+            without the ALE dependency."""
+
+            observation_space = spaces.Box(0.0, 1.0, (84, 84, 4),
+                                           np.float32)
+            action_space = spaces.Discrete(6)
+
+            def __init__(self):
+                self._t = 0
+                self._rng = np.random.default_rng(0)
+
+            def _obs(self):
+                return self._rng.random((84, 84, 4), np.float32)
+
+            def reset(self, *, seed=None, options=None):
+                self._t = 0
+                return self._obs(), {}
+
+            def step(self, action):
+                self._t += 1
+                done = self._t >= 200
+                return self._obs(), float(action == 3), done, False, {}
+
+        return SynthAtari()
+
+    cfg = (IMPALAConfig()
+           .environment(env_creator=synthetic_atari)
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                        rollout_fragment_length=32)
+           .rl_module(use_conv=True)
+           .training(num_aggregation_workers=1, train_batch_size=256,
+                     lr=3e-4)
+           .debugging(seed=0))
+    algo = cfg.build()
+    t0 = time.perf_counter()
+    for _ in range(8):
+        algo.train()
+    dt = time.perf_counter() - t0
+    steps = algo._timesteps
+    algo.stop()
+    print(json.dumps({
+        "metric": "impala_pong_shaped_env_steps_per_sec",
+        "value": round(steps / dt, 1), "unit": "steps/s",
+        "env_steps": steps,
+        "config": "2 CNN env-runners x 2 envs, 1 aggregator, V-trace",
+    }))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--skip-impala", action="store_true")
+    args = parser.parse_args()
+
+    from ray_tpu.testing import force_host_devices
+
+    force_host_devices(1)
+    ok = bench_ppo_cartpole()
+    if not args.skip_impala:
+        bench_impala_pong_shaped()
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

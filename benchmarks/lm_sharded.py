@@ -61,8 +61,7 @@ def main():
         name = "medium"
     cfg = dataclasses.replace(gpt.CONFIGS[name], remat="dots",
                               attn_backend="auto")
-    batch = args.batch or max(n, (8 if name in ("medium", "1b") else 4)
-                              * n)
+    batch = args.batch or (8 if name in ("medium", "1b") else 4) * n
     seq = min(args.seq or cfg.max_seq, cfg.max_seq)
 
     mesh = create_mesh({"fsdp": n}, devices=devs)

@@ -2016,28 +2016,35 @@ class CoreWorker:
         return self._package_batch_reply(outs)
 
     async def _try_actor_batch_fast(self, specs, duration):
-        """Whole-chunk execution in ONE executor hop for the common case:
-        an ordered (max_concurrency=1) actor, plain sync methods, one
-        owner, contiguous seqs. Per-call asyncio round-trips dominate
-        trivial actor calls; running the chunk sequentially in the
-        actor's own thread removes them while preserving exactly the
-        FIFO the seq stream would enforce. Returns None to fall back."""
+        """Whole-chunk execution with minimal asyncio hops.
+
+        Ordered (max_concurrency=1) actors run the chunk sequentially in
+        ONE executor hop — exactly the FIFO the seq stream would enforce.
+        Unordered (max_concurrency>1) actors run round-robin slices, one
+        executor hop per lane, preserving their parallelism. Either way
+        the per-call loop round-trips that dominate trivial actor calls
+        disappear. Returns None to fall back to per-call execution
+        (generators, coroutine methods, missing instance)."""
         meta0 = specs[0]
         actor_id_b = meta0["actor_id"]
         instance = self._actors_local.get(actor_id_b)
         order = self._actor_order.get(actor_id_b)
         first, last = meta0["seq_no"], specs[-1]["seq_no"]
         owner = meta0["owner_address"]
-        if (instance is None or order is None or not order["ordered"]
-                or first < 0 or last - first + 1 != len(specs)
+        if (instance is None or order is None
                 or any(m.get("is_generator") for m in specs)
-                or any(m["owner_address"] != owner for m in specs)
                 or meta0["method_name"] == "__rt_drive__"):
             return None
         for m in specs:
             method = getattr(instance, m["method_name"], None)
             if method is None or asyncio.iscoroutinefunction(method):
                 return None
+        if not order["ordered"]:
+            return await self._actor_batch_lanes(
+                actor_id_b, instance, specs, duration)
+        if (first < 0 or last - first + 1 != len(specs)
+                or any(m["owner_address"] != owner for m in specs)):
+            return None
         loop = asyncio.get_running_loop()
         stream = order["streams"].setdefault(
             owner, {"next": None, "events": {}})
@@ -2049,28 +2056,8 @@ class CoreWorker:
             stream["events"].pop(first, None)
 
         def run_all():
-            outs = []
-            for meta in specs:
-                t0 = time.time()
-                try:
-                    args, kwargs = self._deserialize_args(
-                        meta["args"], meta["kwargs_keys"])
-                    out = getattr(instance, meta["method_name"])(
-                        *args, **kwargs)
-                    values = self._split_returns(out, meta["num_returns"])
-                except Exception as e:  # noqa: BLE001
-                    err = TaskError(type(e).__name__, str(e),
-                                    traceback.format_exc())
-                    values = [err] * max(1, meta["num_returns"])
-                outs.append(self._package_returns(meta, values))
-                end = time.time()
-                duration.observe(end - t0)
-                self._task_events.append(
-                    {"task_id": meta["task_id"].hex(),
-                     "name": meta.get("name", ""),
-                     "start": t0, "end": end,
-                     "worker_id": self.worker_id.hex()})
-            return outs
+            return [self._run_actor_call_sync(instance, meta, duration)
+                    for meta in specs]
 
         try:
             return await loop.run_in_executor(
@@ -2081,6 +2068,47 @@ class CoreWorker:
                 nxt = stream["events"].get(last + 1)
                 if nxt is not None:
                     nxt.set()
+
+    def _run_actor_call_sync(self, instance, meta, duration):
+        """One actor call, fully in the calling thread: deserialize,
+        invoke, split, package. Failures (including unpicklable results
+        in packaging) become TaskError results — one bad call must not
+        sink a chunk whose siblings already ran side effects."""
+        t0 = time.time()
+        try:
+            args, kwargs = self._deserialize_args(
+                meta["args"], meta["kwargs_keys"])
+            out = getattr(instance, meta["method_name"])(*args, **kwargs)
+            values = self._split_returns(out, meta["num_returns"])
+            res = self._package_returns(meta, values)
+        except Exception as e:  # noqa: BLE001
+            err = TaskError(type(e).__name__, str(e),
+                            traceback.format_exc())
+            res = self._package_returns(
+                meta, [err] * max(1, meta["num_returns"]))
+        end = time.time()
+        duration.observe(end - t0)
+        self._task_events.append(
+            {"task_id": meta["task_id"].hex(),
+             "name": meta.get("name", ""),
+             "start": t0, "end": end,
+             "worker_id": self.worker_id.hex()})
+        return res
+
+    async def _actor_batch_lanes(self, actor_id_b, instance, specs,
+                                 duration):
+        """Unordered-actor chunk: every call is its own work item on the
+        actor's thread pool (size == max_concurrency) — same independent
+        scheduling as the per-call path (a blocking coordination call
+        cannot head-of-line-block unrelated calls behind it), but each
+        item runs the light sync helper instead of the full per-call
+        asyncio machinery."""
+        loop = asyncio.get_running_loop()
+        ex = self._actor_executors[actor_id_b]
+        return await asyncio.gather(*(
+            loop.run_in_executor(ex, self._run_actor_call_sync,
+                                 instance, meta, duration)
+            for meta in specs))
 
     def _execute_function(self, meta):
         """Fetch + run the task function; returns its raw result."""

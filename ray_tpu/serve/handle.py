@@ -71,11 +71,12 @@ class DeploymentResponse:
     async actors (delegates to the ObjectRef awaitable)."""
 
     def __init__(self, router: "Router", rid: str, ref,
-                 call: Tuple[str, tuple, dict]):
+                 call: Tuple[str, tuple, dict], model_id: str = ""):
         self._router = router
         self._rid = rid
         self._ref = ref
         self._call = call
+        self._model_id = model_id
 
     @property
     def object_ref(self):
@@ -97,7 +98,10 @@ class DeploymentResponse:
             if _retries <= 0:
                 raise
             method, args, kwargs = self._call
-            resp = self._router.submit(method, args, kwargs)
+            # Carry the multiplexed model id so a transparent retry
+            # still executes in the original tenant's context.
+            resp = self._router.submit(method, args, kwargs,
+                                       model_id=self._model_id)
             self._rid, self._ref = resp._rid, resp._ref
             return self.result(timeout=timeout, _retries=_retries - 1)
 
@@ -259,7 +263,8 @@ class Router:
         with self._cond:
             self._outstanding[ref] = rid
         self._waiter_wake.set()
-        return DeploymentResponse(self, rid, ref, (method_name, args, kwargs))
+        return DeploymentResponse(self, rid, ref,
+                                  (method_name, args, kwargs), model_id)
 
     def _pick_locked(self, model_id: str = "") -> Optional[str]:
         rids = [r for r in self._replicas

@@ -38,6 +38,29 @@ def get_multiplexed_model_id() -> str:
     return _request_model_id.get()
 
 
+def _run_coro_blocking(coro):
+    """Run an async loader to completion from sync code. A plain
+    ``asyncio.run`` would raise when the calling thread already has a
+    running loop (async deployments execute requests under
+    ``asyncio.run``), so the coroutine gets its own thread + loop."""
+    import asyncio
+
+    result: dict = {}
+
+    def runner():
+        try:
+            result["value"] = asyncio.run(coro)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            result["error"] = e
+
+    t = threading.Thread(target=runner, name="rt-multiplex-loader")
+    t.start()
+    t.join()
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
 class _ModelCache:
     """Per-replica LRU of loaded models. Loads are serialized per
     model_id: concurrent first requests for the same tenant wait on one
@@ -79,9 +102,7 @@ class _ModelCache:
             try:
                 out = self.loader(self_obj, model_id)
                 if inspect.iscoroutine(out):
-                    import asyncio
-
-                    out = asyncio.run(out)
+                    out = _run_coro_blocking(out)
                 return self._put(model_id, out)
             finally:
                 with self._lock:
