@@ -20,6 +20,72 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 
+# Single-file cluster UI (the reference ships a 22k-line React client;
+# this renders the same core views — summary, nodes, actors, workers,
+# placement groups — from /api/state with zero build tooling).
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;color:#222}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .3rem}
+ table{border-collapse:collapse;font-size:.85rem;width:100%}
+ th,td{border:1px solid #ddd;padding:.25rem .5rem;text-align:left}
+ th{background:#f5f5f5} tr:nth-child(even){background:#fafafa}
+ .pill{display:inline-block;padding:0 .5rem;border-radius:1rem}
+ .ALIVE{background:#d9f2d9}.DEAD{background:#f7d4d4}
+ .links a{margin-right:1rem} #err{color:#b00}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div class="links"><a href="/metrics">prometheus metrics</a>
+<a href="/api/timeline">chrome trace</a>
+<a href="/api/state?kind=summary">raw state</a></div>
+<div id="err"></div>
+<h2>Summary</h2><table id="summary"></table>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Workers</h2><table id="workers"></table>
+<h2>Placement groups</h2><table id="placement_groups"></table>
+<script>
+async function fetchState(kind){
+  const r = await fetch('/api/state?kind='+kind);
+  if(!r.ok) throw new Error(kind+': '+r.status);
+  return r.json();
+}
+function cell(v){
+  if(v && typeof v === 'object') return JSON.stringify(v);
+  return String(v);
+}
+function renderRows(id, rows){
+  const t = document.getElementById(id);
+  if(!rows || !rows.length){ t.innerHTML = '<tr><td>none</td></tr>'; return; }
+  const cols = Object.keys(rows[0]);
+  let html = '<tr>'+cols.map(c=>'<th>'+c+'</th>').join('')+'</tr>';
+  for(const row of rows){
+    html += '<tr>'+cols.map(c=>{
+      const v = cell(row[c]);
+      const pill = (c==='state'||c==='status')
+        ? ' class="pill '+v+'"' : '';
+      return '<td><span'+pill+'>'+v+'</span></td>';
+    }).join('')+'</tr>';
+  }
+  t.innerHTML = html;
+}
+async function refresh(){
+  try{
+    const s = await fetchState('summary');
+    document.getElementById('summary').innerHTML =
+      Object.entries(s).map(([k,v]) =>
+        '<tr><th>'+k+'</th><td>'+cell(v)+'</td></tr>').join('');
+    for(const kind of ['nodes','actors','workers','placement_groups'])
+      renderRows(kind, await fetchState(kind));
+    document.getElementById('err').textContent = '';
+  }catch(e){ document.getElementById('err').textContent = e; }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
 class DashboardServer:
     def __init__(self, state_fn: Callable[[str], object],
                  metrics_fn: Callable[[], str],
@@ -78,13 +144,8 @@ class DashboardServer:
                     writer, 200, "application/json",
                     json.dumps(self._timeline_fn()).encode())
             elif url.path == "/":
-                body = (b"<html><body><h3>ray_tpu dashboard</h3><ul>"
-                        b'<li><a href="/metrics">/metrics</a></li>'
-                        b'<li><a href="/api/state?kind=summary">'
-                        b"/api/state</a></li>"
-                        b'<li><a href="/api/timeline">/api/timeline</a>'
-                        b"</li></ul></body></html>")
-                await self._respond(writer, 200, "text/html", body)
+                await self._respond(writer, 200, "text/html",
+                                    _INDEX_HTML.encode())
             else:
                 await self._respond(writer, 404, "text/plain",
                                     b"not found")

@@ -157,6 +157,17 @@ class Dataset:
                      batch_format: str = "numpy") -> Iterator[Any]:
         return B.batcher(self._exec_blocks(), batch_size, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu"
+                           ) -> Iterator[Any]:
+        """Numpy batches converted to torch tensors (reference:
+        ``Dataset.iter_torch_batches`` — the torch-training ingestion
+        path; column dicts become dicts of tensors)."""
+        return _torch_batches(
+            self.iter_batches(batch_size=batch_size,
+                              batch_format="numpy"),
+            dtypes, device)
+
     def take(self, n: int = 20) -> List[Any]:
         out = []
         for row in self.iter_rows():
@@ -437,3 +448,22 @@ def _zip(a: Iterator[B.Block], b: Iterator[B.Block]) -> Iterator[B.Block]:
             out = []
     if out:
         yield B.rows_to_block(out)
+
+
+def _torch_batches(batch_iter, dtypes, device):
+    import numpy as np
+    import torch
+
+    def convert(arr, key=None):
+        t = torch.from_numpy(np.ascontiguousarray(arr))
+        want = (dtypes.get(key) if isinstance(dtypes, dict)
+                else dtypes) if dtypes is not None else None
+        if want is not None:
+            t = t.to(want)
+        return t.to(device) if device != "cpu" else t
+
+    for batch in batch_iter:
+        if isinstance(batch, dict):
+            yield {k: convert(v, k) for k, v in batch.items()}
+        else:
+            yield convert(batch)

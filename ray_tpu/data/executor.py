@@ -383,6 +383,16 @@ class DataIterator:
 
         return batcher(self.iter_blocks(), batch_size, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu") -> Iterator:
+        """Per-worker shard as torch tensors (reference:
+        ``DataIterator.iter_torch_batches`` feeding torch train loops)."""
+        from .dataset import _torch_batches
+
+        return _torch_batches(
+            self.iter_batches(batch_size=batch_size,
+                              batch_format="numpy"), dtypes, device)
+
     def iter_rows(self) -> Iterator:
         from .block import iter_rows
 
