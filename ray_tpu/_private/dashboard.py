@@ -52,8 +52,11 @@ async function fetchState(kind){
   return r.json();
 }
 function cell(v){
-  if(v && typeof v === 'object') return JSON.stringify(v);
-  return String(v);
+  const s = (v && typeof v === 'object') ? JSON.stringify(v) : String(v);
+  // Escape before innerHTML insertion: state values carry user strings
+  // (actor names, error text) that must never execute as markup.
+  return s.replace(/&/g,'&amp;').replace(/</g,'&lt;')
+          .replace(/>/g,'&gt;').replace(/"/g,'&quot;');
 }
 function renderRows(id, rows){
   const t = document.getElementById(id);

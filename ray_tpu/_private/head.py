@@ -35,7 +35,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from . import rpc
+from . import reaper, rpc
 from .config import Config
 from .ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from .utils import spawn_env_with_pkg_root
@@ -502,7 +502,8 @@ class HeadService:
                      "--worker-id", worker_id.hex(),
                      "--head-sock", self.sock_path],
                     stdout=log, stderr=subprocess.STDOUT,
-                    env=self._spawn_env,
+                    env={**self._spawn_env,
+                         reaper.EXPECTED_PPID_ENV: str(os.getpid())},
                     cwd=os.getcwd(),
                 )
             else:

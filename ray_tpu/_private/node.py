@@ -19,7 +19,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import rpc
+from . import reaper, rpc
 from .ids import NodeID, WorkerID
 from .utils import spawn_env_with_pkg_root
 
@@ -114,7 +114,8 @@ class NodeService:
              "--shm-domain", self.shm_domain,
              "--tcp"],
             stdout=log, stderr=subprocess.STDOUT,
-            env=self._spawn_env,
+            env={**self._spawn_env,
+                 reaper.EXPECTED_PPID_ENV: str(os.getpid())},
             cwd=os.getcwd(),
         )
         self._procs[worker_hex] = proc

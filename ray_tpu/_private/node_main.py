@@ -26,9 +26,20 @@ def main(argv=None):
                         help="extra resources as JSON")
     parser.add_argument("--shm-domain", default=None)
     parser.add_argument("--labels", default="{}")
+    parser.add_argument("--die-with-parent", action="store_true",
+                        help="SIGKILL this daemon when its spawner dies "
+                             "(test harnesses; operators omit it)")
     args = parser.parse_args(argv)
 
+    from ray_tpu._private import reaper
     from ray_tpu._private.node import NodeService
+
+    # Workers we spawn re-parent to us (not init) if an intermediate
+    # shell dies, so our stop() can always reach them.
+    reaper.become_subreaper()
+    if args.die_with_parent:
+        reaper.die_with_parent()
+        reaper.start_orphan_watchdog()
 
     host, _, port = args.head.rpartition(":")
     resources = {"CPU": args.num_cpus}

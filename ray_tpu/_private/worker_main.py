@@ -21,6 +21,16 @@ def main():
     # the debugging hook for distributed hangs.
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
+    # A worker must never outlive its spawner (head / node daemon) — a
+    # SIGKILL'd parent gets no graceful-stop hook, so the kernel-level
+    # death signal plus re-parent watchdog do the reaping (reference
+    # capability: ``src/ray/util/subreaper.h`` orphan policy).
+    if not os.environ.get("RT_NO_PDEATHSIG"):
+        from ray_tpu._private import reaper
+
+        reaper.die_with_parent()
+        reaper.start_orphan_watchdog()
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--worker-id", required=True)

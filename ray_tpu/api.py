@@ -11,6 +11,7 @@ import asyncio
 import atexit
 import functools
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -123,14 +124,26 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
                         "address='auto' found no live session; start one "
                         "with `python -m ray_tpu start --head` or call "
                         "rt.init() with no address") from None
-            if isinstance(address, str) and ":" in address and \
-                    not os.path.exists(address):
-                # Remote client: "host:port" → TCP attach; this driver
-                # must itself serve over TCP so workers on the cluster
-                # can pull objects it owns (reference: Ray Client /
-                # ``ray.init("ray://host:port")``).
-                host, _, port = address.rpartition(":")
-                head_sock = (host, int(port))
+            # Remote client: "host:port" (or "[v6::addr]:port") → TCP
+            # attach; this driver must itself serve over TCP so workers
+            # on the cluster can pull objects it owns (reference: Ray
+            # Client / ``ray.init("ray://host:port")``). Anything that
+            # doesn't match host:port exactly is treated as a UDS path —
+            # a colon-bearing or not-yet-created socket path must not
+            # fall into int(port).
+            tcp_m = isinstance(address, str) and not os.path.exists(
+                address) and re.match(
+                    # [v6::addr]:port (incl. v4-mapped "::ffff:1.2.3.4"),
+                    # bare-v6:port ("::1:6379" — last colon splits, as
+                    # rpartition did), or plain host:port.
+                    r"^(?:\[(?P<v6>[0-9a-fA-F:.]+)\]"
+                    r"|(?P<v6bare>[0-9a-fA-F:.]*:[0-9a-fA-F:.]*)"
+                    r"|(?P<host>[^/:\[\]]+))"
+                    r":(?P<port>\d{1,5})$", address)
+            if tcp_m:
+                host = (tcp_m.group("v6") or tcp_m.group("v6bare")
+                        or tcp_m.group("host"))
+                head_sock = (host, int(tcp_m.group("port")))
                 session_dir = os.path.join(
                     os.environ.get("TMPDIR", "/tmp"), "ray_tpu",
                     f"client_{int(time.time() * 1000)}_{os.getpid()}")

@@ -78,6 +78,8 @@ def _cmd_start(args) -> int:
                 "--num-cpus", str(args.num_cpus)]
         if num_tpus:
             argv += ["--num-tpus", str(num_tpus)]
+        if getattr(args, "die_with_parent", False):
+            argv += ["--die-with-parent"]
         return node_main.main(argv)
     if not args.head:
         raise SystemExit("start requires --head or --address")
@@ -107,6 +109,13 @@ def _cmd_start(args) -> int:
                              * os.sysconf("SC_PHYS_PAGES"))}
     for k, v in gang_resources(total["TPU"]).items():
         total.setdefault(k, v)
+
+    from ._private import reaper
+
+    reaper.become_subreaper()
+    if getattr(args, "die_with_parent", False):
+        reaper.die_with_parent()
+        reaper.start_orphan_watchdog()
 
     async def run():
         import signal
@@ -147,6 +156,9 @@ def main(argv=None) -> int:
     p_start.add_argument("--session-dir", dest="session_dir",
                          default=argparse.SUPPRESS,
                          help="where session.json lands")
+    p_start.add_argument("--die-with-parent", action="store_true",
+                         help="SIGKILL the head when its spawner dies "
+                              "(test harnesses; operators omit it)")
     p_start.add_argument("--address", default="",
                          help="join an existing head at host:port")
     p_start.add_argument("--num-cpus", type=float,

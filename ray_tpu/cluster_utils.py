@@ -85,7 +85,10 @@ class Cluster:
              "--num-tpus", str(num_tpus),
              "--resources", json.dumps(resources or {}),
              "--shm-domain", shm_domain,
-             "--labels", json.dumps(labels or {})],
+             "--labels", json.dumps(labels or {}),
+             # Test nodes die with the test process — a SIGKILL'd run
+             # must not leak daemons (and their workers) machine-wide.
+             "--die-with-parent"],
             stdout=log, stderr=subprocess.STDOUT,
             env=self._node_env(),
         )

@@ -1678,11 +1678,18 @@ class CoreWorker:
             reply, bufs = await self._actor_request(
                 actor_id, "push_task_batch",
                 {"specs": [self._spec_meta(s) for s, _ in chunk]})
+            results = reply["results"]
             offset = 0
-            for (spec, _), res in zip(chunk, reply["results"]):
+            for (spec, _), res in zip(chunk, results):
                 n = res["nbufs"]
                 self._ingest_results(spec, res, bufs[offset:offset + n])
                 offset += n
+            # A short reply (version skew / receiver bug) must fail the
+            # unmatched specs, never leave their refs hanging forever.
+            for spec, _ in chunk[len(results):]:
+                self._store_error(spec, RuntimeError(
+                    f"actor batch reply had {len(results)} results for "
+                    f"{len(chunk)} tasks; task dropped by receiver"))
         except Exception as e:  # noqa: BLE001 - mapped onto every spec
             self._store_actor_failure(actor_id, [s for s, _ in chunk], e)
         finally:

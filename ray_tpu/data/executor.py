@@ -35,6 +35,8 @@ class ConcurrencyCapPolicy(BackpressurePolicy):
     ``concurrency_cap_backpressure_policy.py``)."""
 
     def __init__(self, cap: int = 8):
+        if cap < 1:
+            raise ValueError(f"concurrency cap must be >= 1, got {cap}")
         self.cap = cap
 
     def can_add_input(self, num_in_flight: int) -> bool:
@@ -158,6 +160,13 @@ def task_pool_stage(ref_iter: Iterator, transform: Callable,
             finished.discard(pending[0])
             yield pending.pop(0)
         while not policy.can_add_input(len(pending) - len(finished)):
+            if len(pending) == len(finished):
+                # Nothing in flight, yet the policy refuses admission:
+                # waiting can never change its answer — fail loudly
+                # instead of spinning forever.
+                raise RuntimeError(
+                    f"Backpressure policy {policy!r} refuses input with "
+                    "zero tasks in flight; it can never make progress")
             absorb_completions(block=True)
             while pending and pending[0] in finished:
                 finished.discard(pending[0])

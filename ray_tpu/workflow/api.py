@@ -125,14 +125,26 @@ def resume_async(workflow_id: str) -> str:
         raise WorkflowNotFoundError(workflow_id)
     if status == WorkflowStatus.SUCCESSFUL:
         return workflow_id
-    if get_status(workflow_id) == WorkflowStatus.RUNNING:
-        # Live here or in another process (fresh heartbeat) — never
-        # start a second executor over the same checkpoints.
+    claim = store.claim_lock(workflow_id)
+    if claim is None:
+        # Another process is claiming this workflow right now.
         return workflow_id
-    dag = store.load_dag(workflow_id)
-    store.set_status(workflow_id, WorkflowStatus.RUNNING,
-                     metadata={"resumed_at": time.time()})
-    return _launch(store, workflow_id, dag)
+    with claim:
+        # Re-check under the lock: the status may have moved while we
+        # were acquiring it (another claimer ran, or the owner finished).
+        status = get_status(workflow_id)
+        if status in (WorkflowStatus.RUNNING, WorkflowStatus.SUCCESSFUL):
+            # Running elsewhere (fresh heartbeat) or already complete —
+            # never start a second executor over the same checkpoints
+            # and never clobber a terminal SUCCESSFUL back to RUNNING.
+            return workflow_id
+        dag = store.load_dag(workflow_id)
+        store.set_status(workflow_id, WorkflowStatus.RUNNING,
+                         metadata={"resumed_at": time.time()})
+        # Heartbeat before releasing the claim so a racer that grabs
+        # the lock next sees RUNNING-with-fresh-beacon, not RESUMABLE.
+        store.touch_heartbeat(workflow_id)
+        return _launch(store, workflow_id, dag)
 
 
 def resume_all() -> List[str]:

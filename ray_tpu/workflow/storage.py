@@ -101,6 +101,35 @@ class WorkflowStorage:
         except FileNotFoundError:
             return None
 
+    def claim_lock(self, workflow_id: str):
+        """Advisory exclusive lock serializing resume claims across
+        processes (flock on ``<wf>/claim.lock``). Returns a context
+        manager holding the lock, or ``None`` if another process holds
+        it — the caller must then treat the workflow as RUNNING
+        elsewhere. The reference serializes resume through the
+        workflow-manager actor; a filesystem lock is the equivalent for
+        a storage-rooted design."""
+        import fcntl
+
+        path = os.path.join(self._wf(workflow_id), "claim.lock")
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return None
+
+        class _Held:
+            def __enter__(self_inner):
+                return self_inner
+
+            def __exit__(self_inner, *exc):
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                os.close(fd)
+                return False
+
+        return _Held()
+
     def touch_heartbeat(self, workflow_id: str):
         """Liveness beacon from a running executor (any process); lets
         get_status distinguish RUNNING-elsewhere from RESUMABLE."""

@@ -18,7 +18,7 @@ def standalone_head():
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu", "start", "--head",
          "--num-cpus", "4", "--num-tpus", "0",
-         "--session-dir", session_dir],
+         "--session-dir", session_dir, "--die-with-parent"],
         cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)
     info = None
@@ -36,7 +36,11 @@ def standalone_head():
     info["session_dir"] = session_dir
     yield info
     proc.terminate()
-    proc.wait(timeout=15)
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=5)
 
 
 def _driver(code: str, timeout=120) -> str:

@@ -1,0 +1,97 @@
+"""Orphan reaping: no cluster process survives a SIGKILL'd spawner
+(reference capability: ``src/ray/util/subreaper.h`` — workers must not
+outlive their raylet)."""
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import ray_tpu as rt
+
+rt.init(num_cpus=2, num_tpus=0)
+
+@rt.remote
+def pid():
+    return os.getpid()
+
+pids = rt.get([pid.remote() for _ in range(4)])
+with open({out!r}, "w") as f:
+    json.dump(sorted(set(pids)), f)
+time.sleep(600)   # hold the cluster open until we are SIGKILLed
+"""
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def test_workers_die_with_sigkilled_driver(tmp_path):
+    out = str(tmp_path / "pids.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", DRIVER.format(repo=REPO, out=out)],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    deadline = time.time() + 60
+    while time.time() < deadline and not os.path.exists(out):
+        if proc.poll() is not None:
+            raise AssertionError("driver died before spawning workers")
+        time.sleep(0.2)
+    assert os.path.exists(out), "driver never reported worker pids"
+    import json
+
+    with open(out) as f:
+        worker_pids = json.load(f)
+    assert worker_pids and all(_alive(p) for p in worker_pids)
+
+    proc.send_signal(signal.SIGKILL)   # no graceful shutdown hook runs
+    proc.wait(timeout=10)
+
+    deadline = time.time() + 15
+    while time.time() < deadline and any(_alive(p) for p in worker_pids):
+        time.sleep(0.5)
+    leaked = [p for p in worker_pids if _alive(p)]
+    for p in leaked:   # clean up before failing loudly
+        os.kill(p, signal.SIGKILL)
+    assert not leaked, f"workers leaked after driver SIGKILL: {leaked}"
+
+
+def test_node_daemon_dies_with_parent(tmp_path):
+    """A --die-with-parent node daemon (and its workers) follows a
+    SIGKILL'd standalone head's test harness down."""
+    session_dir = tempfile.mkdtemp(prefix="rt_hyg_")
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "1", "--num-tpus", "0",
+         "--session-dir", session_dir, "--die-with-parent"],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        path = os.path.join(session_dir, "session.json")
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(path):
+            assert head.poll() is None, "head died during startup"
+            time.sleep(0.1)
+        assert os.path.exists(path)
+    finally:
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=10)
+    # The head was SIGKILLed → pdeathsig must reap any worker it
+    # prestarted; give the kernel + watchdog a moment, then scan.
+    time.sleep(3)
+    r = subprocess.run(["pgrep", "-f", session_dir],
+                       capture_output=True, text=True)
+    leaked = [int(p) for p in r.stdout.split()]
+    for p in leaked:
+        os.kill(p, signal.SIGKILL)
+    assert not leaked, f"processes leaked after head SIGKILL: {leaked}"
