@@ -1,0 +1,158 @@
+"""Streaming GPT serving benchmark (VERDICT round 2, item 4): a decode-
+loop replica with bucketed prefill and per-token streaming through
+Serve's streaming path (replica generator → handle → chunked HTTP).
+
+Reports per-stream TTFT (time to first token), per-token latency, and
+aggregate decoded tokens/s as JSON lines.
+
+Run: ``python benchmarks/serve_gpt.py [--clients 4] [--tokens 32]``
+(CPU fallback shrinks the model so the benchmark completes).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--tokens", type=int, default=32)
+    parser.add_argument("--streams", type=int, default=8,
+                        help="total streams per client")
+    parser.add_argument("--config", default="")
+    args = parser.parse_args()
+
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    rt.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(proxy=False)
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg_name = args.config or ("small" if on_tpu else "nano")
+    max_new = args.tokens
+
+    @serve.deployment(max_ongoing_requests=8)
+    class GPTStream:
+        """Decode-loop replica: bucketed prefill (one compile per prompt
+        bucket), then one jitted decode step per streamed token."""
+
+        def __init__(self, cfg_name: str, max_len: int):
+            from ray_tpu.models import gpt, gpt_decode
+
+            self.cfg = gpt.CONFIGS[cfg_name]
+            self.gd = gpt_decode
+            self.params = gpt.init_params(jax.random.PRNGKey(0), self.cfg)
+            self.max_len = max_len
+            self._prefill = jax.jit(gpt_decode.prefill, static_argnums=(2,))
+            self._step = jax.jit(gpt_decode.decode_step, static_argnums=(3,))
+
+        def warm(self, prompt_bucket: int, _=None):
+            import jax.numpy as jnp
+
+            cache = self.gd.init_cache(self.cfg, 1, self.max_len)
+            logits, cache = self._prefill(
+                self.params, jnp.zeros((1, prompt_bucket), jnp.int32),
+                self.cfg, cache)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            self._step(self.params, cache, tok, self.cfg)
+            return "warm"
+
+        def __call__(self, request):
+            """request = {"prompt_len": int, "max_new": int}; yields one
+            token id per step."""
+            import jax.numpy as jnp
+
+            if hasattr(request, "json"):  # HTTP ingress
+                request = request.json()
+            plen = int(request.get("prompt_len", 16))
+            max_new = int(request.get("max_new", 16))
+            prompt = jnp.asarray(
+                np.random.randint(0, self.cfg.vocab_size, (1, plen),
+                                  dtype=np.int32))
+            cache = self.gd.init_cache(self.cfg, 1, self.max_len)
+            logits, cache = self._prefill(self.params, prompt, self.cfg,
+                                          cache)
+            for _ in range(max_new):
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                yield int(tok[0])
+                logits, cache = self._step(self.params, cache, tok,
+                                           self.cfg)
+
+    max_len = 16 + max_new + 8
+    handle = serve.run(GPTStream.bind(cfg_name, max_len),
+                       name="gpt_stream", route_prefix="/generate")
+    assert handle.options(method_name="warm").remote(16).result(
+        timeout=600) == "warm"
+    # End-to-end warm stream (covers the streaming transport itself).
+    list(handle.options(stream=True).remote(
+        {"prompt_len": 16, "max_new": 2}))
+
+    ttfts, tok_lats = [], []
+    total_tokens = [0]
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(args.streams):
+            t0 = time.perf_counter()
+            gen = handle.options(stream=True).remote(
+                {"prompt_len": 16, "max_new": max_new})
+            last = t0
+            first = None
+            n = 0
+            for _tok in gen:
+                now = time.perf_counter()
+                if first is None:
+                    first = now - t0
+                else:
+                    tok_lats.append(now - last)
+                last = now
+                n += 1
+            with lock:
+                ttfts.append(first)
+                total_tokens[0] += n
+
+    threads = [threading.Thread(target=client)
+               for _ in range(args.clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    ttfts.sort()
+    tok_lats.sort()
+    model = f"gpt_{cfg_name}"
+    print(json.dumps({
+        "metric": f"serve_{model}_ttft_p50_ms",
+        "value": round(ttfts[len(ttfts) // 2] * 1000, 2), "unit": "ms",
+        "p95_ms": round(ttfts[int(len(ttfts) * 0.95)] * 1000, 2),
+        "clients": args.clients}))
+    if tok_lats:
+        print(json.dumps({
+            "metric": f"serve_{model}_tok_latency_p50_ms",
+            "value": round(tok_lats[len(tok_lats) // 2] * 1000, 2),
+            "unit": "ms",
+            "p95_ms": round(tok_lats[int(len(tok_lats) * 0.95)] * 1000, 2)}))
+    print(json.dumps({
+        "metric": f"serve_{model}_decode_throughput",
+        "value": round(total_tokens[0] / wall, 1), "unit": "tokens/s",
+        "clients": args.clients, "streams": args.clients * args.streams}))
+    serve.shutdown()
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

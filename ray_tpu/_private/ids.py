@@ -34,16 +34,17 @@ _counter = 0
 # in) must sit in the first 8 of these 10 bytes.
 _proc_seed = bytes(a ^ b for a, b in zip(
     os.urandom(6), os.getpid().to_bytes(6, "big", signed=False)))
-_seq_lock = threading.Lock()
-_seq = 0
+# itertools.count.__next__ is a single C call — atomic under the GIL, so
+# the hot path needs no lock (a lock acquire/release pair costs more
+# than the whole ID otherwise).
+import itertools as _itertools
+
+_seq_iter = _itertools.count(1)
 
 
 def _rand_bytes(n: int) -> bytes:
-    global _seq
     if n == 10:
-        with _seq_lock:
-            _seq += 1
-            s = _seq & 0xFFFFFFFF
+        s = next(_seq_iter) & 0xFFFFFFFF
         return _proc_seed[:4] + s.to_bytes(4, "big") + _proc_seed[4:6]
     return os.urandom(n)
 

@@ -221,6 +221,9 @@ def core_metrics() -> dict:
             workers_alive=Gauge("workers_alive", "Live workers (head view)"),
             leases_granted=Counter(
                 "leases_granted_total", "Worker leases granted by the head"),
+            objects_recovered=Counter(
+                "objects_recovered_total",
+                "Lost objects rebuilt via lineage re-execution"),
         )
     return _core
 

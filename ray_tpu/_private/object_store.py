@@ -75,6 +75,20 @@ class MemoryStore:
         for w in watchers:
             w.set()
 
+    def put_many(self, items) -> None:
+        """Store ``[(object_id, frames), ...]`` under one lock pass —
+        reply ingestion lands whole chunks at once."""
+        to_set = []
+        with self._lock:
+            for object_id, frames in items:
+                self._objects[object_id] = frames
+                ev = self._events.pop(object_id, None)
+                if ev:
+                    to_set.append(ev)
+                to_set.extend(self._watchers.pop(object_id, ()))
+        for ev in to_set:
+            ev.set()
+
     def add_watcher(self, object_id: ObjectID, ev: threading.Event) -> None:
         """Fire ``ev`` when the object arrives (immediately if present)."""
         with self._lock:
@@ -97,6 +111,18 @@ class MemoryStore:
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             return object_id in self._objects
+
+    def get_many(self, object_ids) -> dict:
+        """Snapshot whichever of ``object_ids`` are present — one lock
+        pass for a whole ``get([refs])`` burst."""
+        out = {}
+        with self._lock:
+            objs = self._objects
+            for oid in object_ids:
+                frames = objs.get(oid)
+                if frames is not None:
+                    out[oid] = frames
+        return out
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None):
         with self._lock:

@@ -113,7 +113,8 @@ class Connection:
                 meta, out_bufs = result, []
             frames = [pickle.dumps((KIND_RESPONSE, req_id, method, meta))] + out_bufs
             _write_msg(self._writer, frames)
-            await self._drain()
+            if self._needs_drain():
+                await self._drain()
         except Exception as e:  # noqa: BLE001 - errors cross the wire
             import traceback
 
@@ -133,6 +134,17 @@ class Connection:
             import traceback
 
             traceback.print_exc()
+
+    def _needs_drain(self) -> bool:
+        """True when the transport actually wants flow control. Draining
+        unconditionally costs a coroutine step (send side: a whole task)
+        per message — at tens of thousands of messages/s that is real
+        loop churn for a no-op."""
+        tr = self._writer.transport
+        try:
+            return tr.get_write_buffer_size() > 256 * 1024
+        except Exception:  # noqa: BLE001 - non-standard transport
+            return True
 
     async def _drain(self):
         try:
@@ -158,7 +170,8 @@ class Connection:
         self._pending[req_id] = fut
         frames = [pickle.dumps((KIND_REQUEST, req_id, method, payload))] + list(bufs)
         _write_msg(self._writer, frames)
-        asyncio.get_running_loop().create_task(self._drain())
+        if self._needs_drain():
+            asyncio.get_running_loop().create_task(self._drain())
         return fut
 
     async def call(self, method: str, payload: Any = None, bufs: List[bytes] = ()):

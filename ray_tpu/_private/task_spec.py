@@ -46,6 +46,7 @@ class TaskSpec:
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 0
     retry_count: int = 0
+    recovery_count: int = 0  # lineage re-executions consumed (owner-side)
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     # Actor fields
     actor_id: Optional[ActorID] = None
@@ -60,6 +61,11 @@ class TaskSpec:
     is_generator: bool = False
 
     def return_object_ids(self) -> List[ObjectID]:
-        return [
-            ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
-        ]
+        # Cached: submission builds the caller-facing refs and reply
+        # ingestion walks the same list — one construction, not two.
+        ids = getattr(self, "_return_ids", None)
+        if ids is None:
+            ids = [ObjectID.for_task_return(self.task_id, i)
+                   for i in range(self.num_returns)]
+            object.__setattr__(self, "_return_ids", ids)
+        return ids

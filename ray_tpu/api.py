@@ -291,6 +291,7 @@ class RemoteFunction:
         self._fn = fn
         self._options = options
         self._fn_key: Optional[str] = None
+        self._call_template: Optional[Dict[str, Any]] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *a, **kw):
@@ -316,15 +317,29 @@ class RemoteFunction:
         core = _core()
         if self._fn_key is None:
             self._fn_key = core.export_function(self._fn)
-        num_returns = self._options.get("num_returns", 1)
+        # Options are immutable after construction (``options()`` builds
+        # a new RemoteFunction), so resolve them once: a burst of
+        # ``fn.remote()`` calls must not re-derive resources/strategy
+        # dicts per call.
+        tmpl = self._call_template
+        if tmpl is None:
+            tmpl = self._call_template = {
+                "num_returns": self._options.get("num_returns", 1),
+                "resources": _resources_from_options(self._options),
+                "max_retries": self._options.get("max_retries"),
+                "strategy": _strategy_from_options(self._options),
+                "name": self._options.get("name") or self._fn.__name__,
+                "runtime_env": self._options.get("runtime_env"),
+            }
+        num_returns = tmpl["num_returns"]
         refs = core.submit_task(
             self._fn_key, args, kwargs,
             num_returns=num_returns,
-            resources=_resources_from_options(self._options),
-            max_retries=self._options.get("max_retries"),
-            strategy=_strategy_from_options(self._options),
-            name=self._options.get("name") or self._fn.__name__,
-            runtime_env=self._options.get("runtime_env"),
+            resources=tmpl["resources"],
+            max_retries=tmpl["max_retries"],
+            strategy=tmpl["strategy"],
+            name=tmpl["name"],
+            runtime_env=tmpl["runtime_env"],
         )
         if num_returns == "streaming":
             return refs  # an ObjectRefGenerator

@@ -1,0 +1,171 @@
+"""KV-cache autoregressive decoding for the GPT model.
+
+The serving-side twin of :mod:`ray_tpu.models.gpt` (reference
+capability: vLLM-style decode loops the reference serves behind Ray
+Serve; here designed TPU-first): static-shape caches so XLA compiles
+exactly two programs (one prefill per bucket, one decode step), scan
+over the stacked layer parameters, and masked full-length attention
+reads so the decode step costs O(max_len) with no dynamic shapes.
+
+Layout notes for the MXU/HBM:
+- cache is [L, B, max_len, H, hd] in the model compute dtype (bf16 on
+  TPU) — the decode step's attention reads it once per token; keeping
+  it bf16 halves the HBM traffic that dominates decode latency.
+- the single-token block math reuses the training block's weights via
+  the same ``_mm`` helper, so MXU-friendly dtypes match training.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .gpt import (GPTConfig, Params, _mm, _project_vocab, _rmsnorm)
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Cache:
+    shape = (cfg.n_layer, batch, max_len, cfg.n_head, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _block_kv(x, p, cfg: GPTConfig):
+    """Training block minus attention: returns (q, k, v, pre-attn x)."""
+    B, S, _ = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    h = _rmsnorm(x, p["ln1_scale"])
+    q = _mm(h, p["wq"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
+    k = _mm(h, p["wk"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
+    v = _mm(h, p["wv"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
+    return q, k, v
+
+
+def _ffn(x, p, cfg: GPTConfig):
+    h = _rmsnorm(x, p["ln2_scale"])
+    if cfg.n_experts > 0:
+        from ray_tpu.models.moe import moe_ffn
+
+        y, _ = moe_ffn(h, p["router"]["kernel"], p["w_up"]["kernel"],
+                       p["w_down"]["kernel"], top_k=cfg.expert_top_k,
+                       capacity_factor=cfg.capacity_factor,
+                       dtype=cfg.dtype)
+        return x + y
+    h = _mm(h, p["w1"]["kernel"], cfg.dtype)
+    h = jax.nn.gelu(h)
+    return x + _mm(h, p["w2"]["kernel"], cfg.dtype)
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: GPTConfig,
+            cache: Cache) -> Tuple[jax.Array, Cache]:
+    """Run the prompt once, filling the cache.
+
+    tokens [B, S] → (last-position logits [B, vocab], cache with
+    pos=S). S must be <= the cache's max_len; compile once per padded
+    prompt bucket.
+    """
+    B, S = tokens.shape
+    max_len = cache["k"].shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
+    x = x + params["pos_embed"][:S].astype(cfg.dtype)[None]
+
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    def body(carry, layer):
+        x = carry
+        p, kc, vc = layer
+        q, k, v = _block_kv(x, p, cfg)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        kc = lax.dynamic_update_slice(kc, k, (0, 0, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, 0, 0, 0))
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["block"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = _project_vocab(x[:, -1:], params["embed"]["kernel"], cfg)
+    new_cache = {"k": k_new, "v": v_new,
+                 "pos": jnp.asarray(S, jnp.int32)}
+    return logits[:, 0], new_cache
+
+
+def decode_step(params: Params, cache: Cache, token: jax.Array,
+                cfg: GPTConfig) -> Tuple[jax.Array, Cache]:
+    """One autoregressive step: token [B] int32 → (logits [B, vocab],
+    cache advanced by one). Static shapes: attention reads the full
+    cache length with future positions masked."""
+    B = token.shape[0]
+    max_len = cache["k"].shape[2]
+    pos = cache["pos"]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    x = params["embed"]["kernel"].astype(cfg.dtype)[token][:, None]
+    x = x + jnp.take(params["pos_embed"], pos, axis=0
+                     ).astype(cfg.dtype)[None, None]
+    # Positions <= pos are valid history (incl. the token being written).
+    valid = (jnp.arange(max_len) <= pos)[None, None, None, :]
+
+    def body(carry, layer):
+        x = carry
+        p, kc, vc = layer
+        q, k, v = _block_kv(x, p, cfg)   # [B, 1, H, hd]
+        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vc,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, 1, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["block"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = _project_vocab(x, params["embed"]["kernel"], cfg)
+    return logits[:, 0], {"k": k_new, "v": v_new, "pos": pos + 1}
+
+
+def generate(params: Params, prompt: jax.Array, cfg: GPTConfig,
+             max_new_tokens: int, max_len: int = 0,
+             temperature: float = 0.0, rng: jax.Array = None):
+    """Greedy/sampled generation; yields one [B] token array per step
+    (the serving replica streams these). Jits prefill and decode_step
+    once each per (batch, max_len) shape."""
+    B, S = prompt.shape
+    max_len = max_len or cfg.max_seq
+    if S + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache length {max_len}")
+    cache = init_cache(cfg, B, max_len)
+    pf = jax.jit(prefill, static_argnums=(2,))
+    step = jax.jit(decode_step, static_argnums=(3,))
+    logits, cache = pf(params, prompt, cfg, cache)
+    for i in range(max_new_tokens):
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+            token = jax.random.categorical(
+                sub, logits / temperature, axis=-1).astype(jnp.int32)
+        else:
+            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        yield token
+        if i + 1 < max_new_tokens:
+            logits, cache = step(params, cache, token, cfg)

@@ -11,13 +11,15 @@ from .api import (Application, Deployment, delete, deployment,
                   start, status)
 from .batching import batch, default_buckets, pad_to_bucket
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
-from .handle import DeploymentHandle, DeploymentResponse
+from .handle import (DeploymentHandle, DeploymentResponse,
+                     DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .request import Request, Response
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "Request",
+    "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
+    "HTTPOptions", "Request",
     "Response", "batch", "default_buckets", "delete", "deployment",
     "get_multiplexed_model_id", "multiplexed",
     "get_app_handle", "get_deployment_handle", "pad_to_bucket", "run",

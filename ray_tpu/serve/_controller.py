@@ -53,6 +53,7 @@ class ServeController:
                            "ingress": None, "deployments": {}})
                 app["route_prefix"] = spec.get("route_prefix")
                 app["ingress"] = spec["ingress"]
+                app["stream"] = bool(spec.get("stream"))
                 wanted = {d["name"] for d in spec["deployments"]}
                 removed = [app["deployments"].pop(dname)
                            for dname in list(app["deployments"])
@@ -147,7 +148,8 @@ class ServeController:
             for name, app in self._apps.items():
                 if app["route_prefix"]:
                     out[app["route_prefix"]] = {
-                        "app": name, "ingress": app["ingress"]}
+                        "app": name, "ingress": app["ingress"],
+                        "stream": bool(app.get("stream"))}
             return out
 
     def get_ingress(self, app_name: str) -> Optional[str]:

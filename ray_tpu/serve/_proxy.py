@@ -114,6 +114,33 @@ class ProxyActor:
                     break
                 status, ctype, body = await self._dispatch(req)
                 keep = req.headers.get("connection", "").lower() != "close"
+                if callable(body):
+                    # Streaming response: chunked transfer encoding, one
+                    # chunk per item the replica generator yields
+                    # (reference: proxy.py streaming + http_util.py).
+                    writer.write(
+                        b"HTTP/1.1 %d %s\r\n" % (status, _reason(status)) +
+                        b"Content-Type: %s\r\n" % ctype.encode() +
+                        b"Transfer-Encoding: chunked\r\n" +
+                        (b"Connection: keep-alive\r\n" if keep
+                         else b"Connection: close\r\n") + b"\r\n")
+                    loop = asyncio.get_running_loop()
+                    while True:
+                        chunk = await loop.run_in_executor(self._pool, body)
+                        if chunk is None:
+                            break
+                        if not chunk:
+                            # A zero-length chunk IS the chunked-encoding
+                            # terminator — writing it would end the
+                            # response mid-stream.
+                            continue
+                        writer.write(b"%x\r\n" % len(chunk) + chunk + b"\r\n")
+                        await writer.drain()
+                    writer.write(b"0\r\n\r\n")
+                    await writer.drain()
+                    if not keep:
+                        break
+                    continue
                 writer.write(
                     b"HTTP/1.1 %d %s\r\n" % (status, _reason(status)) +
                     b"Content-Type: %s\r\n" % ctype.encode() +
@@ -170,6 +197,32 @@ class ProxyActor:
         if target is None:
             return 404, "text/plain", b"no application at this route"
         loop = asyncio.get_running_loop()
+        if target.get("stream"):
+            try:
+                gen = await asyncio.wait_for(
+                    loop.run_in_executor(
+                        self._pool, self._call_app_stream, target, req),
+                    timeout=self._request_timeout_s)
+            except asyncio.TimeoutError:
+                return 504, "text/plain", b"request timed out"
+            except Exception as e:  # noqa: BLE001
+                return 500, "text/plain", (
+                    f"{type(e).__name__}: {e}".encode())
+
+            def next_chunk():
+                """Blocking puller run on the proxy pool; None ends the
+                stream (sentinel keeps the executor round-trip single)."""
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    return None
+                if isinstance(item, bytes):
+                    return item
+                if isinstance(item, str):
+                    return item.encode()
+                return json.dumps(item).encode() + b"\n"
+
+            return 200, "application/octet-stream", next_chunk
         try:
             result = await asyncio.wait_for(
                 loop.run_in_executor(
@@ -189,6 +242,11 @@ class ProxyActor:
     def _call_app(self, target: dict, req: Request):
         handle = DeploymentHandle(target["app"], target["ingress"])
         return handle.remote(req).result(timeout=self._request_timeout_s)
+
+    def _call_app_stream(self, target: dict, req: Request):
+        handle = DeploymentHandle(target["app"], target["ingress"],
+                                  stream=True)
+        return handle.remote(req)
 
 
 def _reason(status: int) -> bytes:

@@ -73,6 +73,63 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict, ctx: dict = None):
+        """Generator twin of ``handle_request`` (reference:
+        ``serve/_private/replica.py:391-543`` handle_request_streaming):
+        items from the user generator stream back to the caller one at a
+        time over the core streaming-generator transport instead of
+        buffering the whole response."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        token = None
+        if ctx and ctx.get("multiplexed_model_id"):
+            from .multiplex import _request_model_id
+
+            token = _request_model_id.set(ctx["multiplexed_model_id"])
+        try:
+            if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
+                method = self._user
+            else:
+                method = getattr(self._user, method_name)
+            out = method(*args, **kwargs)
+            if inspect.isasyncgen(out):
+                # Drain the async generator on a private loop; the
+                # replica's concurrency model is threads, not one loop.
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(out.__anext__())
+                        except StopAsyncIteration:
+                            break
+                finally:
+                    # Abandoned stream: run the handler's cleanup
+                    # (try/finally, context managers) before the loop
+                    # goes away — GC would otherwise try to aclose on a
+                    # closed loop.
+                    try:
+                        loop.run_until_complete(out.aclose())
+                    except Exception:  # noqa: BLE001 - cleanup best-effort
+                        pass
+                    loop.close()
+            elif inspect.isgenerator(out) or hasattr(out, "__next__"):
+                yield from out
+            else:
+                if inspect.iscoroutine(out):
+                    out = asyncio.run(out)
+                # Non-generator handler called in streaming mode: a
+                # single-item stream keeps the caller's contract.
+                yield out
+        finally:
+            if token is not None:
+                from .multiplex import _request_model_id
+
+                _request_model_id.reset(token)
+            with self._lock:
+                self._ongoing -= 1
+
     # ---------------------------------------------------------- control plane
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:

@@ -225,8 +225,19 @@ def _build_app_spec(app: Application, name: str,
         return obj
 
     ingress = visit(app)
+    # Streaming ingress detection (reference: StreamingResponse handling
+    # in the proxy): a generator __call__ makes the proxy stream the
+    # HTTP response chunked instead of buffering it.
+    import inspect
+
+    root = app.deployment.func_or_class
+    target = root if inspect.isfunction(root) else \
+        getattr(root, "__call__", None)
+    stream = bool(target is not None and
+                  (inspect.isgeneratorfunction(target)
+                   or inspect.isasyncgenfunction(target)))
     return {"name": name, "route_prefix": route_prefix, "ingress": ingress,
-            "deployments": list(deployments.values())}
+            "stream": stream, "deployments": list(deployments.values())}
 
 
 def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:

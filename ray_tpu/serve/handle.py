@@ -109,39 +109,87 @@ class DeploymentResponse:
         return self._ref.__await__()
 
 
+class DeploymentResponseGenerator:
+    """Iterable result of ``handle.options(stream=True).remote()``
+    (reference: ``serve/handle.py`` DeploymentResponseGenerator). Items
+    arrive as the replica's generator yields them; in-flight accounting
+    is released once, on exhaustion, failure, or abandonment."""
+
+    def __init__(self, router: "Router", rid: str, gen):
+        self._router = router
+        self._rid = rid
+        self._gen = gen
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._router.release(self._rid)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .. import api as rt
+
+        if self._done:
+            raise StopIteration
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._finish()
+            raise
+        try:
+            return rt.get(ref)
+        except Exception:
+            self._finish()
+            raise
+
+    def __del__(self):
+        try:
+            self._finish()
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
+
+
 class DeploymentHandle:
     """Picklable handle to one deployment of one app."""
 
     def __init__(self, app_name: str, deployment_name: str,
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.method_name = method_name
         self.multiplexed_model_id = multiplexed_model_id
+        self.stream = stream
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.app_name, self.deployment_name, self.method_name,
-                 self.multiplexed_model_id))
+                 self.multiplexed_model_id, self.stream))
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self.method_name,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self.multiplexed_model_id)
+            else self.multiplexed_model_id,
+            self.stream if stream is None else stream)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.app_name, self.deployment_name, name,
-                                self.multiplexed_model_id)
+                                self.multiplexed_model_id, self.stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = get_router(self.app_name, self.deployment_name)
+        if self.stream:
+            return router.submit_stream(self.method_name, args, kwargs,
+                                        model_id=self.multiplexed_model_id)
         return router.submit(self.method_name, args, kwargs,
                              model_id=self.multiplexed_model_id)
 
@@ -265,6 +313,42 @@ class Router:
         self._waiter_wake.set()
         return DeploymentResponse(self, rid, ref,
                                   (method_name, args, kwargs), model_id)
+
+    def submit_stream(self, method_name: str, args: tuple, kwargs: dict,
+                      timeout_s: float = 60.0,
+                      model_id: str = "") -> "DeploymentResponseGenerator":
+        """Streaming dispatch: same admission + pow-2 pick as submit(),
+        but the replica call rides the core streaming-generator
+        transport and the in-flight slot is held until the stream ends
+        (released by the DeploymentResponseGenerator, not the completion
+        loop — a stream has no single completion ref to wait on)."""
+        self.refresh()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._cond:
+                rid = self._pick_locked(model_id)
+                if rid is not None:
+                    self._ongoing[rid] += 1
+                    handle = self._replicas[rid]
+                    break
+                waited = self._cond.wait(timeout=0.05)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {self.deployment_name} accepted the "
+                    f"request within {timeout_s}s")
+            if not waited:
+                self.refresh()
+        ctx = {"multiplexed_model_id": model_id} if model_id else None
+        gen = handle.handle_request_streaming.options(
+            num_returns="streaming").remote(method_name, args, kwargs, ctx)
+        return DeploymentResponseGenerator(self, rid, gen)
+
+    def release(self, rid: str):
+        """Return one in-flight slot (stream finished or abandoned)."""
+        with self._cond:
+            if rid in self._ongoing:
+                self._ongoing[rid] = max(0, self._ongoing[rid] - 1)
+            self._cond.notify_all()
 
     def _pick_locked(self, model_id: str = "") -> Optional[str]:
         rids = [r for r in self._replicas

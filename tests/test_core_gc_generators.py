@@ -38,6 +38,11 @@ def test_put_del_frees_memory_store(rt_cluster):
     assert _store_size() == before + 1
     del ref
     gc.collect()
+    # Ref-dec processing is batched onto the IO-loop sweeper (~100ms
+    # cadence); the free is asynchronous but prompt.
+    deadline = time.time() + 2
+    while time.time() < deadline and _store_size() > before:
+        time.sleep(0.05)
     assert _store_size() <= before
 
 
@@ -47,6 +52,9 @@ def test_put_del_frees_shm(rt_cluster):
     assert _shm_used() >= before + (1 << 22)
     del ref
     gc.collect()
+    deadline = time.time() + 2   # async sweeper-batched free
+    while time.time() < deadline and _shm_used() > before:
+        time.sleep(0.05)
     assert _shm_used() <= before
 
 

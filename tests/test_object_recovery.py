@@ -1,0 +1,172 @@
+"""Lineage-based object recovery (reference capability:
+``src/ray/core_worker/object_recovery_manager.h:41``, lineage
+resubmission ``task_manager.h:208``): a lost normal-task result is
+rebuilt by re-executing its producing task, transitively through its
+dependencies, without user-visible errors."""
+import gc
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core.worker import CoreWorker
+
+
+def _core():
+    return CoreWorker._current
+
+
+def _shm_delete(oid):
+    """Simulate segment loss (node crash / spill file eviction): unlink
+    the backing file so every future attach fails, then drop any local
+    index entry."""
+    try:
+        os.unlink(f"/dev/shm/rt_{oid.hex()[:30]}")
+    except FileNotFoundError:
+        pass
+    _core().shm_store.delete(oid)
+
+
+def test_recover_shm_result(rt_cluster):
+    @rt.remote
+    def make(n):
+        return np.arange(n, dtype=np.float32)
+
+    ref = make.remote(1 << 20)  # 4 MB -> shm tier
+    first = rt.get(ref)
+    _shm_delete(ref.object_id)
+    rebuilt = rt.get(ref)
+    assert np.array_equal(first, rebuilt)
+
+
+def test_recover_transitive_chain(rt_cluster):
+    """Losing both a result AND its (freed) upstream dependency rebuilds
+    the whole chain."""
+
+    @rt.remote
+    def base():
+        return np.ones(1 << 20, dtype=np.float32)  # 4 MB -> shm
+
+    @rt.remote
+    def double(x):
+        return x * 2
+
+    b = base.remote()
+    d = double.remote(b)
+    assert rt.get(d)[0] == 2.0
+    # Lose the downstream result and the upstream value, then drop the
+    # upstream ref entirely — recovery must re-run base() from lineage
+    # (its entry is pinned by double's lineage).
+    _shm_delete(d.object_id)
+    _shm_delete(b.object_id)
+    bid = b.object_id
+    del b
+    gc.collect()
+    rebuilt = rt.get(d)
+    assert rebuilt[0] == 2.0 and rebuilt.shape == (1 << 20,)
+
+
+def test_put_objects_not_recoverable(rt_cluster):
+    """rt.put has no lineage (matches the reference default): loss is a
+    user-visible ObjectLostError, not silent corruption."""
+    ref = rt.put(np.zeros(1 << 20, dtype=np.float32))
+    rt.get(ref)
+    _shm_delete(ref.object_id)
+    with pytest.raises((rt.exceptions.ObjectLostError,
+                        rt.exceptions.GetTimeoutError)):
+        rt.get(ref, timeout=3)
+
+
+def test_recovery_counted_in_metrics(rt_cluster):
+    from ray_tpu._private.metrics import core_metrics
+
+    def total():
+        return sum(v for _, v in
+                   core_metrics()["objects_recovered"].collect())
+
+    @rt.remote
+    def make():
+        return np.zeros(1 << 20, dtype=np.float32)
+
+    before = total()
+    ref = make.remote()
+    rt.get(ref)
+    _shm_delete(ref.object_id)
+    rt.get(ref)
+    assert total() > before
+
+
+def test_chaos_worker_killed_holding_shm_intermediates(rt_fresh):
+    """Kill the worker whose shm holds a pipeline's intermediate objects
+    mid-run; downstream consumption recovers via lineage (VERDICT round
+    2, 'Next round' item 2)."""
+    rt = rt_fresh
+
+    @rt.remote
+    def produce(i):
+        return np.full(1 << 19, i, dtype=np.float32)  # 2 MB each
+
+    @rt.remote
+    def consume(x):
+        return float(x[0])
+
+    refs = [produce.remote(i) for i in range(8)]
+    rt.get([consume.remote(r) for r in refs])  # materialize all
+
+    # Kill every leased worker (SIGKILL: segments created by them survive
+    # in /dev/shm, but lose their creator) AND delete half the segments
+    # outright to simulate the crash taking data with it.
+    for w in rt.state("workers"):
+        if w.get("pid") and w["pid"] != os.getpid():
+            try:
+                os.kill(w["pid"], signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    for r in refs[::2]:
+        _shm_delete(r.object_id)
+
+    # Consumers see every value again — rebuilt where necessary.
+    out = rt.get([consume.remote(r) for r in refs], timeout=120)
+    assert out == [float(i) for i in range(8)]
+
+
+def test_multinode_node_death_objects_recovered():
+    """Kill a node whose worker produced (and whose shm domain holds)
+    objects a live consumer still needs; the owner re-executes the
+    producing tasks elsewhere."""
+    from ray_tpu.cluster_utils import Cluster
+
+    if rt.is_initialized():
+        rt.shutdown()
+    cluster = Cluster()  # head has no CPU: tasks land on nodes
+    try:
+        n1 = cluster.add_node(num_cpus=4)
+        cluster.connect()
+
+        @rt.remote
+        def produce(i):
+            return np.full(1 << 19, i, dtype=np.float32)
+
+        @rt.remote
+        def consume(x):
+            return float(x[0])
+
+        refs = [produce.remote(i) for i in range(4)]
+        assert rt.get([consume.remote(r) for r in refs],
+                      timeout=60) == [0.0, 1.0, 2.0, 3.0]
+
+        n2 = cluster.add_node(num_cpus=4)
+        cluster.remove_node(n1)  # the producing node (and its shm) dies
+        # The driver owns the refs; its pulls now re-execute the
+        # producers on the surviving node.
+        out = rt.get([consume.remote(r) for r in refs], timeout=120)
+        assert out == [0.0, 1.0, 2.0, 3.0]
+    finally:
+        try:
+            rt.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()

@@ -1,0 +1,105 @@
+"""Serve streaming responses (reference:
+``serve/_private/replica.py:391-543`` handle_request_streaming +
+``proxy.py`` chunked streaming): generator deployments stream items
+through handles and as chunked HTTP, token by token."""
+import http.client
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance(rt_cluster):
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    yield serve
+    serve.shutdown()
+
+
+def test_handle_streaming(serve_instance):
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * 10
+
+    h = serve.run(Streamer.bind(), name="streamer", route_prefix=None)
+    gen = h.options(stream=True).remote(5)
+    assert isinstance(gen, serve.DeploymentResponseGenerator)
+    assert list(gen) == [0, 10, 20, 30, 40]
+    serve.delete("streamer")
+
+
+def test_handle_streaming_async_gen(serve_instance):
+    @serve.deployment
+    class AsyncStreamer:
+        async def __call__(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+    h = serve.run(AsyncStreamer.bind(), name="astream", route_prefix=None)
+    out = list(h.options(stream=True).remote(3))
+    assert out == ["tok0", "tok1", "tok2"]
+    serve.delete("astream")
+
+
+def test_streaming_error_propagates(serve_instance):
+    @serve.deployment
+    class Bad:
+        def __call__(self, n):
+            yield 1
+            raise ValueError("boom mid-stream")
+
+    h = serve.run(Bad.bind(), name="bad", route_prefix=None)
+    gen = h.options(stream=True).remote(1)
+    assert next(gen) == 1
+    with pytest.raises(Exception) as ei:
+        list(gen)
+    assert "boom" in str(ei.value)
+    serve.delete("bad")
+
+
+def test_http_chunked_streaming(serve_instance):
+    """A generator ingress streams over HTTP with chunked transfer
+    encoding — chunks arrive incrementally, not as one buffered body."""
+
+    @serve.deployment
+    class TokenStream:
+        def __call__(self, request):
+            for i in range(4):
+                yield f"tok{i} ".encode()
+                time.sleep(0.05)
+
+    serve.run(TokenStream.bind(), name="toks", route_prefix="/toks")
+    from ray_tpu.serve import api as serve_api
+
+    port = serve_api._client["http"]["port"]
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/toks")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Transfer-Encoding") == "chunked"
+    first = resp.read(5)          # arrives before the stream finishes
+    rest = resp.read()
+    assert (first + rest) == b"tok0 tok1 tok2 tok3 "
+    conn.close()
+    serve.delete("toks")
+
+
+def test_streaming_releases_router_slot(serve_instance):
+    """Abandoned/finished streams must return their in-flight slot or
+    the router would wedge at max_ongoing_requests."""
+
+    @serve.deployment(max_ongoing_requests=2)
+    class S:
+        def __call__(self, n):
+            for i in range(n):
+                yield i
+
+    h = serve.run(S.bind(), name="slots", route_prefix=None)
+    for _ in range(8):  # > max_ongoing: only passes if slots release
+        assert list(h.options(stream=True).remote(3)) == [0, 1, 2]
+    serve.delete("slots")
