@@ -1,0 +1,77 @@
+"""Object broadcast benchmark (VERDICT round 2, item 3): one large
+object fanned out to N workers across 2 shm domains — the weight-sync
+shape. Reference scale point: 1GiB to 50 nodes in 15.86s
+(BASELINE.md:32).
+
+One ``rt.put`` → N consumers passing the ref; same-domain consumers
+attach the single shm segment, cross-domain consumers chunk-pull and
+register as copies (later pullers stripe across them).
+
+Run: ``python benchmarks/broadcast_bench.py [--mb 1024] [--workers 8]``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=int, default=1024)
+    parser.add_argument("--workers", type=int, default=8)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    n1 = cluster.add_node(num_cpus=args.workers // 2)
+    n2 = cluster.add_node(num_cpus=args.workers // 2)
+    rt = cluster.connect()
+    strat = rt.NodeAffinitySchedulingStrategy
+
+    payload = np.random.randint(0, 255, args.mb * (1 << 20),
+                                dtype=np.uint8)
+
+    @rt.remote
+    def consume(x):
+        return int(x[0]) + int(x[-1])
+
+    # Warm the worker pools so spawn time stays out of the measurement.
+    rt.get([consume.options(
+        scheduling_strategy=strat(n.node_id)).remote(
+            np.zeros(4, np.uint8))
+        for n in (n1, n2) for _ in range(args.workers // 2)], timeout=120)
+
+    t0 = time.perf_counter()
+    ref = rt.put(payload)
+    want = int(payload[0]) + int(payload[-1])
+    refs = [consume.options(
+        scheduling_strategy=strat((n1, n2)[i % 2].node_id)).remote(ref)
+        for i in range(args.workers)]
+    out = rt.get(refs, timeout=600)
+    wall = time.perf_counter() - t0
+    assert out == [want] * args.workers
+
+    gib = args.mb / 1024
+    print(json.dumps({
+        "metric": "broadcast_to_workers",
+        "value": round(wall, 2), "unit": "s",
+        "size_gib": round(gib, 3), "workers": args.workers,
+        "domains": 2,
+        "effective_gbps": round(gib * args.workers / wall, 2),
+        "reference_point": "1GiB to 50 nodes in 15.86s "
+                           "(BASELINE.md:32, multi-host cluster)"}))
+    rt.shutdown()
+    cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

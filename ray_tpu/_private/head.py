@@ -96,6 +96,7 @@ class ActorInfo:
     detached: bool = False
     handle_refs: int = 0
     pending_gc: Any = None  # asyncio task for the grace-period kill
+    restart_inflight: bool = False  # _restart_actor placement running
 
 
 @dataclass
@@ -137,6 +138,14 @@ class HeadService:
         self.named_actors: Dict[str, ActorID] = {}
         self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)
+        # Object copy directory (reference capability:
+        # ``ownership_based_object_directory.h`` — which nodes hold a
+        # copy): oid hex -> {location key -> (address, shm_domain)}.
+        # Pullers use it to spread big pulls over every live copy.
+        self.object_locations: Dict[str, Dict[str, tuple]] = {}
+        # (object hex, domain) -> (claimer key, ts): one cross-domain
+        # pull per domain at a time.
+        self._pull_claims: Dict[tuple, tuple] = {}
         self._pending_leases: deque = deque()  # (req, pg_meta, strategy, fut)
         self._registration_waiters: Dict[WorkerID, asyncio.Future] = {}
         self._subs: Dict[str, List[rpc.Connection]] = defaultdict(list)
@@ -165,17 +174,50 @@ class HeadService:
         # Head restart on an existing session dir adopts the durable
         # control-plane state (GCS-restart analogue).
         state_path = os.path.join(self.session_dir, "head_state.pkl")
+        self._restored_tcp_port = None
+        restored = False
         if os.path.exists(state_path):
             try:
                 self.restore_state(state_path)
+                restored = True
             except Exception:  # noqa: BLE001 - a bad snapshot can't brick
+                pass
+        # A SIGKILL'd predecessor leaves its socket file behind; the new
+        # head must re-bind the same path (workers reconnect to it). But
+        # NEVER steal the socket of a LIVE head — probe it first, or a
+        # double-start would silently split-brain the session.
+        if os.path.exists(self.sock_path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(1.0)
+            try:
+                probe.connect(self.sock_path)
+                probe.close()
+                raise RuntimeError(
+                    f"a head is already serving {self.sock_path}; refusing "
+                    "to start a second one on the same session")
+            except (ConnectionRefusedError, FileNotFoundError,
+                    socket.timeout, OSError):
+                probe.close()
+            try:
+                os.unlink(self.sock_path)
+            except OSError:
                 pass
         self._server = rpc.RpcServer(self._handle, path=self.sock_path)
         await self._server.start()
         # TCP listener for remote node daemons / workers / drivers
         # (reference: GCS listens on a TCP port for raylet registration).
-        self._tcp_server = rpc.RpcServer(self._handle, host="0.0.0.0")
-        await self._tcp_server.start()
+        # On restart, reclaim the predecessor's port so remote peers'
+        # reconnect loops find us at the address they already know.
+        try:
+            self._tcp_server = rpc.RpcServer(
+                self._handle, host="0.0.0.0",
+                port=self._restored_tcp_port or 0)
+            await self._tcp_server.start()
+        except OSError:
+            self._tcp_server = rpc.RpcServer(self._handle, host="0.0.0.0")
+            await self._tcp_server.start()
+        if restored:
+            self._loop.create_task(self._reconcile_after_restart())
         self._reaper_task = self._loop.create_task(self._reap_loop())
         if getattr(self.config, "dashboard_port", 0) >= 0:
             from .dashboard import DashboardServer
@@ -346,6 +388,19 @@ class HeadService:
                                node_dead: bool = False):
         self.workers.pop(w.worker_id, None)
         self.metrics_snapshots.pop(w.worker_id.hex(), None)
+        # A dead worker's object copies are gone: drop its directory
+        # entries so pullers stop picking it as a source, and free any
+        # pull claims it held so peers take over immediately.
+        wkey = repr(w.address)
+        for oid in list(self.object_locations):
+            locs = self.object_locations[oid]
+            if wkey in locs:
+                locs.pop(wkey, None)
+                if not locs:
+                    self.object_locations.pop(oid, None)
+        for ckey in list(self._pull_claims):
+            if self._pull_claims[ckey][0] == wkey:
+                self._pull_claims.pop(ckey, None)
         node = self.nodes.get(w.node)
         if node is not None:
             try:
@@ -360,10 +415,27 @@ class HeadService:
                 await self._handle_actor_failure(actor, cause)
         self._pump_leases()
 
+    async def _reconcile_after_restart(self):
+        """Grace window after a head restart: actors whose workers have
+        not reattached by then go through the normal failure path
+        (restart from creation spec or DEAD). Reference:
+        ``gcs_failover_worker_reconnect_timeout`` (``ray_config_def.h:60``)."""
+        grace = float(os.environ.get("RT_HEAD_RECONNECT_GRACE_S", "10"))
+        await asyncio.sleep(grace)
+        for a in list(self.actors.values()):
+            if a.state == "RESTARTING" and a.worker is None:
+                await self._handle_actor_failure(
+                    a, "worker did not reconnect after head restart")
+
     async def _handle_actor_failure(self, actor: ActorInfo, cause: str):
         if actor.restarts_used < actor.max_restarts:
             actor.restarts_used += 1
             actor.state = "RESTARTING"
+            # Gate against the reattach path: a worker reconnecting
+            # mid-restart must not flip this actor ALIVE on the old
+            # process while a new instance is being placed (two live
+            # instances with divergent state).
+            actor.restart_inflight = True
             self.publish(f"actor:{actor.actor_id.hex()}",
                          {"state": "RESTARTING", "cause": cause})
             try:
@@ -374,6 +446,8 @@ class HeadService:
                               "restarts": actor.restarts_used})
             except Exception as e:  # noqa: BLE001
                 self._mark_actor_dead(actor, f"restart failed: {e}")
+            finally:
+                actor.restart_inflight = False
         else:
             self._mark_actor_dead(actor, cause)
 
@@ -724,14 +798,35 @@ class HeadService:
         # instead open a dedicated control connection to the worker.
         info.conn = await rpc.connect(address, self._handle)
         self.workers[worker_id] = info
+        # Reattach after a head restart: the worker announces the actors
+        # it still hosts; RESTARTING records flip back to ALIVE. An
+        # actor whose restart placement is already in flight (transient
+        # disconnect, not a head crash) must NOT reattach — the restart
+        # wins, and the stale instance is told to drop itself.
+        reattached = False
+        stale = []
+        for ahex in payload.get("hosting_actors") or ():
+            a = self.actors.get(ActorID.from_hex(ahex))
+            if a is not None and a.state in ("RESTARTING", "PENDING") \
+                    and not a.restart_inflight:
+                a.state = "ALIVE"
+                a.worker = info
+                a.death_cause = ""
+                info.assignment = a.actor_id
+                reattached = True
+                self.publish(f"actor:{ahex}",
+                             {"state": "ALIVE", "address": address})
+            else:
+                stale.append(ahex)
         fut = self._registration_waiters.get(worker_id)
         if fut is not None and not fut.done():
             fut.set_result(info)
-        else:
+        elif not reattached:
             node = self.nodes.get(node_hex)
             if node is not None:
                 node.idle.append(info)  # adopted externally-started worker
         return {"node_id": node_hex,
+                "stale_actors": stale,
                 "config": self.config.to_dict()}
 
     async def _rpc_lease_worker(self, payload, bufs):
@@ -1095,6 +1190,51 @@ class HeadService:
         self.task_events.extend(payload)
         return {}
 
+    # ------------------------------------------------- object directory
+    async def _rpc_object_loc_add(self, payload, bufs):
+        addr = payload["address"]
+        key = repr(addr)
+        locs = self.object_locations.setdefault(payload["object_id"], {})
+        locs[key] = {"address": addr,
+                     "domain": payload.get("shm_domain"),
+                     "frame_sizes": payload.get("frame_sizes")}
+        # The copy exists: release any pull claim for this domain so a
+        # future re-pull (after this copy is freed) isn't stalled behind
+        # a stale claim.
+        self._pull_claims.pop(
+            (payload["object_id"], payload.get("shm_domain")), None)
+        return {}
+
+    async def _rpc_object_loc_get(self, payload, bufs):
+        locs = self.object_locations.get(payload["object_id"], {})
+        return {"locations": list(locs.values())}
+
+    async def _rpc_object_pull_claim(self, payload, bufs):
+        """Grant one puller per (object, shm domain): peers wait for the
+        claimer's copy and attach it locally instead of each moving the
+        same bytes across domains (reference: pull dedup in
+        ``pull_manager.h`` + plasma create/seal)."""
+        key = (payload["object_id"], payload.get("shm_domain"))
+        now = time.time()
+        cur = self._pull_claims.get(key)
+        if (cur is None or payload.get("force")
+                or cur[0] == repr(payload["address"])
+                or now - cur[1] > 300.0):
+            self._pull_claims[key] = (repr(payload["address"]), now)
+            return {"granted": True}
+        return {"granted": False}
+
+    async def _rpc_object_loc_del(self, payload, bufs):
+        if payload.get("address") is not None:
+            locs = self.object_locations.get(payload["object_id"])
+            if locs:
+                locs.pop(repr(payload["address"]), None)
+                if not locs:
+                    self.object_locations.pop(payload["object_id"], None)
+        else:
+            self.object_locations.pop(payload["object_id"], None)
+        return {}
+
     async def _rpc_get_task_events(self, payload, bufs):
         limit = payload.get("limit", 10000)
         return list(self.task_events)[-limit:]
@@ -1250,6 +1390,10 @@ class HeadService:
             "pgs": pgs,
             "jobs": [self._job_public(j) for j in list(self.jobs.values())],
             "job_counter": self.job_counter,
+            # A restarted head re-binds the same TCP port so node
+            # daemons/workers/drivers reconnect to the address they know.
+            "tcp_port": self._tcp_server._port if self._tcp_server
+            else None,
             "timestamp": time.time(),
         }
 
@@ -1277,19 +1421,26 @@ class HeadService:
             st = cloudpickle.loads(f.read())
         for ns, store in st["kv"].items():
             self.kv[ns].update(store)
+        self._restored_tcp_port = st.get("tcp_port")
         for rec in st["actors"]:
             actor_id = ActorID.from_hex(rec["actor_id"])
+            was_live = rec["state"] not in ("DEAD",)
             a = ActorInfo(
-                actor_id=actor_id, name=rec["name"], state="DEAD",
+                actor_id=actor_id, name=rec["name"],
+                # Live actors' processes may have survived the head
+                # crash (node-daemon workers): hold them RESTARTING for
+                # the reconnect grace window; workers that reattach with
+                # ``hosting_actors`` flip them back to ALIVE, the rest
+                # go through the normal failure/restart path (reference:
+                # ``gcs_failover_worker_reconnect_timeout``,
+                # ``ray_config_def.h:60``).
+                state="RESTARTING" if was_live else "DEAD",
                 worker=None, resources=rec["resources"],
                 max_restarts=rec["max_restarts"],
                 creation_spec_meta=rec["spec_meta"],
                 strategy=rec["strategy"], detached=rec["detached"],
-                # Actors already dead before the restart keep their real
-                # death cause; live ones died with their processes.
-                death_cause=(rec["death_cause"]
-                             if rec["state"] == "DEAD"
-                             else "head restarted (process lost)"),
+                death_cause=(rec["death_cause"] if not was_live
+                             else ""),
                 registered_at=time.time(),
             )
             self.actors[actor_id] = a

@@ -77,16 +77,45 @@ class NodeService:
             await self._conn.close()
 
     async def run_forever(self):
-        """Block until the head connection drops (then exit)."""
-        closed = asyncio.get_running_loop().create_future()
-        prev = self._conn.on_close
-        def _on_close():
-            if prev:
-                prev()
-            if not closed.done():
-                closed.set_result(None)
-        self._conn.on_close = _on_close
-        await closed
+        """Block until the head is gone for good. A dropped head
+        connection starts a reconnect loop (a restarted head re-binds
+        the same address and adopts us again); the daemon only exits —
+        taking its workers with it — once the grace window expires
+        (reference: raylet reconnect after GCS failover)."""
+        while True:
+            closed = asyncio.get_running_loop().create_future()
+            prev = self._conn.on_close
+
+            def _on_close(prev=prev, closed=closed):
+                if prev:
+                    prev()
+                if not closed.done():
+                    closed.set_result(None)
+
+            self._conn.on_close = _on_close
+            await closed
+            if self._stopping:
+                return
+            if not await self._reconnect_head():
+                return
+
+    async def _reconnect_head(self) -> bool:
+        grace = float(os.environ.get("RT_HEAD_RECONNECT_TIMEOUT_S", "30"))
+        deadline = time.time() + grace
+        while not self._stopping and time.time() < deadline:
+            try:
+                conn = await rpc.connect(self.head_address, self._handle)
+                await conn.call_simple("register_node", {
+                    "node_id": self.node_id.hex(),
+                    "hostname": self.shm_domain,
+                    "resources": self.resources,
+                    "labels": self.labels,
+                })
+                self._conn = conn
+                return True
+            except Exception:  # noqa: BLE001 - head still down
+                await asyncio.sleep(0.5)
+        return False
 
     # ------------------------------------------------------------- handler
     async def _handle(self, method: str, payload: Any, bufs: List[bytes],

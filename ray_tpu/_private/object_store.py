@@ -150,7 +150,8 @@ class SharedMemoryStore:
     Reader processes attach by name (zero-copy).
     """
 
-    def __init__(self, capacity_bytes: int, spill_dir: str = ""):
+    def __init__(self, capacity_bytes: int, spill_dir: str = "",
+                 domain: str = ""):
         self._capacity = capacity_bytes
         self._used = 0
         # RLock: see MemoryStore — the GC free path may re-enter delete().
@@ -161,6 +162,18 @@ class SharedMemoryStore:
         self._spill_dir = spill_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "rt_spill"
         )
+        # Segment names are scoped by shm domain: processes on the same
+        # host (same domain) agree on names and attach each other's
+        # segments; different domains — real remote hosts, or synthetic
+        # test nodes modelling them — cannot see each other's objects
+        # and must go through the transfer protocol.
+        import hashlib
+
+        self._prefix = (hashlib.sha1(domain.encode()).hexdigest()[:6] + "_"
+                        if domain else "")
+
+    def _name(self, object_id: ObjectID) -> str:
+        return "rt_" + self._prefix + object_id.hex()[:30]
 
     def create(self, object_id: ObjectID, frames: List[bytes]) -> int:
         """Write frames into a new segment. Returns total bytes.
@@ -174,7 +187,7 @@ class SharedMemoryStore:
             if self._used + n > self._capacity:
                 self._spill_lru(self._used + n - self._capacity)
             try:
-                shm = _open_shm(_shm_name(object_id), create=True, size=n)
+                shm = _open_shm(self._name(object_id), create=True, size=n)
             except FileExistsError:
                 return n  # already stored (idempotent put)
             pack_frames_into(shm.buf, 0, frames)
@@ -213,7 +226,7 @@ class SharedMemoryStore:
                 return self._safe_unpack(shm.buf)
         # Attach to a segment owned by another process on this host.
         try:
-            shm = _open_shm(_shm_name(object_id))
+            shm = _open_shm(self._name(object_id))
         except FileNotFoundError:
             return None
         with self._lock:
@@ -224,7 +237,7 @@ class SharedMemoryStore:
         if object_id in self._owned or object_id in self._attached:
             return True
         try:
-            shm = _open_shm(_shm_name(object_id))
+            shm = _open_shm(self._name(object_id))
         except FileNotFoundError:
             return False
         self._attached[object_id] = shm
@@ -264,7 +277,7 @@ class SharedMemoryStore:
             shm, n, path = self._owned[oid]
             if shm is None:
                 continue
-            p = os.path.join(self._spill_dir, _shm_name(oid))
+            p = os.path.join(self._spill_dir, self._name(oid))
             with open(p, "wb") as f:
                 f.write(shm.buf[:n])
             try:

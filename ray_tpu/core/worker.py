@@ -437,7 +437,8 @@ class CoreWorker:
         self.listen_tcp = listen_tcp
         self.memory_store = MemoryStore()
         self.shm_store = SharedMemoryStore(
-            self.config.object_store_memory, self.config.spill_directory)
+            self.config.object_store_memory, self.config.spill_directory,
+            domain=self.shm_domain)
         self.serde = get_context()
         self.sock_path = os.path.join(
             session_dir, "workers", f"{self.worker_id.hex()[:16]}.sock")
@@ -481,6 +482,7 @@ class CoreWorker:
         self._task_events: deque = deque(maxlen=10000)
         self._shutdown = False
         self._pubsub_handlers: Dict[str, List] = defaultdict(list)
+        self._subscribed_topics: set = set()
         self._next_task_index = 0
         self.refs = ReferenceCounter(self)
         self._pulls_inflight: set = set()
@@ -516,6 +518,7 @@ class CoreWorker:
         self._lineage_done: set = set()
         self._lineage_freed: set = set()
         self._recoveries: Dict[bytes, Any] = {}
+        self._registered_copies: set = set()
         self._actor_gc_enabled = (
             os.environ.get("RT_DISABLE_ACTOR_GC", "") != "1")
 
@@ -582,6 +585,16 @@ class CoreWorker:
         for oid, owner in self.refs.pop_containment(object_id):
             self.refs.release_borrow(oid, owner)
         self.on_object_freed(object_id)
+        # Retract this process's copy from the object directory (other
+        # holders keep theirs; dead-worker entries are pruned head-side).
+        # Guarded by the registered set so the common tiny-object free
+        # path never pays a head push.
+        if object_id.binary() not in self._registered_copies:
+            return
+        self._registered_copies.discard(object_id.binary())
+        self._push_to_head("object_loc_del",
+                           {"object_id": object_id.hex(),
+                            "address": self.address})
 
     def _run_loop(self):
         # RT_WORKER_PROFILE=/dir: cProfile THIS thread (the IO loop —
@@ -625,7 +638,7 @@ class CoreWorker:
         else:
             self._server = rpc.RpcServer(self._handle, path=self.sock_path)
             await self._server.start()
-        self._head = await rpc.connect(self.head_sock, self._handle)
+        await self._connect_head()
         if self.listen_tcp and isinstance(self.head_sock, tuple) and \
                 "RT_NODE_IP" not in os.environ:
             # Remote client with no node daemon to export RT_NODE_IP:
@@ -643,6 +656,62 @@ class CoreWorker:
             self._lease_reaper())
         self._gc_sweeper = asyncio.get_running_loop().create_task(
             self._ref_gc_sweeper())
+
+    async def _connect_head(self):
+        self._head = await rpc.connect(self.head_sock, self._handle)
+        self._head.on_close = self._on_head_lost
+
+    def _on_head_lost(self):
+        """The head connection dropped. A crashed head restarts against
+        the same session (same UDS path / TCP port); reconnect within a
+        grace window instead of dying with it (reference: workers
+        reconnect after GCS failover, ``gcs_failover_worker_reconnect_
+        timeout``)."""
+        if self._shutdown:
+            return
+        try:
+            self._loop.create_task(self._reconnect_head())
+        except RuntimeError:
+            pass
+
+    async def _reconnect_head(self):
+        grace = float(os.environ.get("RT_HEAD_RECONNECT_TIMEOUT_S", "30"))
+        deadline = time.time() + grace
+        while not self._shutdown and time.time() < deadline:
+            try:
+                await self._connect_head()
+                if self.mode == "worker":
+                    meta = await self._head.call_simple(
+                        "register_worker", {
+                            "worker_id": self.worker_id.hex(),
+                            "address": self.address,
+                            "node_id": self.node_id,
+                            "pid": os.getpid(),
+                            "hosting_actors": [
+                                ActorID(k).hex()
+                                for k in self._actors_local],
+                        })
+                    stale = meta.get("stale_actors") or ()
+                    if stale and all(
+                            ActorID.from_hex(h).binary() in
+                            self._actors_local for h in stale) and \
+                            len(stale) == len(self._actors_local):
+                        # Every actor we host was restarted elsewhere
+                        # while we were disconnected: this process is a
+                        # zombie — exit rather than run duplicates.
+                        os._exit(0)
+                    for h in stale:
+                        self._actors_local.pop(
+                            ActorID.from_hex(h).binary(), None)
+                for topic in list(self._subscribed_topics):
+                    await self._head.call_simple(
+                        "subscribe", {"topic": topic})
+                return
+            except Exception:  # noqa: BLE001 - head still down
+                await asyncio.sleep(0.5)
+        if self.mode == "worker" and not self._shutdown:
+            # No head within the grace window: this worker is orphaned.
+            os._exit(1)
 
     async def _ref_gc_sweeper(self):
         """Backstop drain for ref-dec events parked while a lock was busy."""
@@ -918,12 +987,19 @@ class CoreWorker:
             if frames is None:
                 frames = self.shm_store.get(ref.object_id)
             if frames is None:
-                # Stored once but gone now (shm/spill lost): rebuild
-                # from lineage before declaring failure.
+                # We own it but never held the bytes (they live in the
+                # producing worker's shm domain) or lost them: fetch
+                # from a registered copy, then fall back to lineage
+                # re-execution.
                 try:
                     frames = self.run_sync(
-                        self._recover_and_load(ref.object_id),
+                        self._fetch_owned_from_copies(ref.object_id),
                         timeout=None if timeout is None else timeout + 1)
+                    if frames is None:
+                        frames = self.run_sync(
+                            self._recover_and_load(ref.object_id),
+                            timeout=None if timeout is None
+                            else timeout + 1)
                 except concurrent.futures.TimeoutError:
                     raise GetTimeoutError(
                         f"timed out recovering {ref}") from None
@@ -952,24 +1028,178 @@ class CoreWorker:
                 if not meta.get("found"):
                     raise ObjectLostError(
                         f"shm segment for {ref} vanished")
-                self.memory_store.put(ref.object_id, bufs)
+                if not meta.get("stored"):
+                    self.memory_store.put(ref.object_id, bufs)
                 return bufs
             return frames
         if not meta.get("found"):
             raise ObjectLostError(f"object {ref} not found at owner")
-        self.memory_store.put(ref.object_id, bufs)
+        if not meta.get("stored"):
+            self.memory_store.put(ref.object_id, bufs)
         return bufs
 
     async def _pull_remote(self, ref: ObjectRef, force_bytes: bool = False):
         conn = await self._get_conn(ref.owner_address)
-        return await conn.call("get_object",
-                               {"object_id": ref.object_id.hex(),
-                                # force_bytes: pretend to be cross-domain
-                                # so the owner ships frames instead of an
-                                # shm attach hint.
-                                "shm_domain": None if force_bytes
-                                else self.shm_domain,
-                                "wait": True})
+        meta, bufs = await conn.call(
+            "get_object",
+            {"object_id": ref.object_id.hex(),
+             # force_bytes: pretend to be cross-domain so the owner
+             # ships frames instead of an shm attach hint.
+             "shm_domain": None if force_bytes else self.shm_domain,
+             "wait": True})
+        if meta.get("chunked"):
+            frames = await self._pull_chunked(ref, meta["frame_sizes"],
+                                              meta.get("sources"))
+            # _pull_chunked stored the copy locally and registered it;
+            # callers must not re-store the frames.
+            return {"found": True, "in_shm": False, "stored": True}, frames
+        return meta, bufs
+
+    async def _pull_chunked(self, ref: ObjectRef, frame_sizes,
+                            source_hint=None):
+        """Stream a big object as pipelined byte-range requests spread
+        over every registered copy (reference: multi-source chunked pull,
+        ``pull_manager.h:52`` + ``ownership_based_object_directory.h``).
+        Stores the result locally and registers this process as a new
+        copy so later pullers fan out further (broadcast becomes a
+        distribution tree under concurrency, not N hits on the owner)."""
+        total = sum(frame_sizes)
+        chunk = self._TRANSFER_CHUNK
+        oid_hex = ref.object_id.hex()
+        # Domain dedup: if a peer in our shm domain is already pulling
+        # this object, wait for its copy and attach instead of moving
+        # the same bytes again.
+        try:
+            claim = await self._head.call_simple(
+                "object_pull_claim",
+                {"object_id": oid_hex, "shm_domain": self.shm_domain,
+                 "address": self.address})
+        except Exception:  # noqa: BLE001 - head unreachable: pull anyway
+            claim = {"granted": True}
+        if not claim.get("granted"):
+            loop = asyncio.get_running_loop()
+            deadline = time.time() + 120.0
+            last_reclaim = time.time()
+            while time.time() < deadline:
+                frames = await loop.run_in_executor(
+                    None, self.shm_store.get, ref.object_id)
+                if frames is not None:
+                    return frames
+                await asyncio.sleep(0.05)
+                if time.time() - last_reclaim > 2.0:
+                    # The claim is released when the claimer registers
+                    # its copy (or dies): re-request periodically so a
+                    # freed claim promotes us without waiting out the
+                    # whole deadline.
+                    last_reclaim = time.time()
+                    try:
+                        claim = await self._head.call_simple(
+                            "object_pull_claim",
+                            {"object_id": oid_hex,
+                             "shm_domain": self.shm_domain,
+                             "address": self.address})
+                        if claim.get("granted"):
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+            else:
+                # Deadline expired: take over regardless.
+                try:
+                    await self._head.call_simple(
+                        "object_pull_claim",
+                        {"object_id": oid_hex,
+                         "shm_domain": self.shm_domain,
+                         "address": self.address, "force": True})
+                except Exception:  # noqa: BLE001
+                    pass
+        sources = []
+        for addr in (source_hint or []):
+            addr = tuple(addr) if isinstance(addr, list) else addr
+            if addr != self.address and addr not in sources:
+                sources.append(addr)
+        if not sources:
+            try:
+                locs = (await self._head.call_simple(
+                    "object_loc_get", {"object_id": oid_hex}))["locations"]
+                for loc in locs:
+                    addr = loc["address"]
+                    addr = tuple(addr) if isinstance(addr, list) else addr
+                    if addr != self.address and addr not in sources:
+                        sources.append(addr)
+            except Exception:  # noqa: BLE001 - directory is advisory
+                pass
+        if not sources:
+            sources = [ref.owner_address]
+        buf = bytearray(total)
+        sem = asyncio.Semaphore(4)  # admission: chunks in flight
+
+        async def fetch(i: int, off: int):
+            length = min(chunk, total - off)
+            payload = {"object_id": oid_hex, "offset": off,
+                       "length": length}
+            last_exc = None
+            # Stripe sources per chunk; then every other copy; the owner
+            # (which may need a lineage re-execution) is the last resort.
+            first = sources[i % len(sources)]
+            order = [first] + [s for s in sources if s != first]
+            if ref.owner_address not in order and \
+                    ref.owner_address != self.address:
+                order.append(ref.owner_address)
+            async with sem:
+                for src in order:
+                    try:
+                        conn = await self._get_conn(src)
+                        m, bufs = await conn.call("object_chunk", payload)
+                        if m.get("found"):
+                            buf[off:off + length] = bufs[0]
+                            return
+                    except Exception as e:  # noqa: BLE001 - try next src
+                        last_exc = e
+            raise ObjectLostError(
+                f"chunk {off}..{off + length} of {ref} unavailable "
+                f"from any copy ({last_exc})")
+
+        await asyncio.gather(*(
+            fetch(i, off)
+            for i, off in enumerate(range(0, total, chunk))))
+        frames, pos = [], 0
+        view = memoryview(buf)
+        for s in frame_sizes:
+            frames.append(view[pos:pos + s])
+            pos += s
+        # The multi-MB store memcpy runs off the IO loop.
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._store_frames, ref.object_id, frames)
+        self._register_object_copy(ref.object_id, frame_sizes)
+        return frames
+
+    def _push_to_head(self, method: str, payload: dict):
+        """Best-effort fire-and-forget push to the head from ANY thread
+        (socket writes only ever happen on the IO loop)."""
+        def _do():
+            try:
+                self._head.push(method, payload)
+            except Exception:  # noqa: BLE001 - advisory traffic
+                pass
+
+        try:
+            if threading.current_thread() is self._io_thread:
+                _do()
+            else:
+                self._loop.call_soon_threadsafe(_do)
+        except RuntimeError:
+            pass
+
+    def _register_object_copy(self, object_id: ObjectID, frame_sizes):
+        """Tell the head we hold a copy (with the frame layout, so the
+        owner can hand pullers a chunk plan for bytes it never held
+        itself)."""
+        self._registered_copies.add(object_id.binary())
+        self._push_to_head("object_loc_add",
+                           {"object_id": object_id.hex(),
+                            "address": self.address,
+                            "shm_domain": self.shm_domain,
+                            "frame_sizes": list(frame_sizes)})
 
     async def _async_get_one(self, ref: ObjectRef):
         """Non-blocking get used by async actors (awaitable refs)."""
@@ -1107,7 +1337,7 @@ class CoreWorker:
         async def _pull():
             try:
                 meta, bufs = await self._pull_remote(ref)
-                if meta.get("found"):
+                if meta.get("found") and not meta.get("stored"):
                     if meta.get("in_shm"):
                         frames = self.shm_store.get(ref.object_id)
                         if frames is not None:
@@ -1368,7 +1598,13 @@ class CoreWorker:
             await asyncio.wait_for(asyncio.shield(fut), timeout)
         except asyncio.TimeoutError:
             return None
-        return self._load_frames(oid)
+        frames = self._load_frames(oid)
+        if frames is None:
+            # The re-executed task ran on another node: its result is a
+            # marker here, bytes in the executing worker's domain —
+            # fetch them through the copy directory.
+            frames = await self._fetch_owned_from_copies(oid)
+        return frames
 
     async def _run_recovery(self, spec: TaskSpec, fut):
         try:
@@ -1661,6 +1897,7 @@ class CoreWorker:
             try:
                 await self._head.call_simple(
                     "subscribe", {"topic": f"actor:{actor_id.hex()}"})
+                self._subscribed_topics.add(f"actor:{actor_id.hex()}")
                 # Synchronous registration (reference: RegisterActor is a
                 # blocking GCS call, gcs_actor_manager.cc:311) so named
                 # actors and list_actors see the actor as soon as
@@ -1732,6 +1969,7 @@ class CoreWorker:
             async def _sub():
                 await self._head.call_simple(
                     "subscribe", {"topic": f"actor:{actor_id.hex()}"})
+                self._subscribed_topics.add(f"actor:{actor_id.hex()}")
             asyncio.run_coroutine_threadsafe(_sub(), self._loop)
         st["event"].wait(timeout)
         if st["state"] == "DEAD":
@@ -2090,6 +2328,8 @@ class CoreWorker:
             return await self._exec_push_task_packed(payload, conn)
         if method == "get_object":
             return await self._exec_get_object(payload)
+        if method == "object_chunk":
+            return await self._exec_object_chunk(payload)
         if method == "ref_inc":
             self.refs.on_borrow_change(
                 ObjectID.from_hex(payload["object_id"]), +1)
@@ -2154,6 +2394,7 @@ class CoreWorker:
 
     def subscribe(self, topic: str, handler):
         self._pubsub_handlers[topic].append(handler)
+        self._subscribed_topics.add(topic)
         self.run_sync(self._head.call_simple("subscribe", {"topic": topic}), 30)
 
     def publish(self, topic: str, msg):
@@ -2179,19 +2420,107 @@ class CoreWorker:
                     return {"found": True, "in_shm": True}
                 frames = self.shm_store.get(oid)
                 if frames is None:
+                    # The bytes live on the producing/pulling workers,
+                    # not here (we only hold the marker): hand the
+                    # puller the copy directory instead of proxying.
+                    hint = await self._locate_copies(
+                        oid, payload.get("shm_domain"))
+                    if hint is not None:
+                        return hint
                     frames = await self._recover_and_load(oid)
                 if frames is None:
                     return {"found": False}
-                return ({"found": True, "in_shm": False},
-                        [bytes(f) for f in frames])
-            # Not stored here (any more): lineage recovery is the last
-            # resort before the puller sees ObjectLostError.
+                return self._whole_or_chunk_hint(frames)
+            # Not stored here (any more): another copy, then lineage
+            # recovery, are the last resorts before ObjectLostError.
+            hint = await self._locate_copies(oid, payload.get("shm_domain"))
+            if hint is not None:
+                return hint
             frames = await self._recover_and_load(oid)
             if frames is None:
                 return {"found": False}
+            return self._whole_or_chunk_hint(frames)
+        return self._whole_or_chunk_hint(frames)
+
+    async def _fetch_owned_from_copies(self, oid: ObjectID):
+        """Owner-side byte fetch for an object whose frames live only on
+        other workers (marker-only ownership): attach if a copy shares
+        our domain, else chunk-pull and keep a local copy."""
+        hint = await self._locate_copies(oid, self.shm_domain)
+        if hint is None:
+            return None
+        if hint.get("in_shm"):
+            return self.shm_store.get(oid)
+        ref = ObjectRef(oid, self.address, _counted=False)
+        try:
+            return await self._pull_chunked(
+                ref, hint["frame_sizes"], hint.get("sources"))
+        except ObjectLostError:
+            return None
+
+    async def _locate_copies(self, oid: ObjectID, puller_domain):
+        """Build a redirect hint from the head's object directory: an
+        shm-attach hint when a copy already sits in the puller's domain,
+        else a chunk plan whose sources are every live copy."""
+        try:
+            locs = (await self._head.call_simple(
+                "object_loc_get", {"object_id": oid.hex()}))["locations"]
+        except Exception:  # noqa: BLE001 - directory is advisory
+            return None
+        locs = [l for l in locs if l.get("frame_sizes")]
+        if not locs:
+            return None
+        if puller_domain is not None and any(
+                l["domain"] == puller_domain for l in locs):
+            return {"found": True, "in_shm": True}
+        return {"found": True, "chunked": True,
+                "frame_sizes": locs[0]["frame_sizes"],
+                "sources": [l["address"] for l in locs]}
+
+    _TRANSFER_CHUNK = int(os.environ.get("RT_TRANSFER_CHUNK_BYTES", 0)) \
+        or 64 * 1024 * 1024
+
+    def _whole_or_chunk_hint(self, frames):
+        """Small objects ship inline in the get_object reply; big ones
+        answer with a chunk plan (frame sizes) so the puller streams
+        ``object_chunk`` requests — possibly from several copies — and
+        a multi-GB frame never materializes in one RPC write (reference:
+        64MiB chunked pull, ``object_manager/pull_manager.h:52``,
+        ``object_buffer_pool.h``)."""
+        sizes = [len(f) for f in frames]
+        if sum(sizes) <= self._TRANSFER_CHUNK:
             return ({"found": True, "in_shm": False},
                     [bytes(f) for f in frames])
-        return {"found": True, "in_shm": False}, [bytes(f) for f in frames]
+        return {"found": True, "chunked": True, "frame_sizes": sizes}
+
+    async def _exec_object_chunk(self, payload):
+        """Serve one byte range of an object's concatenated frames. The
+        slicing memcpy runs off the IO loop so a 64MiB chunk cannot
+        stall unrelated RPC traffic."""
+        oid = ObjectID.from_hex(payload["object_id"])
+        frames = self._load_frames(oid)
+        if frames is None:
+            frames = await self._recover_and_load(oid)
+        if frames is None:
+            return {"found": False}
+        off, length = payload["offset"], payload["length"]
+
+        def cut() -> bytes:
+            out = bytearray()
+            pos = 0
+            for f in frames:
+                if len(out) >= length:
+                    break
+                f_end = pos + len(f)
+                if f_end > off:
+                    lo = max(0, off - pos)
+                    hi = min(len(f), off + length - pos)
+                    out += memoryview(f)[lo:hi]
+                pos = f_end
+            return bytes(out)
+
+        buf = await asyncio.get_running_loop().run_in_executor(None, cut)
+        return {"found": True}, [buf]
 
     def _deserialize_args(self, ser_args, kwargs_keys):
         vals = []
@@ -2544,6 +2873,9 @@ class CoreWorker:
                 out_bufs.extend(bytes(f) for f in frames)
             if ent["where"] == "shm":
                 self.shm_store.create(oid, frames)
+                # Announce this copy so location-aware pulls can read it
+                # from here (not just via the owner).
+                self._register_object_copy(oid, [len(f) for f in frames])
             returns_meta.append(ent)
         return returns_meta, out_bufs
 

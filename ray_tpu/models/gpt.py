@@ -260,6 +260,62 @@ def _block(x, layer_params, cfg: GPTConfig, mesh=None):
     return x, jnp.zeros((), jnp.float32)
 
 
+def _block_pp_tp(x, p, cfg: GPTConfig, tp_axis: str, tp_size: int):
+    """Transformer block for a pipeline stage with Megatron-style tensor
+    parallelism done by hand: qkv/up are column-parallel (each tp rank
+    computes n_head/tp heads and d_ff/tp hidden units), out/down are
+    row-parallel with a psum over tp. Runs per-device inside
+    pipeline_apply's shard_map, so these collectives cannot come from
+    GSPMD."""
+    B, S, d = x.shape
+    hd = cfg.head_dim
+    h_local = cfg.n_head // tp_size
+    p_ = p
+    h = _rmsnorm(x, p_["ln1_scale"])
+    q = _mm(h, p_["wq"]["kernel"], cfg.dtype).reshape(B, S, h_local, hd)
+    k = _mm(h, p_["wk"]["kernel"], cfg.dtype).reshape(B, S, h_local, hd)
+    v = _mm(h, p_["wv"]["kernel"], cfg.dtype).reshape(B, S, h_local, hd)
+    if _resolve_attn_backend(cfg, S) == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        att = flash_attention(q, k, v, causal=True)
+    else:
+        att = _attention_xla(q, k, v, cfg)
+    att = att.reshape(B, S, h_local * hd)
+    o = _mm(att, p_["wo"]["kernel"], cfg.dtype)
+    if tp_size > 1:
+        o = lax.psum(o, tp_axis)
+    x = x + o
+    h = _rmsnorm(x, p_["ln2_scale"])
+    h = jax.nn.gelu(_mm(h, p_["w1"]["kernel"], cfg.dtype))
+    y = _mm(h, p_["w2"]["kernel"], cfg.dtype)
+    if tp_size > 1:
+        y = lax.psum(y, tp_axis)
+    return x + y
+
+
+def _pp_tp_param_specs(block_params, pp_axis: str, tp_axis: str):
+    """PartitionSpecs for a pipeline stage's stacked params under pp x
+    tp: layer dim over pp; column weights (wq/wk/wv/w1) shard their
+    output dim over tp, row weights (wo/w2) their input dim."""
+    from jax.sharding import PartitionSpec as P
+
+    col = {"wq", "wk", "wv", "w1"}
+    row = {"wo", "w2"}
+
+    def spec(path, leaf):
+        keys = {getattr(k, "key", getattr(k, "name", None)) for k in path}
+        if keys & col:
+            return P(pp_axis, *([None] * (leaf.ndim - 2)), tp_axis)
+        if keys & row:
+            return P(pp_axis, tp_axis, *([None] * (leaf.ndim - 2)))
+        return P(pp_axis, *([None] * (leaf.ndim - 1)))
+
+    import jax.tree_util as jtu
+
+    return jtu.tree_map_with_path(spec, block_params)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
             mesh=None, *, return_aux: bool = False,
             final_hidden: bool = False):
@@ -299,12 +355,26 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
                 "{ep, dp} mesh for expert parallelism")
         from ray_tpu.parallel.pipeline import pipeline_apply
 
-        # Inside the pipeline body each stage runs single-device math
-        # (mesh=None): GSPMD does not reach under the shard_map.
-        x = pipeline_apply(
-            lambda act, lp: block_fn(act, lp, cfg, None)[0],
-            params["block"], x, mesh=mesh, pp_axis=cfg.pp_axis,
-            num_microbatches=cfg.num_microbatches)
+        tp_ax = "tp" if "tp" in mesh.axis_names else None
+        if tp_ax is not None:
+            tp_size = mesh.shape[tp_ax]
+            if cfg.n_head % tp_size or cfg.d_ff % tp_size:
+                raise ValueError(
+                    f"n_head={cfg.n_head} / d_ff={cfg.d_ff} not divisible "
+                    f"by tp={tp_size}")
+            x = pipeline_apply(
+                lambda act, lp: _block_pp_tp(act, lp, cfg, tp_ax, tp_size),
+                params["block"], x, mesh=mesh, pp_axis=cfg.pp_axis,
+                num_microbatches=cfg.num_microbatches, tp_axis=tp_ax,
+                param_specs=_pp_tp_param_specs(params["block"],
+                                               cfg.pp_axis, tp_ax))
+        else:
+            # Inside the pipeline body each stage runs single-device math
+            # (mesh=None): GSPMD does not reach under the shard_map.
+            x = pipeline_apply(
+                lambda act, lp: block_fn(act, lp, cfg, None)[0],
+                params["block"], x, mesh=mesh, pp_axis=cfg.pp_axis,
+                num_microbatches=cfg.num_microbatches)
     else:
         def scan_body(carry, layer_params):
             out, a = block_fn(carry, layer_params, cfg, mesh)

@@ -155,6 +155,8 @@ def generate(params: Params, prompt: jax.Array, cfg: GPTConfig,
         raise ValueError(
             f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"cache length {max_len}")
+    if temperature > 0.0 and rng is None:
+        rng = jax.random.PRNGKey(0)
     cache = init_cache(cfg, B, max_len)
     pf = jax.jit(prefill, static_argnums=(2,))
     step = jax.jit(decode_step, static_argnums=(3,))

@@ -38,7 +38,8 @@ def _stage_spec(leaf, pp_axis: str):
 
 def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
                    *, mesh, pp_axis: str = "pp",
-                   num_microbatches: int = 0) -> jax.Array:
+                   num_microbatches: int = 0, tp_axis: str = None,
+                   param_specs: Any = None) -> jax.Array:
     """Run ``x`` through L stacked layers pipelined over the pp axis.
 
     ``block_fn(act, layer_params) -> act`` is one transformer block;
@@ -46,24 +47,36 @@ def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
     L % pp == 0 (stage s owns layers [s*L/P, (s+1)*L/P)).
     ``x`` is [B, S, d] with the batch dim (optionally) sharded over
     dp/fsdp; it must NOT be sharded over pp.
+
+    Tensor parallelism inside a stage (pp x tp): pass ``tp_axis`` plus
+    ``param_specs`` (a pytree of PartitionSpecs sharding each leaf over
+    pp AND the tp dims) and a ``block_fn`` that performs its own tp
+    collectives (Megatron-style: column-parallel qkv/up, row-parallel
+    out/down with a psum over ``tp_axis`` after each row matmul) — the
+    whole body runs per-device under shard_map, so GSPMD cannot insert
+    them. Activations stay replicated over tp.
     """
     from jax.sharding import PartitionSpec as P
 
     names = set(mesh.axis_names)
     if pp_axis not in names:
         raise ValueError(f"mesh has no {pp_axis!r} axis: {mesh.axis_names}")
-    for bad in ("tp", "sp"):
-        if bad in names:
-            raise ValueError(
-                f"pipeline_apply does not compose with {bad!r} yet; use a "
-                "{dp, fsdp, pp} mesh")
+    if "sp" in names:
+        raise ValueError(
+            "pipeline_apply does not compose with 'sp' yet; use a "
+            "{dp, fsdp, tp, pp} mesh")
+    if "tp" in names and tp_axis is None:
+        raise ValueError(
+            "mesh has a tp axis: pass tp_axis= and param_specs= with a "
+            "tp-aware block_fn (see gpt.forward's pp branch)")
     pp_size = mesh.shape[pp_axis]
     num_mb = num_microbatches or 2 * pp_size
 
     bt = tuple(a for a in ("dp", "fsdp") if a in names) or None
     x_spec = P(bt, None, None)
-    param_specs = jax.tree.map(lambda l: _stage_spec(l, pp_axis),
-                               stacked_params)
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda l: _stage_spec(l, pp_axis),
+                                   stacked_params)
 
     def body(params_local, x_local):
         P_ = pp_size  # static: mesh shape is known at trace time

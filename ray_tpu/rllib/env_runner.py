@@ -182,7 +182,13 @@ class EnvRunnerGroup:
         if self.local:
             self.local.set_weights(weights)
         if self.remote:
-            rt.get([r.set_weights.remote(weights) for r in self.remote],
+            # Object-store broadcast: one put, N ref-args — each runner
+            # pulls the single copy (same-host runners attach the shm
+            # segment; cross-node pulls stripe chunks over every copy as
+            # they appear) instead of N serialized payloads through the
+            # caller (reference: weight broadcast via plasma).
+            wref = rt.put(weights)
+            rt.get([r.set_weights.remote(wref) for r in self.remote],
                    timeout=60)
 
     def sample(self) -> List[SampleBatch]:

@@ -24,7 +24,7 @@ def _shm_delete(oid):
     the backing file so every future attach fails, then drop any local
     index entry."""
     try:
-        os.unlink(f"/dev/shm/rt_{oid.hex()[:30]}")
+        os.unlink(f"/dev/shm/{_core().shm_store._name(oid)}")
     except FileNotFoundError:
         pass
     _core().shm_store.delete(oid)

@@ -56,14 +56,55 @@ def test_pipeline_train_step(nano4, cpu_mesh_devices):
     assert losses[-1] < losses[0]  # pipeline gradients actually descend
 
 
-def test_pipeline_rejects_tp_mesh(nano4):
+def test_pipeline_requires_tp_aware_block(nano4):
+    """A tp mesh without tp_axis/param_specs is an error, not silent
+    wrong math (the plain block has no tp collectives)."""
     mesh = create_mesh({"tp": 2, "pp": 4})
-    cfg_pp = dataclasses.replace(nano4, pp_axis="pp")
     from ray_tpu.parallel.pipeline import pipeline_apply
 
-    with pytest.raises(ValueError, match="compose"):
+    with pytest.raises(ValueError, match="tp"):
         pipeline_apply(lambda a, p: a, {}, jnp.zeros((4, 8, 16)),
                        mesh=mesh)
+
+
+def test_pipeline_rejects_sp_mesh(nano4):
+    mesh = create_mesh({"sp": 2, "pp": 4})
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    with pytest.raises(ValueError, match="sp"):
+        pipeline_apply(lambda a, p: a, {}, jnp.zeros((4, 8, 16)),
+                       mesh=mesh)
+
+
+def test_pipeline_tp_forward_parity(nano4, cpu_mesh_devices):
+    """pp x tp (Megatron-in-stage) matches the single-device forward."""
+    mesh = create_mesh({"dp": 2, "pp": 2, "tp": 2})
+    cfg_pt = dataclasses.replace(nano4, pp_axis="pp", num_microbatches=2)
+    params = gpt.init_params(jax.random.PRNGKey(0), nano4)
+    tokens = jnp.asarray(
+        np.random.randint(0, nano4.vocab_size, (8, 16), np.int32))
+
+    ref = gpt.forward(params, tokens, nano4)
+    out = jax.jit(lambda p, t: gpt.forward(p, t, cfg_pt, mesh))(
+        params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_pipeline_tp_train_step(nano4, cpu_mesh_devices):
+    mesh = create_mesh({"dp": 2, "pp": 2, "tp": 2})
+    cfg_pt = dataclasses.replace(nano4, pp_axis="pp", num_microbatches=2)
+    init, step, _, batch_sh = gpt.make_train_step(cfg_pt, mesh)
+    state = init(jax.random.PRNGKey(0))
+    batch = {"tokens": jax.device_put(
+        np.random.randint(0, cfg_pt.vocab_size, (8, 17), np.int32),
+        batch_sh)}
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
 
 
 def test_moe_forward_parity(nano4, cpu_mesh_devices):
