@@ -807,8 +807,15 @@ class HeadService:
         stale = []
         for ahex in payload.get("hosting_actors") or ():
             a = self.actors.get(ActorID.from_hex(ahex))
-            if a is not None and a.state in ("RESTARTING", "PENDING") \
-                    and not a.restart_inflight:
+            can_attach = a is not None and not a.restart_inflight and (
+                a.state in ("RESTARTING", "PENDING")
+                # Asymmetric disconnect: the head never saw a failure
+                # (actor still ALIVE, recorded at this same worker
+                # address) — the SAME healthy process re-registering
+                # must reattach, not be told it is stale.
+                or (a.state == "ALIVE" and a.worker is not None
+                    and a.worker.address == address))
+            if can_attach:
                 a.state = "ALIVE"
                 a.worker = info
                 a.death_cause = ""

@@ -100,7 +100,7 @@ class NodeService:
                 return
 
     async def _reconnect_head(self) -> bool:
-        grace = float(os.environ.get("RT_HEAD_RECONNECT_TIMEOUT_S", "30"))
+        grace = float(os.environ.get("RT_HEAD_RECONNECT_TIMEOUT_S", "60"))
         deadline = time.time() + grace
         while not self._stopping and time.time() < deadline:
             try:

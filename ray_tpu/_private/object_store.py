@@ -29,10 +29,6 @@ from .ids import ObjectID
 from .serialization import pack_frames, unpack_frames
 
 
-def _shm_name(object_id: ObjectID) -> str:
-    return "rt_" + object_id.hex()[:30]
-
-
 def _open_shm(name: str, create: bool = False, size: int = 0):
     """Open a shm segment WITHOUT resource-tracker registration.
 
@@ -213,17 +209,22 @@ class SharedMemoryStore:
         return frames
 
     def get(self, object_id: ObjectID) -> Optional[List[memoryview]]:
+        # Read-only views (reference: plasma buffers are immutable):
+        # deserialized numpy/jax arrays alias the segment zero-copy, so
+        # a writable view would let user code corrupt the stored value
+        # for every other reader.
         with self._lock:
             ent = self._owned.get(object_id)
             if ent is not None:
                 shm, n, path = ent
                 if shm is not None:
-                    return unpack_frames(shm.buf[:n])
+                    return unpack_frames(
+                        memoryview(shm.buf)[:n].toreadonly())
                 with open(path, "rb") as f:  # spilled
                     return unpack_frames(f.read())
             if object_id in self._attached:
                 shm = self._attached[object_id]
-                return self._safe_unpack(shm.buf)
+                return self._safe_unpack(memoryview(shm.buf).toreadonly())
         # Attach to a segment owned by another process on this host.
         try:
             shm = _open_shm(self._name(object_id))
@@ -231,7 +232,7 @@ class SharedMemoryStore:
             return None
         with self._lock:
             self._attached[object_id] = shm
-        return self._safe_unpack(shm.buf)
+        return self._safe_unpack(memoryview(shm.buf).toreadonly())
 
     def contains(self, object_id: ObjectID) -> bool:
         if object_id in self._owned or object_id in self._attached:

@@ -84,6 +84,7 @@ class Connection:
         self._send_q: "deque" = deque()
         self._send_wake: Optional[asyncio.Event] = None
         self._send_task: Optional[asyncio.Task] = None
+        self._send_busy = False  # writer mid-message (cancel = truncation)
         self.on_close: Optional[Callable[[], None]] = None
         try:
             writer.transport.set_write_buffer_limits(
@@ -112,6 +113,7 @@ class Connection:
                     self._send_wake.clear()
                     await self._send_wake.wait()
                 frames = self._send_q.popleft()
+                self._send_busy = True
                 views = []
                 for f in frames:
                     v = memoryview(f)
@@ -128,6 +130,7 @@ class Connection:
                             await self._writer.drain()
                 if tr.get_write_buffer_size() > self._WRITE_HIGH:
                     await self._writer.drain()
+                self._send_busy = False
         except asyncio.CancelledError:
             raise
         except (ConnectionResetError, OSError):
@@ -232,10 +235,12 @@ class Connection:
         self._closed = True
         # Flush BEFORE cancelling the recv task: its finally-block
         # cancels the writer, which would drop queued replies (the peer
-        # would see ConnectionLost instead of its result).
-        if self._send_task and self._send_q:
-            for _ in range(50):
-                if not self._send_q:
+        # would see ConnectionLost instead of its result). Wait for the
+        # in-flight message too — cancelling mid-message truncates a
+        # frame on the wire, corrupting everything already flushed.
+        if self._send_task and (self._send_q or self._send_busy):
+            for _ in range(200):
+                if not self._send_q and not self._send_busy:
                     break
                 await asyncio.sleep(0.01)
         if self._recv_task:

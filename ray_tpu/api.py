@@ -355,11 +355,12 @@ class ActorMethod:
     def options(self, num_returns: int = 1):
         return ActorMethod(self._handle, self._name, num_returns)
 
-    def bind(self, upstream):
-        """Build a compiled-DAG node (see :mod:`ray_tpu.dag`)."""
+    def bind(self, *upstreams):
+        """Build a compiled-DAG node (see :mod:`ray_tpu.dag`);
+        ``bind(a, b)`` joins one item from each upstream per call."""
         from .dag import MethodNode
 
-        return MethodNode(self._handle, self._name, upstream)
+        return MethodNode(self._handle, self._name, *upstreams)
 
     def remote(self, *args, **kwargs):
         core = _core()

@@ -519,6 +519,10 @@ class CoreWorker:
         self._lineage_freed: set = set()
         self._recoveries: Dict[bytes, Any] = {}
         self._registered_copies: set = set()
+        # TCP channel endpoints (see chan_write/chan_read).
+        self._chan_lock = threading.Lock()
+        self._chan_in: Dict[str, dict] = {}
+        self._chan_out: Dict[str, dict] = {}
         self._actor_gc_enabled = (
             os.environ.get("RT_DISABLE_ACTOR_GC", "") != "1")
 
@@ -675,7 +679,7 @@ class CoreWorker:
             pass
 
     async def _reconnect_head(self):
-        grace = float(os.environ.get("RT_HEAD_RECONNECT_TIMEOUT_S", "30"))
+        grace = float(os.environ.get("RT_HEAD_RECONNECT_TIMEOUT_S", "60"))
         deadline = time.time() + grace
         while not self._shutdown and time.time() < deadline:
             try:
@@ -927,7 +931,13 @@ class CoreWorker:
             self.shm_store.create(object_id, frames)
             self.memory_store.put(object_id, None)  # marker: lives in shm
         else:
-            self.memory_store.put(object_id, frames)
+            # Snapshot to bytes: zero-copy serialization leaves raw
+            # frames ALIASING the caller's arrays — storing the views
+            # would let the putter (or a getter, via the shared buffer)
+            # mutate the stored value. bytes() also makes every later
+            # zero-copy deserialize read-only, matching the shm tier.
+            self.memory_store.put(object_id, [
+                f if isinstance(f, bytes) else bytes(f) for f in frames])
 
     def _load_frames(self, object_id: ObjectID) -> Optional[List[bytes]]:
         frames = self.memory_store.get(object_id, timeout=0)
@@ -2330,6 +2340,39 @@ class CoreWorker:
             return await self._exec_get_object(payload)
         if method == "object_chunk":
             return await self._exec_object_chunk(payload)
+        if method == "chan_item":
+            st = self._chan_in_state(payload["name"])
+            writer = payload["writer"]
+            if isinstance(writer, list):
+                writer = tuple(writer)
+            st["writer"] = writer
+            st["items"].append((payload["seq"], writer, bufs[0]))
+            st["event"].set()
+            return {}
+        if method == "chan_ack":
+            st = self._chan_out_state(payload["name"])
+            st["acks"][payload["reader"]] = max(
+                st["acks"].get(payload["reader"], 0), payload["seq"])
+            st["event"].set()
+            return {}
+        if method == "chan_close":
+            st_in = self._chan_in.get(payload["name"])
+            for reg in (self._chan_in, self._chan_out):
+                st = reg.get(payload["name"])
+                if st is not None:
+                    st["closed"] = True
+                    st["event"].set()
+            # Forward once to the writer we have seen (the closer only
+            # knows reader addresses): a producer blocked in chan_write
+            # waiting for acks must observe the close, not a 30s
+            # timeout.
+            if st_in is not None and not payload.get("fwd"):
+                writer = st_in.get("writer")
+                if writer is not None and writer != self.address:
+                    self._push_to_addr(writer, "chan_close",
+                                       {"name": payload["name"],
+                                        "fwd": True})
+            return {}
         if method == "ref_inc":
             self.refs.on_borrow_change(
                 ObjectID.from_hex(payload["object_id"]), +1)
@@ -3042,24 +3085,37 @@ class CoreWorker:
             self._exec_pool, lambda: self._package_returns(meta, values))
 
     async def _run_channel_drive(self, instance, meta, loop):
-        """Execute a compiled-DAG drive loop on this actor's executor."""
+        """Execute a compiled-DAG drive loop on this actor's executor.
+
+        Multi-arg form: one value is read from EACH input channel per
+        iteration (fan-in joins on item index — GPipe-style lockstep),
+        the method is called with them positionally, and the result is
+        written to the output channel."""
         args, _ = self._deserialize_args(meta["args"], meta["kwargs_keys"])
-        method_name, in_ch, out_ch = args
+        if len(args) == 3:  # legacy single-input shape
+            method_name, in_ch, out_ch = args
+            in_chs, reader_idxs = [in_ch], [0]
+        else:
+            method_name, in_chs, reader_idxs, out_ch = args
         fn = getattr(instance, method_name)
 
         def drive():
             from ray_tpu.experimental.channel import ChannelClosed
 
             while True:
+                values = []
                 try:
-                    value = in_ch.read(0, timeout=3600.0)
+                    for ch, ridx in zip(in_chs, reader_idxs):
+                        values.append(ch.read(ridx, timeout=3600.0))
                 except ChannelClosed:
                     return "closed"
-                if isinstance(value, TaskError):
-                    out = value  # upstream failure passes through intact
+                err = next((v for v in values
+                            if isinstance(v, TaskError)), None)
+                if err is not None:
+                    out = err  # upstream failure passes through intact
                 else:
                     try:
-                        out = fn(value)
+                        out = fn(*values)
                     except Exception as e:  # noqa: BLE001 - ship downstream
                         out = TaskError(type(e).__name__, str(e),
                                         traceback.format_exc())
@@ -3071,6 +3127,92 @@ class CoreWorker:
         ex = self._actor_executors[meta["actor_id"]]
         result = await loop.run_in_executor(ex, drive)
         return self._package_returns(meta, [result])
+
+    # -------------------------------------------------- TCP channels
+    # Cross-domain mutable-object channels (experimental/channel.py
+    # TcpChannel): items push writer→readers, acks push back. State is
+    # per-process; any thread may call write/read (pushes marshal onto
+    # the IO loop).
+
+    def _chan_in_state(self, name: str):
+        with self._chan_lock:
+            return self._chan_in.setdefault(
+                name, {"items": deque(), "event": threading.Event(),
+                       "closed": False})
+
+    def _chan_out_state(self, name: str):
+        with self._chan_lock:
+            return self._chan_out.setdefault(
+                name, {"acks": {}, "event": threading.Event(),
+                       "seq": 0, "closed": False})
+
+    def _push_to_addr(self, addr, method: str, payload, bufs=()):
+        """Best-effort fire-and-forget push to any peer address."""
+        async def _do():
+            try:
+                conn = await self._get_conn(addr)
+                conn.push(method, payload, list(bufs))
+            except Exception:  # noqa: BLE001 - peer gone
+                pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(_do(), self._loop)
+        except RuntimeError:
+            pass
+
+    def chan_write(self, chan, value, timeout: float = 30.0):
+        import pickle as _pickle
+
+        from ..experimental.channel import ChannelClosed
+
+        st = self._chan_out_state(chan.name)
+        seq = st["seq"]
+        deadline = time.time() + timeout
+        while any(st["acks"].get(i, 0) < seq
+                  for i in range(chan.num_readers)):
+            if st["closed"]:
+                raise ChannelClosed
+            if time.time() > deadline:
+                raise TimeoutError("channel readers lagging")
+            st["event"].wait(0.05)
+            st["event"].clear()
+        blob = _pickle.dumps(value, protocol=5)
+        for i, addr in enumerate(chan.reader_addresses):
+            self._push_to_addr(addr, "chan_item",
+                               {"name": chan.name, "seq": seq + 1,
+                                "writer": self.address}, [blob])
+        st["seq"] = seq + 1
+
+    def chan_read(self, name: str, reader_idx: int,
+                  timeout: float = 30.0):
+        import pickle as _pickle
+
+        from ..experimental.channel import ChannelClosed
+
+        st = self._chan_in_state(name)
+        deadline = time.time() + timeout
+        while not st["items"]:
+            if st["closed"]:
+                raise ChannelClosed
+            if time.time() > deadline:
+                raise TimeoutError("channel writer idle")
+            st["event"].wait(0.05)
+            st["event"].clear()
+        seq, writer, blob = st["items"].popleft()
+        value = _pickle.loads(blob)
+        self._push_to_addr(writer, "chan_ack",
+                           {"name": name, "reader": reader_idx,
+                            "seq": seq})
+        return value
+
+    def chan_close(self, chan):
+        for addr in chan.reader_addresses:
+            self._push_to_addr(addr, "chan_close", {"name": chan.name})
+        for reg in (self._chan_in, self._chan_out):
+            st = reg.get(chan.name)
+            if st is not None:
+                st["closed"] = True
+                st["event"].set()
 
     # ------------------------------------------------------------- misc
     def head_call(self, method: str, payload=None, timeout=30.0):

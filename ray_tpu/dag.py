@@ -6,22 +6,27 @@ actor method calls, ``experimental_compile`` allocates channels and
 pins a long-running execution loop on each actor so per-call RPC and
 object-store traffic disappear from the steady state).
 
-Here: ``actor.method.bind(upstream)`` builds MethodNodes off an
-``InputNode``; ``compile()`` creates one shm Channel per edge and starts
-a drive loop on each actor (a special ``__rt_drive__`` actor task the
-worker runtime interprets: read input channel → call method → write
-output channel). ``execute(x)`` writes the input channel and reads the
-terminal channel — one shm write and one shm read per call.
+Here: ``actor.method.bind(*upstreams)`` builds MethodNodes off an
+``InputNode``; ``compile()`` allocates one channel per producer edge and
+starts a drive loop on each actor (a special ``__rt_drive__`` actor task
+the worker runtime interprets: read one value from each input channel →
+call the method → write the output channel). ``execute(x)`` writes the
+input channel and reads the terminal channel(s).
 
-Current scope: linear chains of single-reader edges (the common
-inference-pipeline shape); fan-out/fan-in composition can extend the
-edge allocation without changing the channel protocol.
+Topology support: linear chains, fan-out (one producer, many
+consumers — a multi-reader channel), fan-in / multi-arg nodes
+(``bind(a, b)`` joins one item from each upstream per call), and
+``MultiOutputNode`` for multiple terminals. Edges whose endpoints sit
+in different shm domains (different hosts) automatically use the
+TCP-pushed channel instead of the shm slot (reference:
+``node_manager.proto:430-432``).
 """
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-from .experimental.channel import Channel, ChannelClosed  # noqa: F401
+from .experimental.channel import (Channel, ChannelClosed,  # noqa: F401
+                                   TcpChannel)
 
 
 class InputNode:
@@ -35,74 +40,185 @@ class InputNode:
 
 
 class MethodNode:
-    def __init__(self, handle, method_name: str, upstream):
+    def __init__(self, handle, method_name: str, *upstreams):
         self.handle = handle
         self.method_name = method_name
-        self.upstream = upstream
+        self.upstreams: Tuple[Any, ...] = upstreams
+        if not upstreams:
+            raise ValueError("a MethodNode needs at least one upstream")
 
-    def bind_chain(self) -> List["MethodNode"]:
-        chain: List[MethodNode] = []
-        node: Any = self
-        while isinstance(node, MethodNode):
-            chain.append(node)
-            node = node.upstream
-        if not isinstance(node, InputNode):
-            raise ValueError("compiled DAG chain must end at an InputNode")
-        return list(reversed(chain))
+    # Back-compat alias: old code reads .upstream on linear chains.
+    @property
+    def upstream(self):
+        return self.upstreams[0]
 
     def experimental_compile(self, *, capacity_bytes: int = 1 << 20,
                              timeout: float = 30.0) -> "CompiledDAG":
-        return CompiledDAG(self.bind_chain(), capacity_bytes, timeout)
+        return CompiledDAG([self], capacity_bytes, timeout)
 
 
-def bind(actor_method, upstream) -> MethodNode:
-    """``bind(actor.method, upstream_node)`` — functional form."""
-    return MethodNode(actor_method._handle, actor_method._name, upstream)
+class MultiOutputNode:
+    """Explicit multi-terminal wrapper: ``execute`` returns one value
+    per listed node (reference: ``ray.dag.MultiOutputNode``)."""
+
+    def __init__(self, nodes: List[MethodNode]):
+        self.nodes = list(nodes)
+
+    def experimental_compile(self, *, capacity_bytes: int = 1 << 20,
+                             timeout: float = 30.0) -> "CompiledDAG":
+        return CompiledDAG(self.nodes, capacity_bytes, timeout)
+
+
+def bind(actor_method, *upstreams) -> MethodNode:
+    """``bind(actor.method, up1, up2, ...)`` — functional form."""
+    return MethodNode(actor_method._handle, actor_method._name, *upstreams)
 
 
 class CompiledDAG:
-    def __init__(self, chain: List[MethodNode], capacity_bytes: int,
+    def __init__(self, terminals: List[MethodNode], capacity_bytes: int,
                  timeout: float):
         import ray_tpu as rt
+        from ray_tpu.core.worker import CoreWorker
 
         self._rt = rt
         self._timeout = timeout
-        # one channel per edge: input → a1 → a2 → ... → output
-        self._channels = [Channel(capacity_bytes, num_readers=1)
-                          for _ in range(len(chain) + 1)]
+        core = CoreWorker.current()
+
+        # ---- topology: topological order via post-order DFS ----------
+        nodes: List[MethodNode] = []
+        seen: Dict[int, bool] = {}
+
+        def visit(n):
+            if isinstance(n, InputNode) or id(n) in seen:
+                return
+            seen[id(n)] = True
+            for u in n.upstreams:
+                visit(u)
+            nodes.append(n)
+
+        for t in terminals:
+            visit(t)
+
+        # consumers[producer] = [(consumer_node | "driver", arg_pos)]
+        consumers: Dict[int, List[tuple]] = {}
+        producers: Dict[int, Any] = {}  # id -> node (or InputNode)
+        self._input_node: Optional[InputNode] = None
+        for n in nodes:
+            for pos, u in enumerate(n.upstreams):
+                if isinstance(u, InputNode):
+                    self._input_node = u
+                producers[id(u)] = u
+                consumers.setdefault(id(u), []).append((n, pos))
+        for t in terminals:
+            producers[id(t)] = t
+            consumers.setdefault(id(t), []).append(("driver", 0))
+        if self._input_node is None:
+            raise ValueError("compiled DAG must consume an InputNode")
+
+        # ---- placement: shm domain per endpoint ----------------------
+        addresses: Dict[int, Any] = {}
+        for n in nodes:
+            core.wait_actor_ready(n.handle._actor_id, timeout=timeout)
+            addresses[id(n)] = core.actor_address(n.handle._actor_id,
+                                                  timeout=timeout)
+        # One cluster-state fetch for the whole compile (after every
+        # actor is placed, so assignments are visible), not per node.
+        try:
+            cluster_workers = core.head_call("state", {"kind": "workers"})
+            node_domains = {
+                ni["node_id"]: ni["hostname"]
+                for ni in core.head_call("state", {"kind": "nodes"})}
+        except Exception:  # noqa: BLE001 - assume co-located
+            cluster_workers, node_domains = [], {}
+
+        def actor_domain(handle) -> Optional[str]:
+            hexa = handle._actor_id.hex()
+            for w in cluster_workers:
+                if hexa[:12] in str(w.get("assignment", "")):
+                    return node_domains.get(w["node_id"])
+            return None
+
+        domains: Dict[int, Optional[str]] = {}
+        for n in nodes:
+            domains[id(n)] = actor_domain(n.handle)
+        driver_domain = core.shm_domain
+
+        def endpoint_domain(e):
+            if e == "driver" or isinstance(e, InputNode):
+                return driver_domain
+            return domains.get(id(e)) or driver_domain
+
+        def endpoint_address(e):
+            if e == "driver" or isinstance(e, InputNode):
+                return core.address
+            return addresses[id(e)]
+
+        # ---- channels: one per producer ------------------------------
+        self._channels: Dict[int, Any] = {}
+        self._reader_idx: Dict[Tuple[int, int], int] = {}
+        for pid, producer in producers.items():
+            cons = consumers.get(pid, [])
+            if not cons:
+                continue
+            wd = endpoint_domain(producer)
+            cross = any(endpoint_domain(c) != wd
+                        and endpoint_domain(c) is not None
+                        for c, _ in cons)
+            if cross:
+                ch = TcpChannel([endpoint_address(c) for c, _ in cons])
+            else:
+                ch = Channel(capacity_bytes, num_readers=len(cons))
+            self._channels[pid] = ch
+            for ridx, (c, pos) in enumerate(cons):
+                cid = -1 if c == "driver" else id(c)
+                self._reader_idx[(pid, cid, pos)] = ridx
+
+        # ---- drive loops ---------------------------------------------
         from .api import ActorMethod
 
         self._drive_refs = []
-        for i, node in enumerate(chain):
-            method = ActorMethod(node.handle, "__rt_drive__")
+        for n in nodes:
+            in_chs = [self._channels[id(u)] for u in n.upstreams]
+            ridxs = [self._reader_idx[(id(u), id(n), pos)]
+                     for pos, u in enumerate(n.upstreams)]
+            method = ActorMethod(n.handle, "__rt_drive__")
             self._drive_refs.append(method.remote(
-                node.method_name, self._channels[i],
-                self._channels[i + 1]))
+                n.method_name, in_chs, ridxs, self._channels[id(n)]))
+
+        self._terminals = terminals
+        self._multi = len(terminals) > 1
         self._closed = False
 
-    def execute(self, value: Any) -> Any:
-        if self._closed:
-            raise ChannelClosed("compiled DAG torn down")
-        self._channels[0].write(value, timeout=self._timeout)
-        out = self._channels[-1].read(0, timeout=self._timeout)
+    def _terminal_read(self, t):
+        ch = self._channels[id(t)]
+        ridx = self._reader_idx[(id(t), -1, 0)]
+        out = ch.read(ridx, timeout=self._timeout)
         from .exceptions import TaskError
 
         if isinstance(out, TaskError):
             raise out  # same raise-on-get convention as rt.get
         return out
 
+    def execute(self, value: Any) -> Any:
+        if self._closed:
+            raise ChannelClosed("compiled DAG torn down")
+        self._channels[id(self._input_node)].write(
+            value, timeout=self._timeout)
+        outs = [self._terminal_read(t) for t in self._terminals]
+        return outs if self._multi else outs[0]
+
     def teardown(self):
         if self._closed:
             return
         self._closed = True
-        for ch in self._channels:
+        for ch in self._channels.values():
             ch.close()
         # drive loops observe the closed flag and return
         try:
             self._rt.get(self._drive_refs, timeout=10)
         except Exception:
             pass
-        for ch in self._channels:
+        for ch in self._channels.values():
             ch.destroy()
 
     def __del__(self):

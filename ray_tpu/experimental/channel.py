@@ -125,3 +125,54 @@ class Channel:
             self._shm.unlink()
         except Exception:
             pass
+
+
+class TcpChannel:
+    """Single-writer multi-reader channel ACROSS shm domains.
+
+    Counterpart of the reference's cross-node mutable-object transfer
+    (reference: ``node_manager.proto:430-432`` — the writer's raylet
+    pushes each new value to every reader node): items are pushed over
+    the worker RPC plane to each reader's process, acks flow back for
+    the same 1-deep backpressure the shm channel enforces. The channel
+    object is picklable; whichever process calls ``write``/``read``
+    uses its own CoreWorker as the transport endpoint.
+    """
+
+    def __init__(self, reader_addresses, capacity_bytes: int = 0,
+                 *, _name: str = None):
+        self.name = _name or ("rtchan_" + ObjectID.from_random().hex())
+        self.reader_addresses = [
+            tuple(a) if isinstance(a, list) else a
+            for a in reader_addresses]
+        self.num_readers = len(self.reader_addresses)
+        self.capacity = capacity_bytes  # unused; parity with Channel
+
+    @classmethod
+    def _attach(cls, reader_addresses, capacity, name):
+        return cls(reader_addresses, capacity, _name=name)
+
+    def __reduce__(self):
+        return (TcpChannel._attach,
+                (self.reader_addresses, self.capacity, self.name))
+
+    def write(self, value: Any, timeout: float = 30.0) -> None:
+        from ray_tpu.core.worker import CoreWorker
+
+        CoreWorker.current().chan_write(self, value, timeout)
+
+    def read(self, reader_idx: int = 0, timeout: float = 30.0) -> Any:
+        from ray_tpu.core.worker import CoreWorker
+
+        return CoreWorker.current().chan_read(self.name, reader_idx,
+                                              timeout)
+
+    def close(self) -> None:
+        from ray_tpu.core.worker import CoreWorker
+
+        core = CoreWorker._current
+        if core is not None and not core._shutdown:
+            core.chan_close(self)
+
+    def destroy(self) -> None:
+        self.close()

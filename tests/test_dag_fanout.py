@@ -1,0 +1,111 @@
+"""Compiled-DAG fan-out / fan-in / multi-output + cross-node channels
+(reference: ``python/ray/dag/compiled_dag_node.py:372`` general
+topologies; ``node_manager.proto:430-432`` cross-node mutable objects)."""
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@rt.remote
+class Adder:
+    def __init__(self, k):
+        self.k = k
+
+    def add(self, x):
+        return x + self.k
+
+    def join(self, a, b):
+        return a + b
+
+
+def test_fan_out_fan_in(rt_cluster):
+    """input → (a, b) in parallel → aggregator joins both."""
+    a = Adder.remote(10)
+    b = Adder.remote(100)
+    agg = Adder.remote(0)
+    with InputNode() as inp:
+        left = a.add.bind(inp)
+        right = b.add.bind(inp)
+        out = agg.join.bind(left, right)
+    dag = out.experimental_compile()
+    try:
+        for i in range(5):
+            # (i+10) + (i+100)
+            assert dag.execute(i) == 2 * i + 110
+    finally:
+        dag.teardown()
+
+
+def test_multi_output(rt_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        n1 = a.add.bind(inp)
+        n2 = b.add.bind(inp)
+    dag = MultiOutputNode([n1, n2]).experimental_compile()
+    try:
+        assert dag.execute(10) == [11, 12]
+        assert dag.execute(20) == [21, 22]
+    finally:
+        dag.teardown()
+
+
+def test_error_propagates_through_fanin(rt_cluster):
+    @rt.remote
+    class Bad:
+        def boom(self, x):
+            raise ValueError("dag boom")
+
+    a = Adder.remote(1)
+    bad = Bad.remote()
+    agg = Adder.remote(0)
+    with InputNode() as inp:
+        out = agg.join.bind(a.add.bind(inp), bad.boom.bind(inp))
+    dag = out.experimental_compile()
+    try:
+        with pytest.raises(Exception, match="dag boom"):
+            dag.execute(1)
+    finally:
+        dag.teardown()
+
+
+def test_cross_node_two_stage_pipeline():
+    """VERDICT demo: a 2-stage pipeline across 2 nodes feeding one
+    aggregator — edges that cross shm domains ride the TCP channel."""
+    from ray_tpu.cluster_utils import Cluster
+
+    if rt.is_initialized():
+        rt.shutdown()
+    cluster = Cluster()
+    try:
+        n1 = cluster.add_node(num_cpus=2)
+        n2 = cluster.add_node(num_cpus=2)
+        cluster.connect()
+        strat = rt.NodeAffinitySchedulingStrategy
+
+        s1 = Adder.options(
+            scheduling_strategy=strat(n1.node_id, soft=False)).remote(10)
+        s2 = Adder.options(
+            scheduling_strategy=strat(n2.node_id, soft=False)).remote(100)
+        agg = Adder.options(
+            scheduling_strategy=strat(n2.node_id, soft=False)).remote(0)
+
+        with InputNode() as inp:
+            out = agg.join.bind(s1.add.bind(inp), s2.add.bind(inp))
+        dag = out.experimental_compile(timeout=60)
+        try:
+            from ray_tpu.experimental.channel import TcpChannel
+
+            kinds = {type(c).__name__ for c in dag._channels.values()}
+            assert "TcpChannel" in kinds, kinds  # actually crossed nodes
+            for i in range(3):
+                assert dag.execute(i) == 2 * i + 110
+        finally:
+            dag.teardown()
+    finally:
+        try:
+            rt.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()

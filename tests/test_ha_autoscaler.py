@@ -53,8 +53,14 @@ def test_head_state_snapshot_restore(tmp_path):
         assert core2.kv_get("durable_key", ns="app") == b"durable_value"
         actors = rt.state("actors")
         survivor = [a for a in actors if a["name"] == "survivor"]
-        assert survivor and survivor[0]["state"] == "DEAD"
-        assert "head restarted" in survivor[0]["death_cause"]
+        # Live-at-snapshot actors restore as RESTARTING (the reconnect
+        # grace window — workers that survived a head crash reattach);
+        # with its process gone, the reconcile pass marks it DEAD after
+        # the grace expires. Either state is the correct record here.
+        assert survivor and survivor[0]["state"] in ("RESTARTING", "DEAD")
+        if survivor[0]["state"] == "DEAD":
+            assert "reconnect" in survivor[0]["death_cause"] or \
+                "head restart" in survivor[0]["death_cause"]
     finally:
         rt.shutdown()
         ht2.stop()

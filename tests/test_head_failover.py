@@ -44,7 +44,11 @@ def _start_head(session_dir):
 
 
 @pytest.fixture
-def failover_cluster():
+def failover_cluster(monkeypatch):
+    # Generous windows: on a loaded single-core CI box the restart +
+    # reconnect sequence can stretch well past the production defaults.
+    monkeypatch.setenv("RT_HEAD_RECONNECT_TIMEOUT_S", "180")
+    monkeypatch.setenv("RT_HEAD_RECONNECT_GRACE_S", "60")
     if rt.is_initialized():
         rt.shutdown()
     session_dir = tempfile.mkdtemp(prefix="rt_failover_")

@@ -1,0 +1,29 @@
+"""Multi-slice / DCN data parallelism (SURVEY §2.3 "Distributed comm
+backend"): per-slice processes with their own device sets compose an
+intra-slice ICI mesh with a cross-slice store (DCN) allreduce."""
+import subprocess
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multislice_dryrun_two_slices():
+    """Run in a fresh subprocess: the dryrun spawns its own cluster and
+    per-slice processes, which must not inherit this test process's
+    virtual-device config."""
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from ray_tpu.parallel.multislice import run_multislice_dryrun\n"
+        "rep = run_multislice_dryrun(2, 2)\n"
+        "assert len(rep['slices']) == 2\n"
+        "assert all(r['agree'] for r in rep['slices'])\n"
+        "cs = {round(r['checksum'], 3) for r in rep['slices']}\n"
+        "assert len(cs) == 1, rep\n"
+        "print('multislice ok')\n" % REPO)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "multislice ok" in r.stdout

@@ -1,0 +1,51 @@
+"""Zero-copy shm → numpy/jax adoption (SURVEY §7): big values come out
+of the object store as read-only views over the shared segment — no
+host copy — and stage onto devices directly."""
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core.worker import CoreWorker
+
+
+def test_get_is_zero_copy_and_readonly(rt_cluster):
+    arr = np.arange(8 << 20, dtype=np.uint8)  # 8MB -> shm tier
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    core = CoreWorker._current
+    frames = core._load_frames(ref.object_id)
+    raw = np.frombuffer(frames[-1], dtype=np.uint8)
+    # Aliases the segment (no copy was made)...
+    assert np.shares_memory(out, raw)
+    # ...and is immutable, so user writes can't corrupt the stored
+    # value for other readers (plasma semantics).
+    assert not out.flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        out[0] = 1
+
+
+def test_zero_copy_view_device_put(rt_cluster):
+    import jax
+
+    from ray_tpu.utils.device import device_put_shm
+
+    arr = np.ones((512, 512), dtype=np.float32)  # 1MB -> shm
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    dev = device_put_shm(out)
+    assert isinstance(dev, jax.Array)
+    assert float(dev.sum()) == 512 * 512
+
+
+def test_inline_values_snapshot_and_readonly(rt_cluster):
+    """Inline (non-shm) values are snapshotted at put time: mutating
+    the source array after put, or the array a get returned, never
+    changes the stored value (matches the reference's immutable-object
+    semantics at every size)."""
+    src = np.arange(16, dtype=np.int64)
+    ref = rt.put(src)
+    src[0] = -1  # putter mutates AFTER put
+    out = rt.get(ref)
+    assert out[0] == 0  # snapshot, not an alias
+    assert not out.flags.writeable
+    assert rt.get(ref)[0] == 0
