@@ -77,18 +77,23 @@ def head_resource_name(pod_type: str) -> str:
     return f"TPU-{normalize_pod_type(pod_type)}-head"
 
 
-def gang_resources(num_chips: float) -> Dict[str, float]:
+def gang_resources(num_chips: float, pod_type: Optional[str] = None,
+                   worker_id: Optional[int] = None) -> Dict[str, float]:
     """Extra node resources advertised alongside ``TPU: num_chips``.
 
     Worker 0 of a slice gets the ``TPU-{pod}-head`` anchor; every worker
     gets the ``accelerator_type:TPU-{VERSION}`` label-style resource.
+    ``pod_type``/``worker_id`` default to env detection (a real TPU VM
+    host); explicit values let provisioners (the autoscaler's slice
+    provider) mint the same shape for hosts they are about to launch.
     """
-    pod = detect_pod_type()
+    pod = normalize_pod_type(pod_type) if pod_type else detect_pod_type()
     if not pod or not num_chips:
         return {}
     version, _ = parse_topology(pod)
     res: Dict[str, float] = {
         f"accelerator_type:TPU-{version.upper()}": float(num_chips)}
-    if detect_worker_id() == 0:
+    wid = detect_worker_id() if worker_id is None else worker_id
+    if wid == 0:
         res[head_resource_name(pod)] = 1.0
     return res

@@ -93,10 +93,13 @@ class DashboardServer:
     def __init__(self, state_fn: Callable[[str], object],
                  metrics_fn: Callable[[], str],
                  timeline_fn: Callable[[], list],
-                 host: str = "127.0.0.1", port: int = 0):
+                 log_fn=None, host: str = "127.0.0.1", port: int = 0):
         self._state_fn = state_fn
         self._metrics_fn = metrics_fn
         self._timeline_fn = timeline_fn
+        # async (query dict) -> {"data": str}|{"files": [...]}; serves
+        # /api/logs (reference: dashboard log module).
+        self._log_fn = log_fn
         self._host = host
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -146,6 +149,15 @@ class DashboardServer:
                 await self._respond(
                     writer, 200, "application/json",
                     json.dumps(self._timeline_fn()).encode())
+            elif url.path == "/api/logs" and self._log_fn is not None:
+                try:
+                    data = await self._log_fn(q)
+                    await self._respond(writer, 200, "application/json",
+                                        json.dumps(data).encode())
+                except Exception as e:  # noqa: BLE001 - missing log file
+                    await self._respond(writer, 404, "application/json",
+                                        json.dumps(
+                                            {"error": str(e)}).encode())
             elif url.path == "/":
                 await self._respond(writer, 200, "text/html",
                                     _INDEX_HTML.encode())

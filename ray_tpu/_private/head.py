@@ -157,6 +157,9 @@ class HeadService:
         # (the driver may have put the package on sys.path manually).
         self._spawn_env = spawn_env_with_pkg_root()
         self.task_events: deque = deque(maxlen=100_000)
+        # Finished tracing spans reported by workers/drivers
+        # (ray_tpu/util/tracing.py).
+        self.spans: deque = deque(maxlen=100_000)
         self._shutting_down = False
         # Observability: per-process metric snapshots (worker_id → snap)
         # merged on demand; dashboard server started in start().
@@ -224,6 +227,7 @@ class HeadService:
 
             self.dashboard = DashboardServer(
                 self.state_listing, self.metrics_text, self.chrome_trace,
+                log_fn=lambda q: self._rpc_worker_log(q, []),
                 port=getattr(self.config, "dashboard_port", 0))
             await self.dashboard.start()
         # Discovery file for the CLI (`python -m ray_tpu status`).
@@ -1197,6 +1201,14 @@ class HeadService:
         self.task_events.extend(payload)
         return {}
 
+    async def _rpc_report_spans(self, payload, bufs):
+        self.spans.extend(payload)
+        return {}
+
+    async def _rpc_get_spans(self, payload, bufs):
+        limit = payload.get("limit", 1000)
+        return list(self.spans)[-limit:]
+
     # ------------------------------------------------- object directory
     async def _rpc_object_loc_add(self, payload, bufs):
         addr = payload["address"]
@@ -1245,6 +1257,30 @@ class HeadService:
     async def _rpc_get_task_events(self, payload, bufs):
         limit = payload.get("limit", 10000)
         return list(self.task_events)[-limit:]
+
+    async def _rpc_worker_log(self, payload, bufs):
+        """Tail a worker's log wherever it lives: head-local logs read
+        from the head's session dir, remote ones fetched through the
+        owning node daemon (reference: ``dashboard/modules/log/`` routes
+        log reads through per-node agents)."""
+        from .node import tail_worker_log
+
+        wid = payload.get("worker_id", "")
+        req = {"worker_id": wid, "bytes": payload.get("bytes", 65536)}
+        if wid:  # empty = list the head's log dir, never a node's
+            for info in self.workers.values():
+                if info.worker_id.hex().startswith(wid):
+                    # Log files are named by the FULL id's first 12 hex
+                    # chars; a shorter matched prefix must be resolved.
+                    req["worker_id"] = info.worker_id.hex()
+                    node = self.nodes.get(info.node)
+                    if node is not None and not node.is_head \
+                            and node.conn is not None:
+                        return await node.conn.call_simple("tail_log", req)
+                    break
+        # Head-local worker, or already-dead worker whose log file
+        # remains in the head session dir.
+        return tail_worker_log(self.session_dir, req)
 
     # -------------------------------------------------------- observability
     async def _rpc_report_metrics(self, payload, bufs):
@@ -1477,12 +1513,26 @@ class HeadService:
         """Demand signals for the autoscaler loop (reference: v2 instance
         manager reads cluster resource state from the GCS)."""
         unplaced = 0
+        shapes: list = []
         for pg in self.pgs.values():
             if pg.state in ("PENDING", "RESCHEDULING"):
-                unplaced += sum(1 for n in pg.bundle_nodes if n is None)
+                for i, n in enumerate(pg.bundle_nodes):
+                    if n is None:
+                        unplaced += 1
+                        shapes.append(dict(pg.bundles[i].resources))
+        for req, pg_meta, _strategy, _fut in list(self._pending_leases):
+            # Bundle-targeted leases draw on capacity their PG already
+            # accounts for (above if unplaced, reserved if placed) —
+            # counting them again would double the demand signal.
+            if pg_meta:
+                continue
+            shapes.append(dict(req))
         return {
             "pending_lease_requests": len(self._pending_leases),
             "unplaced_pg_bundles": unplaced,
+            # Resource dict per unmet demand unit, so gang-aware
+            # providers (TPU slices) can pick a node type.
+            "pending_resource_shapes": shapes,
             "node_utilization": {
                 n.node_id: n.utilization()
                 for n in self._alive_nodes() if not n.is_head},
@@ -1561,6 +1611,19 @@ class HeadService:
                 "dur": int((ev["end"] - ev["start"]) * 1e6),
                 "pid": "ray_tpu",
                 "tid": ev.get("worker_id", "?")[:12],
+            })
+        # Tracing spans render on per-trace rows so one request's
+        # submit → execute chain reads left-to-right on one line.
+        for sp in list(self.spans):
+            out.append({
+                "name": sp["name"], "cat": f"span:{sp['kind']}", "ph": "X",
+                "ts": int(sp["start"] * 1e6),
+                "dur": max(1, int((sp["end"] - sp["start"]) * 1e6)),
+                "pid": "trace",
+                "tid": sp["trace_id"][:12],
+                "args": {"span_id": sp["span_id"],
+                         "parent_id": sp.get("parent_id"),
+                         "status": sp.get("status", "ok")},
             })
         return out
 

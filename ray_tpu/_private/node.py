@@ -24,6 +24,34 @@ from .ids import NodeID, WorkerID
 from .utils import spawn_env_with_pkg_root
 
 
+def tail_worker_log(session_dir: str, payload: dict) -> dict:
+    """Serve the tail of a worker's log file from this host (reference:
+    the per-node dashboard log agent, ``dashboard/modules/log/`` — logs
+    stay on the node that produced them and are fetched on demand).
+
+    ``payload``: ``worker_id`` (hex, >=12 chars; omit to list log files)
+    and ``bytes`` (tail size, default 64KiB).
+    """
+    logs_dir = os.path.join(session_dir, "logs")
+    wid = payload.get("worker_id", "")
+    if not wid:
+        try:
+            return {"files": sorted(os.listdir(logs_dir))}
+        except OSError:
+            return {"files": []}
+    nbytes = int(payload.get("bytes", 65536))
+    path = os.path.join(logs_dir, f"worker-{wid[:12]}.log")
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - nbytes))
+            data = f.read()
+    except OSError as e:
+        raise rpc.RpcError(f"log unavailable for worker {wid[:12]}: {e}")
+    return {"data": data.decode("utf-8", "replace"), "size": size}
+
+
 class NodeService:
     def __init__(self, head_address: Tuple[str, int], session_dir: str,
                  resources: Dict[str, float],
@@ -126,6 +154,8 @@ class NodeService:
             return self._kill_worker(payload["worker_id"])
         if method == "ping":
             return {"ok": True, "node_id": self.node_id.hex()}
+        if method == "tail_log":
+            return tail_worker_log(self.session_dir, payload)
         if method == "pubsub":
             return {}
         raise rpc.RpcError(f"node daemon: unknown method {method}")

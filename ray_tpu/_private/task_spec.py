@@ -59,6 +59,9 @@ class TaskSpec:
     owner_address: Any = None  # socket address of the submitting process
     # Streaming generator support
     is_generator: bool = False
+    # Propagated tracing context ({"trace_id","span_id"}) when the
+    # submitter has tracing enabled (ray_tpu/util/tracing.py).
+    trace_ctx: Optional[Dict[str, str]] = None
 
     def return_object_ids(self) -> List[ObjectID]:
         # Cached: submission builds the caller-facing refs and reply

@@ -17,6 +17,7 @@ demand from the GCS against a cloud NodeProvider; the v1 loop lives in
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -34,6 +35,12 @@ class NodeProvider:
 
     def non_terminated_nodes(self) -> List[str]:
         raise NotImplementedError
+
+    def member_nodes(self, provider_node_id: str) -> List[str]:
+        """Cluster node ids behind one provider unit. A plain provider's
+        unit IS one node; a slice provider's unit is a gang of hosts, and
+        idleness/termination apply to the whole gang."""
+        return [provider_node_id]
 
 
 class LocalNodeProvider(NodeProvider):
@@ -61,6 +68,179 @@ class LocalNodeProvider(NodeProvider):
         return list(self._nodes)
 
 
+class SliceBackend:
+    """Cloud-API surface behind :class:`TPUSliceProvider`: how one slice
+    HOST is launched/terminated and how its cluster node id is read. A
+    GCE/GKE deployment implements these against its API (queued
+    resources / nodepools); the default backend materializes hosts as
+    local node daemons."""
+
+    def launch(self, slice_id: str, worker_id: int,
+               resources: Dict[str, float], num_cpus: float,
+               num_tpus: float) -> Any:
+        """Start one host (non-blocking); returns an opaque handle."""
+        raise NotImplementedError
+
+    def finalize(self, slice_id: str, handles: List[Any]) -> None:
+        """Barrier after every host of a slice launched (optional).
+        Cloud backends usually no-op — their hosts register with the
+        head asynchronously."""
+
+    def terminate(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def node_id(self, handle: Any) -> str:
+        """Cluster node id for a launched host ('' until registered)."""
+        raise NotImplementedError
+
+
+class LocalSliceBackend(SliceBackend):
+    """Slice hosts as local node daemons (cluster_utils). Launch is
+    non-blocking; ``finalize`` waits for the whole gang to register at
+    once, so an N-host slice costs one registration wait, not N."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def launch(self, slice_id, worker_id, resources, num_cpus, num_tpus):
+        return self.cluster.add_node(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            labels={"rt.io/tpu-slice": slice_id,
+                    "rt.io/tpu-worker-id": str(worker_id)},
+            wait=False)
+
+    def finalize(self, slice_id, handles):
+        deadline = time.time() + 60
+        waiting = {h.shm_domain: h for h in handles}
+        while waiting:
+            for n in self.cluster.list_nodes():
+                h = waiting.pop(n["hostname"], None)
+                if h is not None:
+                    h.node_id = n["node_id"]
+            if not waiting:
+                return
+            for h in waiting.values():
+                if h.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"slice {slice_id}: host daemon exited "
+                        f"with {h.proc.returncode}")
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"slice {slice_id}: {len(waiting)} host(s) never "
+                    "registered")
+            time.sleep(0.05)
+
+    def terminate(self, handle):
+        self.cluster.remove_node(handle)
+
+    def node_id(self, handle):
+        return handle.node_id
+
+
+class TPUSliceProvider(NodeProvider):
+    """TPU provider: one ``create_node`` call = one whole slice gang,
+    never a partial slice (reference capability:
+    ``python/ray/autoscaler/_private/gcp/`` node types +
+    ``_private/accelerators/tpu.py``'s ``TPU-{pod}-head`` anchor — a
+    slice is atomic because one lost host breaks the ICI domain).
+
+    ``pod_type`` (e.g. ``"v5e-16"``) fixes the gang shape:
+    ``num_hosts(pod_type)`` hosts x ``chips_per_host`` chips. Hosts
+    carry exactly the resource shape a real TPU VM host advertises
+    (``TPU: n`` per host, the ``TPU-{pod}-head`` anchor on host 0), so
+    gang scheduling behaves identically to a detected slice. The
+    provisioning calls live in a pluggable :class:`SliceBackend`.
+    """
+
+    def __init__(self, cluster, pod_type: str = "v5e-16", *,
+                 cpus_per_host: float = 4.0,
+                 backend: Optional[SliceBackend] = None):
+        from ray_tpu._private import accelerators as acc
+
+        self.pod_type = acc.normalize_pod_type(pod_type)
+        version, chips = acc.parse_topology(self.pod_type)
+        self.hosts_per_slice = acc.num_hosts(self.pod_type)
+        self.chips_per_host = chips // self.hosts_per_slice
+        self.cpus_per_host = cpus_per_host
+        self.version = version
+        self.backend = backend or LocalSliceBackend(cluster)
+        self._slices: Dict[str, List[Any]] = {}  # slice_id -> host handles
+        self._seq = 0
+
+    def _host_resources(self, worker_id: int) -> Dict[str, float]:
+        from ray_tpu._private import accelerators as acc
+
+        # Same shape a detected TPU VM host advertises — one rule, in
+        # the accelerator layer.
+        return acc.gang_resources(self.chips_per_host,
+                                  pod_type=self.pod_type,
+                                  worker_id=worker_id)
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        """Launch one full slice; ``resources`` (the generic per-node
+        ask) is subsumed by the slice shape. Launch failures tear down
+        the partial gang — a half-slice can never gang-schedule and
+        would leak hosts."""
+        self._seq += 1
+        slice_id = f"{self.pod_type}-slice-{self._seq}"
+        hosts: List[Any] = []
+        try:
+            for wid in range(self.hosts_per_slice):
+                hosts.append(self.backend.launch(
+                    slice_id, wid, self._host_resources(wid),
+                    self.cpus_per_host, self.chips_per_host))
+            self.backend.finalize(slice_id, hosts)
+        except Exception:
+            for h in hosts:
+                try:
+                    self.backend.terminate(h)
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+            raise
+        self._slices[slice_id] = hosts
+        return slice_id
+
+    def terminate_node(self, node_id: str) -> None:
+        remaining = []
+        for handle in self._slices.pop(node_id, []):
+            try:
+                self.backend.terminate(handle)
+            except Exception:  # noqa: BLE001 - keep for a retry pass
+                remaining.append(handle)
+        if remaining:
+            # Partial teardown: keep the leftovers visible so the next
+            # idle pass retries them instead of orphaning live hosts.
+            self._slices[node_id] = remaining
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._slices)
+
+    def member_nodes(self, provider_node_id: str) -> List[str]:
+        return [self.backend.node_id(h)
+                for h in self._slices.get(provider_node_id, [])]
+
+    def slices_needed(self, state: dict) -> int:
+        """Demand in SLICES: pending TPU chip asks divided by slice
+        capacity, plus one slice per anchor/label-only gang ask.
+        Generic CPU demand never launches slices; pass this as the
+        autoscaler's ``demand_fn``."""
+        chips = 0.0
+        anchors = 0
+        for shape in state.get("pending_resource_shapes", ()):
+            tpu_keys = [k for k in shape
+                        if k == "TPU" or k.startswith("TPU-")
+                        or k.startswith("accelerator_type:TPU")]
+            if not tpu_keys:
+                continue
+            c = shape.get("TPU", 0.0)
+            if c > 0:
+                chips += c
+            else:
+                anchors += 1
+        per_slice = self.chips_per_host * self.hosts_per_slice
+        return math.ceil(chips / per_slice) + anchors
+
+
 class Autoscaler:
     """Reconciling loop: head demand → provider node count."""
 
@@ -68,13 +248,20 @@ class Autoscaler:
                  node_resources: Optional[Dict[str, float]] = None,
                  min_nodes: int = 0, max_nodes: int = 4,
                  idle_timeout_s: float = 30.0,
-                 poll_period_s: float = 1.0):
+                 poll_period_s: float = 1.0,
+                 demand_fn=None):
         self.provider = provider
         self.node_resources = node_resources or {"CPU": 2.0}
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
         self.idle_timeout_s = idle_timeout_s
         self.poll_period_s = poll_period_s
+        # state dict -> provider UNITS needed (nodes for plain
+        # providers, slices for TPUSliceProvider.slices_needed).
+        # Default: ~2 queued demand items per new node.
+        self.demand_fn = demand_fn or (
+            lambda s: (s["pending_lease_requests"]
+                       + s["unplaced_pg_bundles"] + 1) // 2)
         self._idle_since: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -90,21 +277,22 @@ class Autoscaler:
     def reconcile_once(self) -> None:
         state = self._demand()
         nodes = self.provider.non_terminated_nodes()
-        pending = state["pending_lease_requests"] + \
-            state["unplaced_pg_bundles"]
+        pending = self.demand_fn(state)
         if pending > 0 and len(nodes) < self.max_nodes:
-            n_new = min(self.max_nodes - len(nodes),
-                        max(1, pending // 2))
+            n_new = min(self.max_nodes - len(nodes), pending)
             for _ in range(n_new):
                 node_id = self.provider.create_node(self.node_resources)
                 self.events.append(
                     f"scale-up {node_id[:12]} (pending={pending})")
             return
-        # Scale down: retire provider nodes idle past the timeout.
+        # Scale down: retire provider units idle past the timeout. A
+        # unit spanning several cluster nodes (a TPU slice) is idle only
+        # when EVERY member host is.
         util = state["node_utilization"]  # node_id -> busy fraction
         now = time.time()
         for node_id in nodes:
-            busy = util.get(node_id, 1.0)
+            members = self.provider.member_nodes(node_id)
+            busy = max((util.get(m, 1.0) for m in members), default=1.0)
             if busy > 0:
                 self._idle_since.pop(node_id, None)
                 continue

@@ -169,6 +169,11 @@ def main(argv=None) -> int:
     p_list.add_argument("kind", choices=[
         "nodes", "workers", "actors", "placement_groups", "tasks"])
     sub.add_parser("metrics")
+    p_logs = sub.add_parser("logs")
+    p_logs.add_argument("worker_id", nargs="?", default="",
+                        help="worker id hex prefix (>=12 chars); omit "
+                             "to list available log files")
+    p_logs.add_argument("--bytes", type=int, default=65536)
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("output", nargs="?", default="timeline.json")
     sub.add_parser("dashboard")
@@ -217,6 +222,16 @@ def main(argv=None) -> int:
             print(json.dumps(rt.state(args.kind), indent=1, default=str))
         elif args.cmd == "metrics":
             print(rt.metrics_text(), end="")
+        elif args.cmd == "logs":
+            from .core.worker import CoreWorker
+
+            out = CoreWorker.current().head_call(
+                "worker_log", {"worker_id": args.worker_id,
+                               "bytes": args.bytes})
+            if "files" in out:
+                print("\n".join(out["files"]))
+            else:
+                print(out["data"], end="")
         elif args.cmd == "timeline":
             events = rt.timeline(format="chrome")
             with open(args.output, "w") as f:

@@ -43,6 +43,7 @@ from ..exceptions import (
     TaskError,
     WorkerCrashedError,
 )
+from ..util import tracing
 
 
 class ObjectRef:
@@ -1449,6 +1450,7 @@ class CoreWorker:
             name=name, owner_address=self.address,
             is_generator=streaming,
             runtime_env=wire_env,
+            trace_ctx=tracing.on_submit(name or fn_key),
         )
         # Refs MUST exist before the submission is scheduled: a fast task
         # completing on the IO thread hits on_result_stored, and with no
@@ -1734,6 +1736,8 @@ class CoreWorker:
             meta["is_generator"] = True
         if spec.runtime_env is not None:
             meta["runtime_env"] = spec.runtime_env
+        if spec.trace_ctx is not None:
+            meta["trace_ctx"] = spec.trace_ctx
         return meta
 
     def _ingest_results(self, spec: TaskSpec, meta, bufs):
@@ -2005,6 +2009,7 @@ class CoreWorker:
         task_id = TaskID.from_random()
         streaming = num_returns == "streaming"
         ser_args, kw_keys, borrowed = self._serialize_args(args, kwargs)
+        trace_ctx = tracing.on_submit(method_name)
         key = actor_id.binary()
         # Wire batching: consecutive calls to the same actor share one
         # push_task_batch RPC (receiver-side seq streams keep ordering,
@@ -2027,6 +2032,7 @@ class CoreWorker:
                 num_returns=0 if streaming else num_returns,
                 actor_id=actor_id, method_name=method_name, seq_no=seq,
                 owner_address=self.address, is_generator=streaming,
+                trace_ctx=trace_ctx,
             )
             if streaming:
                 direct = None  # enqueue outside the lock
@@ -2821,7 +2827,8 @@ class CoreWorker:
         try:
             args, kwargs = self._deserialize_args(
                 meta["args"], meta["kwargs_keys"])
-            out = getattr(instance, meta["method_name"])(*args, **kwargs)
+            with tracing.execute_span(meta, meta["method_name"]):
+                out = getattr(instance, meta["method_name"])(*args, **kwargs)
             values = self._split_returns(out, meta["num_returns"])
             res = self._package_returns(meta, values)
         except Exception as e:  # noqa: BLE001
@@ -2879,7 +2886,21 @@ class CoreWorker:
         fn = getattr(fn, "__rt_function__", fn)
         args, kwargs = self._deserialize_args(meta["args"],
                                               meta["kwargs_keys"])
-        return fn(*args, **kwargs)
+        if meta.get("is_generator"):
+            return self._traced_gen(meta, lambda: fn(*args, **kwargs))
+        # Runs on the executor thread, so user code inherits the span
+        # context: nested tracing.span()/submissions become children.
+        with tracing.execute_span(meta, meta.get("name") or "task"):
+            return fn(*args, **kwargs)
+
+    @staticmethod
+    def _traced_gen(meta, make):
+        """Generator tasks produce lazily: the execute span must cover
+        the ITERATION of the body (where user code actually runs), not
+        the call that merely constructs the generator object."""
+        name = meta.get("name") or meta.get("method_name") or "task"
+        with tracing.execute_span(meta, name):
+            yield from make()
 
     @staticmethod
     def _split_returns(out, num_returns):
@@ -3015,7 +3036,8 @@ class CoreWorker:
                 def produce():
                     args, kwargs = self._deserialize_args(
                         meta["args"], meta["kwargs_keys"])
-                    return method(*args, **kwargs)
+                    return self._traced_gen(
+                        meta, lambda: method(*args, **kwargs))
 
                 ex = self._actor_executors[actor_id_b]
                 return await loop.run_in_executor(
@@ -3030,11 +3052,16 @@ class CoreWorker:
                     lambda: self._deserialize_args(meta["args"],
                                                    meta["kwargs_keys"]))
             if asyncio.iscoroutinefunction(method):
-                out = await method(*args, **kwargs)
+                with tracing.execute_span(meta, meta["method_name"]):
+                    out = await method(*args, **kwargs)
             else:
                 ex = self._actor_executors[actor_id_b]
-                out = await loop.run_in_executor(
-                    ex, lambda: method(*args, **kwargs))
+
+                def _call_traced():
+                    with tracing.execute_span(meta, meta["method_name"]):
+                        return method(*args, **kwargs)
+
+                out = await loop.run_in_executor(ex, _call_traced)
             return self._split_returns(out, meta["num_returns"])
 
         # FIFO per submitting client for max_concurrency == 1 actors, like
@@ -3245,6 +3272,15 @@ class CoreWorker:
             self._task_events.clear()
             try:
                 self.head_call("report_task_events", evs)
+            except Exception:
+                pass
+        spans = tracing.drain()
+        if spans:
+            me = self.worker_id.hex()
+            for s in spans:
+                s.setdefault("process", me)
+            try:
+                self.head_call("report_spans", spans)
             except Exception:
                 pass
         self.flush_metrics()

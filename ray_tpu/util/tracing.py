@@ -1,0 +1,192 @@
+"""Distributed tracing: spans around task submit/execute with context
+propagation across process boundaries.
+
+Capability parity with the reference's tracing helper (reference:
+``python/ray/util/tracing/tracing_helper.py`` — ``_inject_tracing_into_function``
+serializes the caller's span context into a hidden ``_ray_trace_ctx`` kwarg
+and the worker reopens a child span around user code) and with C++ profile
+events (reference: ``src/ray/core_worker/profile_event.h``). Re-designed for
+this runtime: the context rides the task wire meta (``trace_ctx`` key on the
+spec), spans buffer per process and flush to the head alongside task events,
+and the head folds them into the chrome-trace timeline and a ``get_spans``
+RPC — no OpenTelemetry dependency (zero-egress image), but the span model
+(trace_id / span_id / parent_id / attributes) matches, so an exporter is a
+drain loop away.
+
+Usage::
+
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    ray_tpu.init()
+    tracing.enable()
+    with tracing.span("my-request", user="alice"):
+        ref = my_task.remote()          # submit span, child of my-request
+        ray_tpu.get(ref)                # worker executes under same trace
+    spans = tracing.get_spans()          # cluster-wide, from the head
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# (trace_id, span_id) of the active span in this thread/coroutine.
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_trace_ctx", default=None)
+
+_enabled = os.environ.get("RT_TRACING_ENABLED", "").lower() in (
+    "1", "true", "yes", "on")
+# Finished spans waiting for a flush to the head.
+_buffer: deque = deque(maxlen=100_000)
+
+
+def enable() -> None:
+    """Turn on span recording in THIS process. Remote workers switch on
+    lazily: any task submitted while tracing is enabled carries a
+    ``trace_ctx``, and executing a traced task records spans regardless
+    of the worker-local flag (the decision belongs to the submitter,
+    like the reference's driver-side ``_tracing_startup_hook``)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    cur = _current.get()
+    if cur is None:
+        return None
+    return {"trace_id": cur[0], "span_id": cur[1]}
+
+
+def _record(name: str, kind: str, trace_id: str, span_id: str,
+            parent_id: Optional[str], start: float, end: float,
+            attrs: Optional[Dict[str, Any]], status: str = "ok") -> dict:
+    span = {
+        "name": name, "kind": kind,
+        "trace_id": trace_id, "span_id": span_id, "parent_id": parent_id,
+        "start": start, "end": end, "status": status,
+    }
+    if attrs:
+        span["attrs"] = attrs
+    _buffer.append(span)
+    return span
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "internal", **attrs):
+    """Record a span; nested ``span()``/task submissions become children.
+
+    No-op (yields None) when tracing is disabled, so library code may
+    instrument unconditionally. Inside a traced task the propagated
+    context is active even though the worker never called ``enable()``
+    — user spans there must record, so the context check comes first.
+    """
+    parent = _current.get()
+    if parent is None and not _enabled:
+        yield None
+        return
+    trace_id = parent[0] if parent else _new_id(16)
+    span_id = _new_id(8)
+    token = _current.set((trace_id, span_id))
+    start = time.time()
+    status = "ok"
+    try:
+        yield {"trace_id": trace_id, "span_id": span_id}
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        _current.reset(token)
+        _record(name, kind, trace_id, span_id,
+                parent[1] if parent else None, start, time.time(),
+                attrs or None, status)
+
+
+def on_submit(name: str) -> Optional[Dict[str, str]]:
+    """Called by the core worker at task/actor-call submission. Records a
+    point-in-time submit span (child of the caller's active span) and
+    returns the wire context the execute side parents under, or None when
+    tracing is off (the common case — one branch on the hot path).
+
+    A worker submitting from inside a traced task has an active context
+    (execute_span set it) even though its local flag is off — the chain
+    must continue across hops, so the context check comes first."""
+    parent = _current.get()
+    if parent is None and not _enabled:
+        return None
+    trace_id = parent[0] if parent else _new_id(16)
+    span_id = _new_id(8)
+    now = time.time()
+    _record(f"submit {name}", "submit", trace_id, span_id,
+            parent[1] if parent else None, now, now, None)
+    return {"trace_id": trace_id, "span_id": span_id}
+
+
+@contextlib.contextmanager
+def execute_span(meta: dict, name: str):
+    """Worker-side child span around user-code execution of a traced task.
+
+    Pulls the propagated context from the task wire meta; a task with no
+    ``trace_ctx`` (tracing off at the submitter) costs one dict lookup.
+    """
+    ctx = meta.get("trace_ctx")
+    if ctx is None:
+        yield None
+        return
+    trace_id = ctx["trace_id"]
+    span_id = _new_id(8)
+    token = _current.set((trace_id, span_id))
+    start = time.time()
+    status = "ok"
+    try:
+        yield {"trace_id": trace_id, "span_id": span_id}
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        _current.reset(token)
+        _record(f"execute {name}", "execute", trace_id, span_id,
+                ctx.get("span_id"), start, time.time(), None, status)
+
+
+def drain() -> List[dict]:
+    """Hand off buffered finished spans (called by the flush loop).
+    Pops item-wise: a span appended concurrently by an executor thread
+    either makes this drain or stays for the next one — a snapshot +
+    clear() would silently drop it."""
+    out: List[dict] = []
+    while True:
+        try:
+            out.append(_buffer.popleft())
+        except IndexError:
+            return out
+
+
+def local_spans() -> List[dict]:
+    """Finished spans still buffered in this process (testing hook)."""
+    return list(_buffer)
+
+
+def get_spans(limit: int = 1000) -> List[dict]:
+    """Cluster-wide finished spans, from the head (flushes local first)."""
+    from ray_tpu.core.worker import CoreWorker
+
+    core = CoreWorker.current()
+    core.flush_task_events()
+    return core.head_call("get_spans", {"limit": limit})

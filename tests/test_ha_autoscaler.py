@@ -106,6 +106,63 @@ def test_autoscaler_scales_up_and_down(tmp_path):
         cluster.shutdown()
 
 
+def test_tpu_slice_provider_gang_scale(tmp_path):
+    """TPU demand launches a WHOLE slice (2 hosts for v5e-8), CPU demand
+    launches nothing, and an idle slice retires atomically."""
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    from ray_tpu.autoscaler import Autoscaler, TPUSliceProvider
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 4.0},
+                      system_config={"worker_lease_timeout_s": 120.0})
+    rt = cluster.connect()
+    provider = TPUSliceProvider(cluster, pod_type="v5e-8")
+    assert provider.hosts_per_slice == 2 and provider.chips_per_host == 4
+    # Capacity-aware demand: 2 pending x TPU:4 = 8 chips = ONE v5e-8
+    # slice, not one slice per pending task.
+    assert provider.slices_needed(
+        {"pending_resource_shapes": [{"TPU": 4.0}, {"TPU": 4.0}]}) == 1
+    scaler = Autoscaler(provider, min_nodes=0, max_nodes=2,
+                        idle_timeout_s=3.0, poll_period_s=0.5,
+                        demand_fn=provider.slices_needed).start()
+    try:
+        @rt.remote
+        def cpu_work():
+            return "cpu"
+
+        # CPU-only demand fits the head and must NOT launch a slice.
+        assert rt.get(cpu_work.remote(), timeout=30) == "cpu"
+        time.sleep(1.5)
+        assert provider.non_terminated_nodes() == []
+
+        @rt.remote(resources={"TPU": 4.0})
+        def tpu_work():
+            return "tpu"
+
+        refs = [tpu_work.remote() for _ in range(2)]
+        assert rt.get(refs, timeout=120) == ["tpu", "tpu"]
+        slices = provider.non_terminated_nodes()
+        assert len(slices) == 1
+        assert len(provider.member_nodes(slices[0])) == 2
+        # Host 0 of the slice carries the gang anchor, host 1 does not.
+        anchored = [n for n in rt.state("nodes")
+                    if "TPU-v5e-8-head" in n["total"]]
+        assert len(anchored) == 1
+
+        # Idle past the timeout → the whole gang retires together.
+        deadline = time.time() + 60
+        while time.time() < deadline and provider.non_terminated_nodes():
+            time.sleep(1.0)
+        assert not provider.non_terminated_nodes(), scaler.events
+        assert any("scale-down" in e for e in scaler.events)
+    finally:
+        scaler.stop()
+        cluster.shutdown()
+
+
 def test_usage_stats_local_only(tmp_path):
     usage_stats.record_feature("unit_test_feature")
     rep = usage_stats.report()

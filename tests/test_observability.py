@@ -100,6 +100,46 @@ def test_dashboard_http(rt_cluster):
         urllib.request.urlopen(url + "/nope", timeout=10)
 
 
+def test_worker_log_tailing(rt_cluster):
+    """Worker stdout is fetchable by worker id — via head RPC and via
+    the dashboard /api/logs endpoint (reference:
+    ``dashboard/modules/log/`` per-node log serving)."""
+    rt = rt_cluster
+
+    @rt.remote
+    class Chatty:
+        def speak(self):
+            print("chatty-actor-log-line", flush=True)
+            return os.getpid()
+
+    a = Chatty.remote()
+    rt.get(a.speak.remote())
+    time.sleep(0.3)  # stdout reaches the redirected file
+
+    from ray_tpu.core.worker import CoreWorker
+
+    core = CoreWorker.current()
+    listing = core.head_call("worker_log", {})
+    assert any(f.startswith("worker-") for f in listing["files"])
+
+    workers = rt.state("workers")
+    tails = []
+    for w in workers:
+        out = core.head_call("worker_log", {"worker_id": w["worker_id"]})
+        tails.append(out["data"])
+    assert any("chatty-actor-log-line" in t for t in tails)
+
+    url = rt.dashboard_url()
+    hit = False
+    for w in workers:
+        with urllib.request.urlopen(
+                url + f"/api/logs?worker_id={w['worker_id']}",
+                timeout=10) as resp:
+            if "chatty-actor-log-line" in json.loads(resp.read())["data"]:
+                hit = True
+    assert hit
+
+
 def test_chrome_timeline(rt_cluster):
     rt = rt_cluster
 

@@ -1,0 +1,188 @@
+"""Tracing spans: context propagation across submit/execute boundaries.
+
+Mirrors the reference's tracing tests (reference:
+``python/ray/tests/test_tracing.py`` — asserts spans exist for
+``.remote()`` submission and worker-side execution with a shared trace).
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def traced_cluster():
+    ray_tpu.init(num_cpus=2)
+    tracing.enable()
+    try:
+        yield
+    finally:
+        tracing.disable()
+        ray_tpu.shutdown()
+
+
+def _spans_by_kind(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s["kind"], []).append(s)
+    return out
+
+
+def _wait_spans(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        spans = tracing.get_spans()
+        if predicate(spans):
+            return spans
+        time.sleep(0.2)
+    return tracing.get_spans()
+
+
+def test_task_spans_share_trace(traced_cluster):
+    @ray_tpu.remote
+    def traced_fn(x):
+        return x + 1
+
+    with tracing.span("request", user="test") as ctx:
+        assert ray_tpu.get(traced_fn.remote(41)) == 42
+    trace_id = ctx["trace_id"]
+
+    spans = _wait_spans(lambda ss: any(s["kind"] == "execute" for s in ss))
+    kinds = _spans_by_kind([s for s in spans if s["trace_id"] == trace_id])
+    # Root span, the submit span it parents, and the worker-side execute
+    # span parented under the submit span — one trace end to end.
+    assert "internal" in kinds and "submit" in kinds and "execute" in kinds
+    root = kinds["internal"][0]
+    sub = kinds["submit"][0]
+    ex = kinds["execute"][0]
+    assert root["name"] == "request" and root["attrs"] == {"user": "test"}
+    assert sub["parent_id"] == root["span_id"]
+    assert ex["parent_id"] == sub["span_id"]
+    assert ex["name"] == "execute traced_fn"
+    assert ex["process"] != root.get("process")  # ran in another process
+
+
+def test_actor_call_spans(traced_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    with tracing.span("actor-request") as ctx:
+        assert ray_tpu.get(c.incr.remote()) == 1
+
+    spans = _wait_spans(
+        lambda ss: any(s["kind"] == "execute"
+                       and s["trace_id"] == ctx["trace_id"] for s in ss))
+    mine = [s for s in spans if s["trace_id"] == ctx["trace_id"]]
+    kinds = _spans_by_kind(mine)
+    assert any(s["name"] == "execute incr" for s in kinds["execute"])
+    assert any(s["name"] == "submit incr" for s in kinds["submit"])
+
+
+def test_nested_submission_continues_trace(traced_cluster):
+    """A task submitted from INSIDE a traced task stays on the same
+    trace even though the worker process never called enable()."""
+    @ray_tpu.remote
+    def inner():
+        return 41
+
+    @ray_tpu.remote
+    def outer():
+        # User span inside a traced task: the worker never called
+        # enable(), but the propagated context must make this record.
+        with tracing.span("user-phase"):
+            return ray_tpu.get(inner.remote()) + 1
+
+    with tracing.span("nested-root") as ctx:
+        assert ray_tpu.get(outer.remote()) == 42
+
+    spans = _wait_spans(
+        lambda ss: sum(1 for s in ss if s["kind"] == "execute"
+                       and s["trace_id"] == ctx["trace_id"]) >= 2,
+        timeout=15.0)
+    mine = [s for s in spans if s["trace_id"] == ctx["trace_id"]]
+    ex_names = {s["name"] for s in mine if s["kind"] == "execute"}
+    assert "execute outer" in ex_names and "execute inner" in ex_names
+    # The user's in-task span recorded and chains execute→user→submit.
+    outer_ex = next(s for s in mine if s["name"] == "execute outer")
+    user = next(s for s in mine if s["name"] == "user-phase")
+    inner_sub = next(s for s in mine if s["name"] == "submit inner")
+    assert user["parent_id"] == outer_ex["span_id"]
+    assert inner_sub["parent_id"] == user["span_id"]
+
+
+def test_generator_span_covers_iteration(traced_cluster):
+    """The execute span of a streaming task covers the body's lazy
+    iteration, not just the generator's construction."""
+    @ray_tpu.remote
+    def stream3():
+        for i in range(3):
+            time.sleep(0.05)
+            yield i
+
+    with tracing.span("gen-root") as ctx:
+        gen = stream3.options(num_returns="streaming").remote()
+        assert [ray_tpu.get(r) for r in gen] == [0, 1, 2]
+
+    spans = _wait_spans(
+        lambda ss: any(s["kind"] == "execute"
+                       and s["trace_id"] == ctx["trace_id"] for s in ss))
+    ex = next(s for s in spans if s["trace_id"] == ctx["trace_id"]
+              and s["kind"] == "execute")
+    assert ex["end"] - ex["start"] >= 0.15  # 3 x 0.05s of body time
+
+
+def test_error_status_recorded(traced_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("nope")
+
+    with tracing.span("err-request") as ctx:
+        with pytest.raises(Exception):
+            ray_tpu.get(boom.remote())
+
+    spans = _wait_spans(
+        lambda ss: any(s["kind"] == "execute"
+                       and s["trace_id"] == ctx["trace_id"] for s in ss))
+    ex = [s for s in spans if s["trace_id"] == ctx["trace_id"]
+          and s["kind"] == "execute"]
+    assert ex and ex[0]["status"] == "error"
+
+
+def test_disabled_is_free():
+    ray_tpu.init(num_cpus=1)
+    try:
+        assert not tracing.enabled()
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote()) == 1
+        assert tracing.local_spans() == []
+        assert tracing.current_context() is None
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_timeline_includes_spans(traced_cluster):
+    @ray_tpu.remote
+    def g():
+        return "ok"
+
+    with tracing.span("tl-request"):
+        ray_tpu.get(g.remote())
+    _wait_spans(lambda ss: any(s["kind"] == "execute" for s in ss))
+
+    from ray_tpu.core.worker import CoreWorker
+
+    trace = CoreWorker.current().head_call("chrome_trace")
+    assert any(ev.get("pid") == "trace" for ev in trace)
