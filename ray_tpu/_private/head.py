@@ -1278,9 +1278,22 @@ class HeadService:
                             and node.conn is not None:
                         return await node.conn.call_simple("tail_log", req)
                     break
-        # Head-local worker, or already-dead worker whose log file
-        # remains in the head session dir.
-        return tail_worker_log(self.session_dir, req)
+        # Head-local worker (alive or dead — its file is in the head's
+        # session dir), else a DEAD remote worker: the head no longer
+        # tracks it, but the node daemon that ran it still has the file,
+        # so ask each live node until one finds it.
+        try:
+            return tail_worker_log(self.session_dir, req)
+        except rpc.RpcError:
+            if wid:
+                for node in self._alive_nodes():
+                    if node.is_head or node.conn is None:
+                        continue
+                    try:
+                        return await node.conn.call_simple("tail_log", req)
+                    except Exception:  # noqa: BLE001 - not on this node
+                        continue
+            raise
 
     # -------------------------------------------------------- observability
     async def _rpc_report_metrics(self, payload, bufs):

@@ -155,6 +155,8 @@ class SharedMemoryStore:
         # object_id -> (shm handle or None, nbytes, spilled_path or None)
         self._owned: "OrderedDict[ObjectID, tuple]" = OrderedDict()
         self._attached: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        # In-progress chunked transfers (create_pending → seal/abort)
+        self._pending: Dict[ObjectID, tuple] = {}
         self._spill_dir = spill_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "rt_spill"
         )
@@ -171,6 +173,24 @@ class SharedMemoryStore:
     def _name(self, object_id: ObjectID) -> str:
         return "rt_" + self._prefix + object_id.hex()[:30]
 
+    @staticmethod
+    def _clear_if_stale(name: str) -> bool:
+        """True if the named segment was a half-written leftover (frame
+        count still 0 — a crashed or in-flight chunked pull) and was
+        unlinked. A COMPLETE segment is left alone: task results are
+        idempotent, the existing copy is the same value."""
+        try:
+            shm = _open_shm(name)
+        except FileNotFoundError:
+            return True  # vanished under us: name is free now
+        try:
+            stale = bytes(shm.buf[:4]) == b"\x00\x00\x00\x00"
+            if stale:
+                shm.unlink()
+            return stale
+        finally:
+            shm.close()
+
     def create(self, object_id: ObjectID, frames: List[bytes]) -> int:
         """Write frames into a new segment. Returns total bytes.
 
@@ -185,11 +205,105 @@ class SharedMemoryStore:
             try:
                 shm = _open_shm(self._name(object_id), create=True, size=n)
             except FileExistsError:
-                return n  # already stored (idempotent put)
+                # A half-written leftover (e.g. a pull racing this
+                # producer — lineage recovery while a consumer pulls)
+                # must NOT suppress the write: readers would wedge on a
+                # count-0 segment that no one will ever finish.
+                if not self._clear_if_stale(self._name(object_id)):
+                    return n  # complete copy already here (idempotent)
+                try:
+                    shm = _open_shm(self._name(object_id), create=True,
+                                    size=n)
+                except FileExistsError:
+                    return n  # recreated concurrently: defer to it
             pack_frames_into(shm.buf, 0, frames)
             self._owned[object_id] = (shm, n, None)
             self._used += n
         return n
+
+    def create_pending(self, object_id: ObjectID, frame_sizes):
+        """Reserve a segment an incoming chunked transfer writes into
+        DIRECTLY (no staging buffer — at GiB sizes a second fresh
+        allocation measurably hurts, see benchmarks/broadcast_bench.py).
+        The size table is written here (this store owns the packed
+        layout, shared with ``serialization.unpack_frames``); the caller
+        fills the returned PAYLOAD view, then :meth:`seal` publishes.
+        Until then the 4-byte frame count is zero, so concurrent
+        attachers see not-ready (the ``_safe_unpack`` contract), never
+        torn frames. Returns None if the object already has a segment
+        (or pending transfer) here."""
+        import struct as _struct
+
+        header = _struct.pack("<I", 0) + b"".join(
+            _struct.pack("<Q", s) for s in frame_sizes)
+        nbytes = len(header) + sum(frame_sizes)
+        with self._lock:
+            if object_id in self._pending:
+                # A transfer of this object is already in flight in THIS
+                # process; a second writer would corrupt the first's
+                # bookkeeping at seal time.
+                return None
+            if self._used + nbytes > self._capacity:
+                self._spill_lru(self._used + nbytes - self._capacity)
+            try:
+                shm = _open_shm(self._name(object_id), create=True,
+                                size=nbytes)
+            except FileExistsError:
+                return None
+            # Reserve now: concurrent pending transfers must see each
+            # other's bytes or the store overcommits its capacity.
+            self._used += nbytes
+            self._pending[object_id] = (shm, nbytes, len(frame_sizes))
+        shm.buf[4:len(header)] = header[4:]
+        return memoryview(shm.buf)[len(header):]
+
+    def seal(self, object_id: ObjectID) -> None:
+        """Publish a pending segment: the frame count lands LAST.
+
+        Plain Python stores publish-after-write — like the pure-Python
+        ``pack_frames_into`` path, ordering is guaranteed on TSO
+        hardware (x86, every supported TPU VM host); weakly-ordered
+        CPUs would need the native codec's release fence here."""
+        import struct as _struct
+
+        with self._lock:
+            shm, n, num_frames = self._pending.pop(object_id)
+            shm.buf[:4] = _struct.pack("<I", num_frames)
+            self._owned[object_id] = (shm, n, None)
+
+    def clear_stale_segment(self, object_id: ObjectID) -> bool:
+        """Unlink a half-written (count-0) segment left by a crashed
+        transfer so a new writer can claim the name."""
+        return self._clear_if_stale(self._name(object_id))
+
+    def abort_pending(self, object_id: ObjectID) -> None:
+        """Drop a pending segment after a failed transfer."""
+        with self._lock:
+            ent = self._pending.pop(object_id, None)
+            if ent is None:
+                return
+            shm, n, _ = ent
+            self._used -= n
+        # Unlink FIRST (independent of open mappings): close() raises
+        # BufferError while the writer's aborted view is still alive,
+        # which must not leave the count-0 segment squatting the name.
+        # And only unlink if the name still maps to OUR inode — a
+        # clobbering producer may have re-created a complete segment
+        # under this name (see _clear_if_stale), which must survive.
+        try:
+            mine = os.fstat(shm._fd).st_ino == os.stat(
+                f"/dev/shm/{shm.name.lstrip('/')}").st_ino
+        except OSError:
+            mine = False  # name already gone or unreadable
+        try:
+            if mine:
+                shm.unlink()
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+        try:
+            shm.close()
+        except BufferError:
+            pass  # writer's view still alive; fd goes with the process
 
     @staticmethod
     def _safe_unpack(buf) -> Optional[List[memoryview]]:
@@ -224,15 +338,33 @@ class SharedMemoryStore:
                     return unpack_frames(f.read())
             if object_id in self._attached:
                 shm = self._attached[object_id]
-                return self._safe_unpack(memoryview(shm.buf).toreadonly())
+                frames = self._safe_unpack(
+                    memoryview(shm.buf).toreadonly())
+                if frames is not None:
+                    return frames
+                # Not ready. The mapping may be an orphaned inode (the
+                # segment was cleared and re-created under this name by
+                # a racing writer): drop it so THIS call re-opens by
+                # NAME and sees the live segment.
+                self._attached.pop(object_id, None)
         # Attach to a segment owned by another process on this host.
         try:
             shm = _open_shm(self._name(object_id))
         except FileNotFoundError:
             return None
+        frames = self._safe_unpack(memoryview(shm.buf).toreadonly())
+        if frames is None:
+            # Mid-write (count 0): don't cache the mapping — a clobber
+            # would strand it on an orphaned inode. No views escaped, so
+            # closing is safe.
+            try:
+                shm.close()
+            except BufferError:  # pragma: no cover - paranoia
+                pass
+            return None
         with self._lock:
             self._attached[object_id] = shm
-        return self._safe_unpack(memoryview(shm.buf).toreadonly())
+        return frames
 
     def contains(self, object_id: ObjectID) -> bool:
         if object_id in self._owned or object_id in self._attached:

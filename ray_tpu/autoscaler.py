@@ -263,6 +263,11 @@ class Autoscaler:
             lambda s: (s["pending_lease_requests"]
                        + s["unplaced_pg_bundles"] + 1) // 2)
         self._idle_since: Dict[str, float] = {}
+        # (launch time, units) — just-launched capacity the demand
+        # signal can't see yet (hosts still registering); counted
+        # against demand for launch_grace_s to prevent double-launch.
+        self.launch_grace_s = 30.0
+        self._recent_launches: List[tuple] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.events: List[str] = []  # human-readable scaling decisions
@@ -277,13 +282,19 @@ class Autoscaler:
     def reconcile_once(self) -> None:
         state = self._demand()
         nodes = self.provider.non_terminated_nodes()
-        pending = self.demand_fn(state)
+        now = time.time()
+        self._recent_launches = [
+            (t, c) for t, c in self._recent_launches
+            if now - t < self.launch_grace_s]
+        pending = self.demand_fn(state) \
+            - sum(c for _, c in self._recent_launches)
         if pending > 0 and len(nodes) < self.max_nodes:
             n_new = min(self.max_nodes - len(nodes), pending)
             for _ in range(n_new):
                 node_id = self.provider.create_node(self.node_resources)
                 self.events.append(
                     f"scale-up {node_id[:12]} (pending={pending})")
+            self._recent_launches.append((time.time(), n_new))
             return
         # Scale down: retire provider units idle past the timeout. A
         # unit spanning several cluster nodes (a TPU slice) is idle only

@@ -520,6 +520,9 @@ class CoreWorker:
         self._lineage_freed: set = set()
         self._recoveries: Dict[bytes, Any] = {}
         self._registered_copies: set = set()
+        # oid binary -> asyncio.Event: one chunked pull per object per
+        # process; concurrent getters wait and then read the copy.
+        self._inflight_pulls: Dict[bytes, asyncio.Event] = {}
         # TCP channel endpoints (see chan_write/chan_read).
         self._chan_lock = threading.Lock()
         self._chan_in: Dict[str, dict] = {}
@@ -1077,6 +1080,32 @@ class CoreWorker:
         total = sum(frame_sizes)
         chunk = self._TRANSFER_CHUNK
         oid_hex = ref.object_id.hex()
+        # In-process dedup: N tasks getting the same big ref must not
+        # race N transfers (and two pending segments under one name
+        # would corrupt seal bookkeeping). Late waiters whose puller
+        # failed fall through and pull themselves.
+        key = ref.object_id.binary()
+        loop = asyncio.get_running_loop()
+        while True:
+            inflight = self._inflight_pulls.get(key)
+            if inflight is None:
+                break
+            await inflight.wait()
+            frames = await loop.run_in_executor(
+                None, self.shm_store.get, ref.object_id)
+            if frames is not None:
+                return frames
+        done = asyncio.Event()
+        self._inflight_pulls[key] = done
+        try:
+            return await self._pull_chunked_inner(
+                ref, frame_sizes, source_hint, total, chunk, oid_hex)
+        finally:
+            done.set()
+            self._inflight_pulls.pop(key, None)
+
+    async def _pull_chunked_inner(self, ref: ObjectRef, frame_sizes,
+                                  source_hint, total, chunk, oid_hex):
         # Domain dedup: if a peer in our shm domain is already pulling
         # this object, wait for its copy and attach instead of moving
         # the same bytes again.
@@ -1141,7 +1170,31 @@ class CoreWorker:
                 pass
         if not sources:
             sources = [ref.owner_address]
-        buf = bytearray(total)
+        # Chunks land DIRECTLY in the destination shm segment (size
+        # table written up front, frame count sealed last): a GiB-scale
+        # staging bytearray would be a second giant fresh allocation,
+        # and first-touch page faults at that size are the dominant
+        # cost on large transfers.
+        dview = self.shm_store.create_pending(ref.object_id, frame_sizes)
+        if dview is None:
+            # A segment already exists in this domain: a peer landed the
+            # copy (read it) or is mid-write (count still 0 — poll until
+            # it seals). After a grace period a still-count-0 segment is
+            # a crashed puller's leftover: clear it and take over.
+            loop = asyncio.get_running_loop()
+            deadline = time.time() + 10.0
+            while dview is None:
+                frames = await loop.run_in_executor(
+                    None, self.shm_store.get, ref.object_id)
+                if frames is not None:
+                    return frames
+                await asyncio.sleep(0.05)
+                if time.time() > deadline:
+                    self.shm_store.clear_stale_segment(ref.object_id)
+                    dview = self.shm_store.create_pending(
+                        ref.object_id, frame_sizes)
+                    if dview is None:
+                        deadline = time.time() + 10.0  # recreated: rewait
         sem = asyncio.Semaphore(4)  # admission: chunks in flight
 
         async def fetch(i: int, off: int):
@@ -1162,7 +1215,7 @@ class CoreWorker:
                         conn = await self._get_conn(src)
                         m, bufs = await conn.call("object_chunk", payload)
                         if m.get("found"):
-                            buf[off:off + length] = bufs[0]
+                            dview[off:off + length] = bufs[0]
                             return
                     except Exception as e:  # noqa: BLE001 - try next src
                         last_exc = e
@@ -1170,19 +1223,17 @@ class CoreWorker:
                 f"chunk {off}..{off + length} of {ref} unavailable "
                 f"from any copy ({last_exc})")
 
-        await asyncio.gather(*(
-            fetch(i, off)
-            for i, off in enumerate(range(0, total, chunk))))
-        frames, pos = [], 0
-        view = memoryview(buf)
-        for s in frame_sizes:
-            frames.append(view[pos:pos + s])
-            pos += s
-        # The multi-MB store memcpy runs off the IO loop.
-        await asyncio.get_running_loop().run_in_executor(
-            None, self._store_frames, ref.object_id, frames)
+        try:
+            await asyncio.gather(*(
+                fetch(i, off)
+                for i, off in enumerate(range(0, total, chunk))))
+        except BaseException:
+            self.shm_store.abort_pending(ref.object_id)
+            raise
+        self.shm_store.seal(ref.object_id)
+        self.memory_store.put(ref.object_id, None)  # marker: lives in shm
         self._register_object_copy(ref.object_id, frame_sizes)
-        return frames
+        return self.shm_store.get(ref.object_id)
 
     def _push_to_head(self, method: str, payload: dict):
         """Best-effort fire-and-forget push to the head from ANY thread
@@ -3282,7 +3333,11 @@ class CoreWorker:
             try:
                 self.head_call("report_spans", spans)
             except Exception:
-                pass
+                # Head unreachable (e.g. crash-restart window): put the
+                # spans back for the next flush — traces covering a
+                # failure window are the ones worth keeping. The deque
+                # bound caps memory if the head stays gone.
+                tracing.requeue(spans)
         self.flush_metrics()
 
     def flush_metrics(self):

@@ -178,6 +178,13 @@ def drain() -> List[dict]:
             return out
 
 
+def requeue(spans: List[dict]) -> None:
+    """Return drained spans to the buffer after a failed flush (oldest
+    first, so a healthy next flush preserves order; the deque bound
+    drops the oldest if the head stays unreachable)."""
+    _buffer.extendleft(reversed(spans))
+
+
 def local_spans() -> List[dict]:
     """Finished spans still buffered in this process (testing hook)."""
     return list(_buffer)

@@ -48,6 +48,87 @@ def test_shm_domains_isolate(two_node_cluster):
     a.delete(oid)
 
 
+def test_create_clobbers_stale_pending_segment():
+    """A producer's create must overwrite a half-written (count-0)
+    leftover segment — e.g. a crashed pull racing lineage recovery —
+    instead of treating it as an idempotent existing copy."""
+    import time as _time
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import SharedMemoryStore
+
+    dom = f"clobber-{os.getpid()}-{int(_time.time())}"
+    store = SharedMemoryStore(1 << 24, domain=dom)
+    reader = SharedMemoryStore(1 << 24, domain=dom)
+    oid = ObjectID.from_random()
+
+    # A pending (unsealed) segment: attachers must see not-ready.
+    view = store.create_pending(oid, [3, 3])
+    assert view is not None
+    assert reader.get(oid) is None
+    # A second pending for the same object in the same store is refused.
+    assert store.create_pending(oid, [64]) is None
+
+    # The producer lands the real value over the stale pending segment.
+    frames = [b"hdr", b"body"]
+    store2 = SharedMemoryStore(1 << 24, domain=dom)
+    store2.create(oid, frames)
+    # The loser's abort must NOT unlink the successor's complete copy
+    # (it checks the name still maps to its own inode).
+    store.abort_pending(oid)
+    got = reader.get(oid)
+    assert got is not None and bytes(got[1]) == b"body"
+    store2.delete(oid)
+
+
+def test_pending_seal_publishes():
+    """create_pending → write → seal roundtrip: count lands last and
+    readers in the same domain attach the sealed copy."""
+    import time as _time
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import SharedMemoryStore
+
+    dom = f"seal-{os.getpid()}-{int(_time.time())}"
+    store = SharedMemoryStore(1 << 24, domain=dom)
+    reader = SharedMemoryStore(1 << 24, domain=dom)
+    oid = ObjectID.from_random()
+    frames = [b"h", b"payload-bytes"]
+    view = store.create_pending(oid, [len(f) for f in frames])
+    off = 0
+    for f in frames:
+        view[off:off + len(f)] = f
+        off += len(f)
+    assert reader.get(oid) is None  # count still 0
+    store.seal(oid)
+    got = reader.get(oid)
+    assert got is not None and bytes(got[1]) == b"payload-bytes"
+    store.delete(oid)
+
+
+def test_concurrent_same_ref_pulls(two_node_cluster):
+    """Several tasks on one node consuming the SAME big remote ref: one
+    transfer, every consumer gets the value (in-process pull dedup)."""
+    cluster, n1, n2 = two_node_cluster
+
+    @rt.remote
+    def produce():
+        return np.full(1 << 19, 3.0, dtype=np.float32)
+
+    @rt.remote
+    def consume(x, _i):
+        return float(x[0])
+
+    r = produce.options(
+        scheduling_strategy=rt.NodeAffinitySchedulingStrategy(
+            node_id=n1.node_id, soft=False)).remote()
+    outs = [consume.options(
+        scheduling_strategy=rt.NodeAffinitySchedulingStrategy(
+            node_id=n2.node_id, soft=False)).remote(r, i)
+        for i in range(6)]
+    assert rt.get(outs, timeout=120) == [3.0] * 6
+
+
 def test_cross_node_chunked_pull(two_node_cluster):
     """A multi-chunk array produced on node 1 is consumed on node 2 —
     only the chunk protocol can move it (domains don't share shm)."""
