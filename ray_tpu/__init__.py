@@ -13,6 +13,7 @@ from .api import (  # noqa: F401
     ActorClass,
     ActorHandle,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroup,
     PlacementGroupSchedulingStrategy,
     available_resources,

@@ -535,6 +535,9 @@ class HeadService:
           (reference: ``spread_scheduling_policy.h``).
         - NODE_AFFINITY: the named node; ``soft`` falls back to hybrid
           (reference: ``node_affinity_scheduling_policy.h``).
+        - NODE_LABEL: nodes carrying every hard label; soft-label
+          matches preferred among them (reference:
+          ``node_label_scheduling_policy.h``).
         """
         kind = (strategy or {}).get("kind", "DEFAULT") if isinstance(
             strategy, dict) else "DEFAULT"
@@ -551,6 +554,19 @@ class HeadService:
             if not strategy.get("soft"):
                 return None
             kind = "DEFAULT"
+        if kind == "NODE_LABEL":
+            hard = strategy.get("hard_labels") or {}
+            soft = strategy.get("soft_labels") or {}
+            feasible = [n for n in fitting
+                        if all(n.labels.get(k) == v
+                               for k, v in hard.items())]
+            if not feasible:
+                return None
+            preferred = [n for n in feasible
+                         if all(n.labels.get(k) == v
+                                for k, v in soft.items())]
+            pool = preferred or feasible
+            return min(pool, key=lambda n: n.utilization())
         if kind == "SPREAD":
             self._spread_rr += 1
             order = sorted(fitting, key=lambda n: n.node_id)

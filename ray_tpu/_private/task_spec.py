@@ -24,12 +24,18 @@ class TaskType(enum.Enum):
 class SchedulingStrategy:
     """Default hybrid policy unless a specific target is set."""
 
-    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    # DEFAULT | SPREAD | NODE_AFFINITY | NODE_LABEL | PLACEMENT_GROUP
+    kind: str = "DEFAULT"
     node_id: Optional[NodeID] = None
     soft: bool = False
     placement_group_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     capture_child_tasks: bool = False
+    # NODE_LABEL: nodes must carry every `hard_labels` pair; among those,
+    # `soft_labels` matches are preferred (reference:
+    # ``node_label_scheduling_policy.h`` + common.proto NodeLabel oneof).
+    hard_labels: Optional[Dict[str, str]] = None
+    soft_labels: Optional[Dict[str, str]] = None
 
 
 @dataclass

@@ -255,6 +255,22 @@ class NodeAffinitySchedulingStrategy:
         self.soft = soft
 
 
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes by label (reference:
+    ``ray.util.scheduling_strategies.NodeLabelSchedulingStrategy``):
+    ``hard`` pairs are required, ``soft`` pairs preferred among the
+    hard-feasible nodes. Node labels come from ``cluster_utils.Cluster
+    .add_node(labels=...)`` / ``node_main --labels``."""
+
+    def __init__(self, hard: Optional[Dict[str, str]] = None,
+                 soft: Optional[Dict[str, str]] = None):
+        if not hard and not soft:
+            raise ValueError("NodeLabelSchedulingStrategy needs at least "
+                             "one hard or soft label")
+        self.hard = dict(hard or {})
+        self.soft = dict(soft or {})
+
+
 def _resources_from_options(opts: Dict[str, Any]) -> Dict[str, float]:
     res = dict(opts.get("resources") or {})
     num_cpus = opts.get("num_cpus")
@@ -275,6 +291,9 @@ def _strategy_from_options(opts) -> Optional[SchedulingStrategy]:
     if isinstance(s, NodeAffinitySchedulingStrategy):
         return SchedulingStrategy(kind="NODE_AFFINITY", node_id=s.node_id,
                                   soft=s.soft)
+    if isinstance(s, NodeLabelSchedulingStrategy):
+        return SchedulingStrategy(kind="NODE_LABEL", hard_labels=s.hard,
+                                  soft_labels=s.soft)
     if isinstance(s, PlacementGroupSchedulingStrategy):
         return SchedulingStrategy(
             kind="PLACEMENT_GROUP",

@@ -406,6 +406,10 @@ class _LeaseCache:
         elif strategy is not None and strategy.kind == "NODE_AFFINITY":
             # Affinity leases must not be reused for other targets.
             extra = ("aff", strategy.node_id, strategy.soft)
+        elif strategy is not None and strategy.kind == "NODE_LABEL":
+            extra = ("label",
+                     tuple(sorted((strategy.hard_labels or {}).items())),
+                     tuple(sorted((strategy.soft_labels or {}).items())))
         elif strategy is not None and strategy.kind == "SPREAD":
             extra = ("spread",)
         if runtime_env_hash:
@@ -1867,6 +1871,8 @@ class CoreWorker:
                 "bundle_index": strategy.bundle_index,
                 "node_id": strategy.node_id,
                 "soft": strategy.soft,
+                "hard_labels": strategy.hard_labels,
+                "soft_labels": strategy.soft_labels,
             }}
         if not pre_counted:
             self._lease_requests_inflight[shape] += 1
@@ -1950,6 +1956,8 @@ class CoreWorker:
                 "bundle_index": strategy.bundle_index,
                 "node_id": strategy.node_id,
                 "soft": strategy.soft,
+                "hard_labels": strategy.hard_labels,
+                "soft_labels": strategy.soft_labels,
             },
         }
         st = {"state": "PENDING", "address": None, "error": None,

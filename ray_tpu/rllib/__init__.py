@@ -20,6 +20,7 @@ from .connectors import (  # noqa: F401
 )
 from .conv_module import ConvModule  # noqa: F401
 from .dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
+from .dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401
 from .env_runner import (  # noqa: F401
     EnvRunnerGroup,
     SampleBatch,

@@ -106,6 +106,11 @@ class SingleAgentEnvRunner:
                     self.episode_returns[i] = 0.0
                     if self.connector is not None:
                         self.connector.reset(i)
+                    # Recurrent modules (DreamerV3's RSSM) carry
+                    # per-slot state across steps; a new episode must
+                    # start from the zero state.
+                    if hasattr(self.module, "on_episode_reset"):
+                        self.module.on_episode_reset(i)
                     o = env.reset()[0]
                     o = self._connect(
                         np.asarray(o, np.float32)[None], slots=[i])[0]
@@ -122,8 +127,16 @@ class SingleAgentEnvRunner:
         # successor, not of the reset obs that follows in the buffer
         trunc_only = trunc_buf & ~done_buf
         if trunc_only.any():
-            next_val_buf[trunc_only] = self.module.forward_values(
-                final_buf[trunc_only])
+            if getattr(self.module, "recurrent", False):
+                # A recurrent module keys internal state by env slot;
+                # a masked sub-batch would misalign rows to slots, so
+                # tell it which slots these rows belong to.
+                slots = np.nonzero(trunc_only)[1]
+                next_val_buf[trunc_only] = self.module.forward_values(
+                    final_buf[trunc_only], slots=slots)
+            else:
+                next_val_buf[trunc_only] = self.module.forward_values(
+                    final_buf[trunc_only])
         # terminated states bootstrap 0
         next_val_buf[done_buf] = 0.0
 

@@ -40,6 +40,45 @@ def _node_of():
     return whereami
 
 
+def test_node_label_scheduling():
+    """NODE_LABEL strategy (reference:
+    ``node_label_scheduling_policy.h``): hard labels select, soft labels
+    prefer, and an unsatisfiable hard selector fails the lease."""
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    c = Cluster(head_resources={"CPU": 0})
+    c.add_node(num_cpus=2, labels={"zone": "a", "disk": "ssd"})
+    c.add_node(num_cpus=2, labels={"zone": "b"})
+    rt = c.connect()
+    try:
+        whereami = _node_of()
+        strat = rt.NodeLabelSchedulingStrategy
+        ssd, zb = c._nodes
+
+        on_ssd = rt.get(whereami.options(
+            scheduling_strategy=strat(hard={"disk": "ssd"})).remote())
+        assert on_ssd == ssd.node_id
+
+        on_b = rt.get(whereami.options(
+            scheduling_strategy=strat(hard={"zone": "b"})).remote())
+        assert on_b == zb.node_id
+
+        # Soft-only: prefers the match but never blocks.
+        pref = rt.get(whereami.options(
+            scheduling_strategy=strat(soft={"zone": "b"})).remote())
+        assert pref == zb.node_id
+
+        # Unsatisfiable hard selector: lease times out as an error.
+        with pytest.raises(Exception):
+            rt.get(whereami.options(
+                scheduling_strategy=strat(hard={"zone": "mars"})).remote(),
+                timeout=10)
+    finally:
+        c.shutdown()
+
+
 def test_spread_uses_both_nodes(cluster2):
     c, rt = cluster2
     whereami = _node_of()

@@ -1,0 +1,188 @@
+"""DreamerV3: symlog/twohot invariants, world-model learning on a
+predictable env, imagination-driven policy improvement, e2e Algorithm.
+
+Mirrors the reference's DreamerV3 coverage
+(``rllib/algorithms/dreamerv3/tests/test_dreamerv3.py`` — compile/run of
+the training loop; learning gates live in tuned examples)."""
+import numpy as np
+import pytest
+
+
+def test_symlog_twohot_roundtrip():
+    from ray_tpu.rllib import dreamerv3 as d
+
+    x = np.array([-55.0, -1.0, 0.0, 0.3, 7.0, 400.0], np.float32)
+    np.testing.assert_allclose(d.symexp(d.symlog(x)), x, rtol=1e-5,
+                               atol=1e-5)
+    # twohot is an exact two-bin interpolation: decoding recovers the
+    # value for anything inside the support.
+    y = np.array([-10.0, -0.5, 0.0, 1.7, 12.0], np.float32)
+    enc = d.twohot(y)
+    assert enc.shape == (5, d.NUM_BINS)
+    np.testing.assert_allclose(enc.sum(-1), 1.0, atol=1e-6)
+    dec = enc @ d._bins()
+    np.testing.assert_allclose(dec, y, rtol=1e-4, atol=1e-4)
+
+
+class _CounterEnv:
+    """Deterministic chain: obs counts up, reward = +1 on action 1 at
+    even steps else 0 — world model must become able to predict both."""
+
+    class _Space:
+        def __init__(self, n=None, shape=None):
+            self.n = n
+            self.shape = shape
+
+    def __init__(self):
+        self.observation_space = self._Space(shape=(3,))
+        self.action_space = self._Space(n=2)
+        self.t = 0
+
+    def reset(self, seed=None):
+        self.t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        return np.array([self.t / 10.0, (self.t % 2), 1.0], np.float32)
+
+    def step(self, action):
+        rew = 1.0 if (self.t % 2 == 0 and action == 1) else 0.0
+        self.t += 1
+        done = self.t >= 20
+        return self._obs(), rew, done, False, {}
+
+    def close(self):
+        pass
+
+
+def _tiny_config():
+    from ray_tpu.rllib import DreamerV3Config
+
+    cfg = DreamerV3Config().environment(env_creator=_CounterEnv)
+    cfg.deter_dim = 32
+    cfg.units = 32
+    cfg.stoch_dims = 4
+    cfg.stoch_classes = 4
+    cfg.horizon = 5
+    cfg.seq_len = 8
+    cfg.batch_seqs = 4
+    cfg.lr = 3e-4
+    cfg.rollout_fragment_length = 32
+    cfg.num_steps_before_learning = 32
+    cfg.updates_per_iteration = 4
+    return cfg
+
+
+def test_world_model_learns_predictable_env():
+    """WM losses (recon + reward) drop sharply on a deterministic env."""
+    import jax
+
+    from ray_tpu.rllib import dreamerv3 as d
+
+    cfg = _tiny_config()
+    spec = cfg.module_spec()
+    learner = d.DreamerV3Learner(spec, cfg, seed=0)
+
+    # Scripted experience from the counter env.
+    env, rng = _CounterEnv(), np.random.default_rng(0)
+    frags = {"obs": [], "actions": [], "rewards": [], "dones": [],
+             "is_first": []}
+    obs, _ = env.reset()
+    seq = {k: [] for k in frags}
+    first = True
+    for _ in range(512):
+        a = int(rng.integers(2))
+        nxt, r, done, _, _ = env.step(a)
+        seq["obs"].append(obs)
+        seq["actions"].append(a)
+        seq["rewards"].append(r)
+        seq["dones"].append(float(done))
+        seq["is_first"].append(float(first))
+        first = done
+        obs = env.reset()[0] if done else nxt
+    n = (len(seq["obs"]) // cfg.seq_len) * cfg.seq_len
+    batchify = lambda k: np.asarray(  # noqa: E731
+        seq[k][:n], np.float32).reshape(-1, cfg.seq_len)
+
+    full = {
+        "obs": np.asarray(seq["obs"][:n], np.float32).reshape(
+            -1, cfg.seq_len, 3),
+        "actions": batchify("actions"),
+        "rewards": batchify("rewards"),
+        # counter env only terminates (never truncates): terms == dones
+        "terms": batchify("dones"),
+        "is_first": batchify("is_first"),
+    }
+
+    key = jax.random.PRNGKey(0)
+    _, m0 = learner.wm_only(learner.params, key, full)
+    for _ in range(150):
+        learner.update(full)
+    _, m1 = learner.wm_only(learner.params, key, full)
+    assert float(m1["wm/obs"]) < 0.5 * float(m0["wm/obs"]), (m0, m1)
+    assert float(m1["wm/reward"]) < 0.8 * float(m0["wm/reward"]), (m0, m1)
+
+
+def test_dreamer_e2e_and_checkpoint(tmp_path):
+    """Full Algorithm loop: sample → replay → update → sync; metrics are
+    finite and state round-trips through save/restore."""
+    from ray_tpu.rllib import dreamerv3 as d
+
+    algo = _tiny_config().build()
+    try:
+        for _ in range(3):
+            m = algo.train()
+        assert m["num_updates"] > 0
+        assert np.isfinite(m["loss"])
+        assert np.isfinite(m["ac/entropy"])
+        assert m["replay_fragments"] >= 1
+
+        path = algo.save_to_path(str(tmp_path / "ckpt"))
+        w0 = algo.learner_group.get_state()["params"]["actor"][0]["w"].copy()
+        algo.train()
+        algo.restore_from_path(path)
+        w1 = algo.learner_group.get_state()["params"]["actor"][0]["w"]
+        np.testing.assert_array_equal(w0, w1)
+    finally:
+        algo.stop()
+
+
+def test_imagination_trains_the_actor():
+    """The imagination pathway delivers gradient to the actor: over a
+    dozen iterations on the deterministic counter env the policy
+    entropy falls from ln(2) as the world model's reward predictions
+    sharpen, and returns do not degrade below random (~5)."""
+    cfg = _tiny_config()
+    cfg.updates_per_iteration = 16
+    algo = cfg.build()
+    try:
+        ents, rets = [], []
+        for _ in range(12):
+            m = algo.train()
+            ents.append(m["ac/entropy"])
+            if m.get("episode_return_mean") is not None:
+                rets.append(m["episode_return_mean"])
+        assert ents[-1] < 0.685, ents  # moved off ln(2) = uniform
+        assert ents[-1] < ents[0], ents
+        assert rets[-1] > 5.0, rets
+    finally:
+        algo.stop()
+
+
+def test_recurrent_module_state_resets():
+    """The acting module carries per-slot RSSM state and zeroes it on
+    episode reset (the env-runner hook)."""
+    from ray_tpu.rllib import dreamerv3 as d
+
+    cfg = _tiny_config()
+    spec = cfg.module_spec()
+    mod = d.DreamerV3Module(spec, seed=0, cfg=cfg)
+    rng = np.random.default_rng(0)
+    obs = np.ones((2, 3), np.float32)
+    mod.forward_exploration(obs, rng)
+    assert 0 in mod._state and 1 in mod._state
+    h_before = mod._state[0][0].copy()
+    mod.forward_exploration(obs, rng)
+    assert not np.allclose(mod._state[0][0], h_before)  # state evolved
+    mod.on_episode_reset(0)
+    assert 0 not in mod._state and 1 in mod._state
