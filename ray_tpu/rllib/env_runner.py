@@ -82,6 +82,9 @@ class SingleAgentEnvRunner:
         val_buf = np.zeros((T, N), np.float32)
         # true successor obs at truncation points (see bootstrap below)
         final_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        # (t, slot) -> bootstrap value captured at truncation time with
+        # the episode's own recurrent state (stateful modules only)
+        recurrent_trunc_vals: dict = {}
 
         for t in range(T):
             actions, logp, values = self.module.forward_exploration(
@@ -104,6 +107,15 @@ class SingleAgentEnvRunner:
                 if term or trunc:
                     self.completed_returns.append(self.episode_returns[i])
                     self.episode_returns[i] = 0.0
+                    if trunc and not term and \
+                            getattr(self.module, "recurrent", False):
+                        # The truncated state's bootstrap value must use
+                        # THIS episode's recurrent state — capture it
+                        # now, before the slot state is reset (and later
+                        # overwritten by the next episode).
+                        recurrent_trunc_vals[(t, i)] = float(
+                            self.module.forward_values(
+                                final_buf[t, i][None], slots=[i])[0])
                     if self.connector is not None:
                         self.connector.reset(i)
                     # Recurrent modules (DreamerV3's RSSM) carry
@@ -128,12 +140,11 @@ class SingleAgentEnvRunner:
         trunc_only = trunc_buf & ~done_buf
         if trunc_only.any():
             if getattr(self.module, "recurrent", False):
-                # A recurrent module keys internal state by env slot;
-                # a masked sub-batch would misalign rows to slots, so
-                # tell it which slots these rows belong to.
-                slots = np.nonzero(trunc_only)[1]
-                next_val_buf[trunc_only] = self.module.forward_values(
-                    final_buf[trunc_only], slots=slots)
+                # Values were captured at truncation time, before the
+                # slot's recurrent state was reset (computing them here
+                # would read the NEXT episode's state).
+                for (t, i), v in recurrent_trunc_vals.items():
+                    next_val_buf[t, i] = v
             else:
                 next_val_buf[trunc_only] = self.module.forward_values(
                     final_buf[trunc_only])

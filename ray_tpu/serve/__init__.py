@@ -10,7 +10,7 @@ from .api import (Application, Deployment, delete, deployment,
                   get_app_handle, get_deployment_handle, run, shutdown,
                   start, status)
 from .batching import batch, default_buckets, pad_to_bucket
-from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .config import (AutoscalingConfig, DeploymentConfig, HTTPOptions, gRPCOptions)
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -19,7 +19,7 @@ from .request import Request, Response
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
-    "HTTPOptions", "Request",
+    "HTTPOptions", "gRPCOptions", "Request",
     "Response", "batch", "default_buckets", "delete", "deployment",
     "get_multiplexed_model_id", "multiplexed",
     "get_app_handle", "get_deployment_handle", "pad_to_bucket", "run",

@@ -248,6 +248,99 @@ class ProxyActor:
                                   stream=True)
         return handle.remote(req)
 
+    # ---------------------------------------------------------- gRPC ingress
+    def start_grpc(self, host: str, port: int) -> dict:
+        """gRPC ingress next to HTTP (reference: ``proxy.py:534``
+        ``gRPCProxy`` — one proxy actor serves both protocols).
+
+        Generic-handler server: ANY method path is accepted and routed
+        by the ``application`` request metadata (reference behavior) or,
+        absent that, the first path segment (``/<app>/Method``). Request
+        and response messages are raw bytes — schema belongs to the
+        application (a deployment returning bytes passes through
+        verbatim; other values use the same ``encode_body`` rules as
+        HTTP). Streaming deployments answer server-streaming calls with
+        one message per yielded item.
+        """
+        import grpc
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                md = dict(call_details.invocation_metadata or ())
+                method = call_details.method or ""
+                target = proxy._grpc_target(md.get("application"), method)
+                if target is None:
+                    return None  # grpc answers UNIMPLEMENTED
+                if target.get("stream"):
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._grpc_stream_call(target, method))
+                return grpc.unary_unary_rpc_method_handler(
+                    proxy._grpc_unary_call(target, method))
+
+        server = grpc.server(self._pool, handlers=(_Generic(),))
+        bound = server.add_insecure_port(f"{host}:{port}")
+        if not bound:
+            raise RuntimeError(f"grpc ingress failed to bind {host}:{port}")
+        server.start()
+        self._grpc_server = server
+        return {"host": host, "grpc_port": bound}
+
+    def _grpc_target(self, app_name: Optional[str],
+                     method: str) -> Optional[dict]:
+        routes = self._get_routes()
+        if app_name:
+            for prefix, t in routes.items():
+                if t["app"] == app_name:
+                    return {**t, "prefix": prefix}
+            return None
+        seg = method.strip("/").split("/", 1)[0].split(".")[0]
+        for prefix, t in routes.items():
+            if t["app"] == seg or prefix.strip("/") == seg:
+                return {**t, "prefix": prefix}
+        return None
+
+    def _grpc_request(self, method: str, data: bytes, context) -> Request:
+        headers = {k: v for k, v in (context.invocation_metadata() or ())
+                   if isinstance(v, str)}
+        headers["grpc-method"] = method
+        return Request(method="GRPC", path=method, headers=headers,
+                       body=bytes(data))
+
+    def _grpc_unary_call(self, target: dict, method: str):
+        def call(data, context):
+            try:
+                result = self._call_app(
+                    target, self._grpc_request(method, data, context))
+            except Exception as e:  # noqa: BLE001
+                import grpc
+
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+                return b""
+            if isinstance(result, Response):
+                _, _, body = result.encode()
+                return body
+            return encode_body(result)[1]
+
+        return call
+
+    def _grpc_stream_call(self, target: dict, method: str):
+        def call(data, context):
+            try:
+                gen = self._call_app_stream(
+                    target, self._grpc_request(method, data, context))
+                for item in gen:
+                    yield encode_body(item)[1]
+            except Exception as e:  # noqa: BLE001
+                import grpc
+
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        return call
+
 
 def _reason(status: int) -> bytes:
     return {200: b"OK", 404: b"Not Found", 500: b"Internal Server Error",

@@ -20,7 +20,8 @@ import cloudpickle
 from .. import api as rt
 from ..exceptions import RayTpuError
 from .config import (DEFAULT_APP_NAME, SERVE_CONTROLLER_NAME,
-                     AutoscalingConfig, DeploymentConfig, HTTPOptions)
+                     AutoscalingConfig, DeploymentConfig, HTTPOptions,
+                     gRPCOptions)
 from .handle import DeploymentHandle, _HandleMarker, reset_routers
 
 _client_lock = threading.Lock()
@@ -130,13 +131,18 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
 
 # ------------------------------------------------------------------ lifecycle
 def start(http_options: Union[None, dict, HTTPOptions] = None,
-          proxy: bool = True):
-    """Start the Serve control plane (controller + optional HTTP proxy)."""
+          proxy: bool = True,
+          grpc_options: Union[None, dict, gRPCOptions] = None):
+    """Start the Serve control plane: controller + optional HTTP proxy,
+    plus a gRPC ingress on the same proxy actor when ``grpc_options``
+    is given (reference: ``proxy.py`` HTTPProxy + gRPCProxy)."""
     if not rt.is_initialized():
         rt.init()
     if isinstance(http_options, dict):
         http_options = HTTPOptions(**http_options)
     http_options = http_options or HTTPOptions()
+    if isinstance(grpc_options, dict):
+        grpc_options = gRPCOptions(**grpc_options)
     with _client_lock:
         if _client["controller"] is None:
             _client["controller"] = _get_or_create_controller()
@@ -148,9 +154,23 @@ def start(http_options: Union[None, dict, HTTPOptions] = None,
             info = rt.get(p.start.remote(
                 http_options.host, http_options.port,
                 http_options.request_timeout_s), timeout=30)
+            if grpc_options is not None:
+                info.update(rt.get(p.start_grpc.remote(
+                    grpc_options.host, grpc_options.port), timeout=30))
             rt.get(_client["controller"].set_http_info.remote(info),
                    timeout=10)
             _client["proxy"] = p
+            _client["http"] = info
+        elif grpc_options is not None and _client["proxy"] is not None \
+                and "grpc_port" not in (_client["http"] or {}):
+            # Proxy already running (e.g. serve.run auto-started it):
+            # bind the gRPC ingress on it now rather than silently
+            # dropping the request.
+            info = dict(_client["http"] or {})
+            info.update(rt.get(_client["proxy"].start_grpc.remote(
+                grpc_options.host, grpc_options.port), timeout=30))
+            rt.get(_client["controller"].set_http_info.remote(info),
+                   timeout=10)
             _client["http"] = info
     return _client["controller"]
 

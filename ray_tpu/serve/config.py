@@ -68,5 +68,14 @@ class HTTPOptions:
     request_timeout_s: float = 60.0
 
 
+@dataclass
+class gRPCOptions:  # noqa: N801 - reference-parity name
+    """gRPC ingress bind options (reference: ``serve/config.py``
+    ``gRPCOptions`` — served by the same proxy actor as HTTP)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = pick a free port
+
+
 SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
 DEFAULT_APP_NAME = "default"

@@ -46,7 +46,7 @@ class Response:
     content_type: Optional[str] = None
 
     def encode(self):
-        body, ctype = encode_body(self.body)
+        ctype, body = encode_body(self.body)
         return self.status, self.content_type or ctype, body
 
 

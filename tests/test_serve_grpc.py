@@ -1,0 +1,136 @@
+"""Serve gRPC ingress (reference: ``serve/_private/proxy.py:534``
+``gRPCProxy``): unary and server-streaming calls route to deployments by
+application metadata, sharing the proxy actor with HTTP."""
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@pytest.fixture
+def grpc_serve(rt_cluster):
+    from ray_tpu.serve import api as serve_api
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0},
+                grpc_options={"host": "127.0.0.1", "port": 0})
+    port = serve_api._client["http"]["grpc_port"]
+    yield port
+    serve.shutdown()
+
+
+def test_grpc_unary(grpc_serve):
+    import grpc
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, req):
+            # raw request bytes + the called method in headers
+            return b"echo:" + req.body + b"@" + \
+                req.headers["grpc-method"].encode()
+
+    serve.run(Echo.bind(), name="echoapp", route_prefix="/echoapp")
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{grpc_serve}")
+    call = chan.unary_unary("/userns.Svc/Predict")
+    out = call(b"hello", metadata=(("application", "echoapp"),),
+               timeout=30)
+    assert out == b"echo:hello@/userns.Svc/Predict"
+
+    # Path-segment routing works without metadata too.
+    out2 = chan.unary_unary("/echoapp/Predict")(b"x", timeout=30)
+    assert out2.startswith(b"echo:x@")
+    chan.close()
+    serve.delete("echoapp")
+
+
+def test_grpc_unknown_app_unimplemented(grpc_serve):
+    import grpc
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{grpc_serve}")
+    with pytest.raises(grpc.RpcError) as ei:
+        chan.unary_unary("/nope.Svc/Call")(
+            b"", metadata=(("application", "ghost"),), timeout=10)
+    assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    chan.close()
+
+
+def test_grpc_server_streaming(grpc_serve):
+    import grpc
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, req):
+            n = int(req.body or b"0")
+            for i in range(n):
+                yield f"tok{i}"
+
+    serve.run(Tokens.bind(), name="tokapp", route_prefix="/tokapp")
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{grpc_serve}")
+    stream = chan.unary_stream("/tokapp/Generate")
+    items = list(stream(b"3", metadata=(("application", "tokapp"),),
+                        timeout=60))
+    assert items == [b"tok0", b"tok1", b"tok2"]
+    chan.close()
+    serve.delete("tokapp")
+
+
+def test_response_encode_tuple_order():
+    """Response.encode() returns (status, content_type, body) — a swap
+    here sent the mime string as the payload on both ingresses."""
+    from ray_tpu.serve.request import Response
+
+    status, ctype, body = Response(body=b"abc").encode()
+    assert status == 200
+    assert ctype == "application/octet-stream"
+    assert body == b"abc"
+    status, ctype, body = Response(body={"a": 1}, status=201).encode()
+    assert (status, ctype) == (201, "application/json")
+    assert body == b'{"a": 1}'
+
+
+def test_grpc_enable_after_proxy_started(rt_cluster):
+    """serve.start(grpc_options=...) after the proxy already exists must
+    bind the gRPC ingress on it, not silently no-op."""
+    import grpc
+
+    from ray_tpu.serve import api as serve_api
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    try:
+        assert "grpc_port" not in serve_api._client["http"]
+
+        @serve.deployment
+        class Late:
+            def __call__(self, req):
+                return b"late-ok"
+
+        serve.run(Late.bind(), name="lateapp", route_prefix="/lateapp")
+        serve.start(grpc_options={"host": "127.0.0.1", "port": 0})
+        port = serve_api._client["http"]["grpc_port"]
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        out = chan.unary_unary("/lateapp/Call")(b"", timeout=30)
+        assert out == b"late-ok"
+        chan.close()
+        serve.delete("lateapp")
+    finally:
+        serve.shutdown()
+
+
+def test_grpc_error_surfaces_as_internal(grpc_serve):
+    import grpc
+
+    @serve.deployment
+    class Boom:
+        def __call__(self, req):
+            raise RuntimeError("kaput")
+
+    serve.run(Boom.bind(), name="boomapp", route_prefix="/boomapp")
+    chan = grpc.insecure_channel(f"127.0.0.1:{grpc_serve}")
+    with pytest.raises(grpc.RpcError) as ei:
+        chan.unary_unary("/boomapp/Call")(
+            b"", metadata=(("application", "boomapp"),), timeout=30)
+    assert ei.value.code() == grpc.StatusCode.INTERNAL
+    assert "kaput" in ei.value.details()
+    chan.close()
+    serve.delete("boomapp")
