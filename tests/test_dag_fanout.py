@@ -28,7 +28,7 @@ def test_fan_out_fan_in(rt_cluster):
         left = a.add.bind(inp)
         right = b.add.bind(inp)
         out = agg.join.bind(left, right)
-    dag = out.experimental_compile()
+    dag = out.experimental_compile(timeout=120.0)
     try:
         for i in range(5):
             # (i+10) + (i+100)
@@ -43,7 +43,7 @@ def test_multi_output(rt_cluster):
     with InputNode() as inp:
         n1 = a.add.bind(inp)
         n2 = b.add.bind(inp)
-    dag = MultiOutputNode([n1, n2]).experimental_compile()
+    dag = MultiOutputNode([n1, n2]).experimental_compile(timeout=120.0)
     try:
         assert dag.execute(10) == [11, 12]
         assert dag.execute(20) == [21, 22]
@@ -62,7 +62,7 @@ def test_error_propagates_through_fanin(rt_cluster):
     agg = Adder.remote(0)
     with InputNode() as inp:
         out = agg.join.bind(a.add.bind(inp), bad.boom.bind(inp))
-    dag = out.experimental_compile()
+    dag = out.experimental_compile(timeout=120.0)
     try:
         with pytest.raises(Exception, match="dag boom"):
             dag.execute(1)
