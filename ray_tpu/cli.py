@@ -61,6 +61,38 @@ def _connect(info: dict):
     return rt
 
 
+def _cmd_serve(args) -> int:
+    """``serve deploy/run/status/config/shutdown`` against the running
+    cluster (reference: ``serve/scripts.py``)."""
+    from ray_tpu import serve
+    from ray_tpu.serve import schema
+
+    if args.serve_cmd == "deploy":
+        import yaml
+
+        with open(args.config_file) as f:
+            cfg = yaml.safe_load(f)
+        names = schema.deploy_config(cfg)
+        print(f"deployed applications: {', '.join(names)}")
+    elif args.serve_cmd == "run":
+        app = schema.import_application(args.import_path)
+        print(f"running app {args.name!r} at route "
+              f"{args.route_prefix!r}; ctrl-c to exit")
+        serve.run(app, name=args.name, route_prefix=args.route_prefix,
+                  blocking=True)
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=1, default=str))
+    elif args.serve_cmd == "config":
+        import yaml
+
+        cfg = schema.get_last_config()
+        print(yaml.safe_dump(cfg) if cfg else "# no config deployed")
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+    return 0
+
+
 def _cmd_start(args) -> int:
     if args.address:   # join an existing head as a node daemon
         import tempfile
@@ -177,6 +209,17 @@ def main(argv=None) -> int:
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("output", nargs="?", default="timeline.json")
     sub.add_parser("dashboard")
+    p_serve = sub.add_parser("serve")
+    serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
+    p_sdeploy = serve_sub.add_parser("deploy")
+    p_sdeploy.add_argument("config_file")
+    p_srun = serve_sub.add_parser("run")
+    p_srun.add_argument("import_path")
+    p_srun.add_argument("--name", default="default")
+    p_srun.add_argument("--route-prefix", default="/")
+    serve_sub.add_parser("status")
+    serve_sub.add_parser("config")
+    serve_sub.add_parser("shutdown")
     p_job = sub.add_parser("job")
     job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
     p_submit = job_sub.add_parser("submit")
@@ -211,6 +254,8 @@ def main(argv=None) -> int:
         return 0
     rt = _connect(info)
     try:
+        if args.cmd == "serve":
+            return _cmd_serve(args)
         if args.cmd == "status":
             summary = rt.state("summary")
             print(f"session: {info['session_dir']}")

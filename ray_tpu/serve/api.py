@@ -147,31 +147,37 @@ def start(http_options: Union[None, dict, HTTPOptions] = None,
         if _client["controller"] is None:
             _client["controller"] = _get_or_create_controller()
         if proxy and _client["proxy"] is None:
-            from ._proxy import ProxyActor
+            # Get-or-create: another process (a driver, a previous CLI
+            # invocation) may already run the named proxy — adopt it,
+            # with its recorded bind info, instead of crashing on the
+            # duplicate actor name.
+            try:
+                p = rt.get_actor("SERVE_PROXY", timeout=0.5)
+                info = dict(rt.get(
+                    _client["controller"].get_http_info.remote(),
+                    timeout=10) or {})
+            except Exception:  # noqa: BLE001 - no proxy yet: create one
+                from ._proxy import ProxyActor
 
-            p = rt.remote(ProxyActor).options(
-                name="SERVE_PROXY", max_concurrency=8).remote()
-            info = rt.get(p.start.remote(
-                http_options.host, http_options.port,
-                http_options.request_timeout_s), timeout=30)
-            if grpc_options is not None:
-                info.update(rt.get(p.start_grpc.remote(
-                    grpc_options.host, grpc_options.port), timeout=30))
-            rt.get(_client["controller"].set_http_info.remote(info),
-                   timeout=10)
+                p = rt.remote(ProxyActor).options(
+                    name="SERVE_PROXY", max_concurrency=8).remote()
+                info = rt.get(p.start.remote(
+                    http_options.host, http_options.port,
+                    http_options.request_timeout_s), timeout=30)
             _client["proxy"] = p
             _client["http"] = info
-        elif grpc_options is not None and _client["proxy"] is not None \
+        if grpc_options is not None and _client["proxy"] is not None \
                 and "grpc_port" not in (_client["http"] or {}):
-            # Proxy already running (e.g. serve.run auto-started it):
-            # bind the gRPC ingress on it now rather than silently
+            # Bind the gRPC ingress on the running proxy (whether it was
+            # just created or already existed) rather than silently
             # dropping the request.
             info = dict(_client["http"] or {})
             info.update(rt.get(_client["proxy"].start_grpc.remote(
                 grpc_options.host, grpc_options.port), timeout=30))
-            rt.get(_client["controller"].set_http_info.remote(info),
-                   timeout=10)
             _client["http"] = info
+        if _client["http"] is not None:
+            rt.get(_client["controller"].set_http_info.remote(
+                _client["http"]), timeout=10)
     return _client["controller"]
 
 
@@ -301,9 +307,17 @@ def shutdown():
                 rt.kill(ctrl)
             except Exception:  # noqa: BLE001
                 pass
-        if _client["proxy"] is not None:
+        proxy = _client["proxy"]
+        if proxy is None:
+            # A fresh process (the CLI) has no cached handle — the
+            # named actor is the source of truth.
             try:
-                rt.kill(_client["proxy"])
+                proxy = rt.get_actor("SERVE_PROXY", timeout=0.5)
+            except Exception:  # noqa: BLE001 - no proxy running
+                proxy = None
+        if proxy is not None:
+            try:
+                rt.kill(proxy)
             except Exception:  # noqa: BLE001
                 pass
         _client.update({"controller": None, "proxy": None, "http": None})
