@@ -1148,7 +1148,11 @@ class HeadService:
             if assignment is not None:
                 break
             if time.time() > deadline or self._shutting_down:
-                self.pgs.pop(pg_id, None)
+                # Keep the entry, terminally REMOVED: async creators'
+                # ready() polls must see a fast failure here — the
+                # unknown-id → PENDING fallback in pg_state only covers
+                # the create-RPC-in-flight race.
+                pg.state = "REMOVED"
                 raise rpc.RpcError(
                     f"placement group infeasible: strategy {strategy}, "
                     f"bundles {[b.resources for b in bundles]}, "
@@ -1180,8 +1184,15 @@ class HeadService:
     async def _rpc_pg_state(self, payload, bufs):
         pg_id = PlacementGroupID.from_hex(payload["pg_id"])
         pg = self.pgs.get(pg_id)
-        return {"state": pg.state if pg else "REMOVED",
-                "bundle_nodes": pg.bundle_nodes if pg else []}
+        if pg is None:
+            # Creation is async (the driver fires create_placement_group
+            # on a background thread and returns the handle at once): an
+            # unknown id here is almost always a ready() poll winning
+            # the race against the create RPC. Removed PGs keep their
+            # entry with state REMOVED, so "unknown" is not "removed" —
+            # answer PENDING and let the poller see the create land.
+            return {"state": "PENDING", "bundle_nodes": []}
+        return {"state": pg.state, "bundle_nodes": pg.bundle_nodes}
 
     # ------------------------------------------------------------- cluster
     async def _rpc_cluster_resources(self, payload, bufs):
