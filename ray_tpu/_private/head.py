@@ -116,6 +116,8 @@ class PlacementGroupInfo:
     remaining: List[Dict[str, float]] = field(default_factory=list)
     # per-bundle node assignment (node_id hex, or None while lost)
     bundle_nodes: List[Optional[str]] = field(default_factory=list)
+    # tombstone timestamp once state hits REMOVED (reaper prunes later)
+    removed_at: Optional[float] = None
 
 
 class HeadService:
@@ -137,6 +139,9 @@ class HeadService:
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[str, ActorID] = {}
         self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        # pg_state polls for ids with no entry: id -> first-seen time
+        # (grace window for the async-create race)
+        self._pg_unknown_since: Dict[PlacementGroupID, float] = {}
         self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)
         # Object copy directory (reference capability:
         # ``ownership_based_object_directory.h`` — which nodes hold a
@@ -290,6 +295,15 @@ class HeadService:
         while True:
             await asyncio.sleep(period)
             self._poll_jobs()
+            # Prune REMOVED placement-group tombstones: kept long enough
+            # for stale ready() polls to observe the terminal state, not
+            # for the head's lifetime (unbounded growth under retry
+            # loops). pg_state's unknown-id grace covers pruned ids.
+            now = time.time()
+            for pid, pg in list(self.pgs.items()):
+                if pg.state == "REMOVED" and pg.removed_at is not None \
+                        and now - pg.removed_at > 600.0:
+                    del self.pgs[pid]
             if time.time() - last_persist > 10.0:
                 last_persist = time.time()
                 try:
@@ -1144,6 +1158,10 @@ class HeadService:
         deadline = time.time() + payload.get(
             "timeout", self.config.worker_lease_timeout_s)
         while True:
+            if pg.state == "REMOVED":
+                # remove_placement_group raced the pending create: the
+                # caller's removal wins; committing would leak bundles.
+                raise rpc.RpcError("placement group removed during creation")
             assignment = self._place_bundles(bundles, strategy)
             if assignment is not None:
                 break
@@ -1153,6 +1171,7 @@ class HeadService:
                 # unknown-id → PENDING fallback in pg_state only covers
                 # the create-RPC-in-flight race.
                 pg.state = "REMOVED"
+                pg.removed_at = time.time()
                 raise rpc.RpcError(
                     f"placement group infeasible: strategy {strategy}, "
                     f"bundles {[b.resources for b in bundles]}, "
@@ -1178,6 +1197,7 @@ class HeadService:
                 if node is not None:
                     self._node_release(node, b.resources)
         pg.state = "REMOVED"
+        pg.removed_at = time.time()
         self._pump_leases()
         return {}
 
@@ -1187,11 +1207,19 @@ class HeadService:
         if pg is None:
             # Creation is async (the driver fires create_placement_group
             # on a background thread and returns the handle at once): an
-            # unknown id here is almost always a ready() poll winning
-            # the race against the create RPC. Removed PGs keep their
-            # entry with state REMOVED, so "unknown" is not "removed" —
-            # answer PENDING and let the poller see the create land.
-            return {"state": "PENDING", "bundle_nodes": []}
+            # unknown id is usually a ready() poll winning the race
+            # against the create RPC — but only briefly, since create
+            # registers the entry as its first act. Answer PENDING
+            # within a short grace window; past it the id is genuinely
+            # dead (lost create RPC, pruned tombstone, head restart) and
+            # must fail fast, not spin out the caller's whole timeout.
+            now = time.time()
+            first = self._pg_unknown_since.setdefault(pg_id, now)
+            if now - first < 10.0:
+                return {"state": "PENDING", "bundle_nodes": []}
+            self._pg_unknown_since.pop(pg_id, None)
+            return {"state": "REMOVED", "bundle_nodes": []}
+        self._pg_unknown_since.pop(pg_id, None)
         return {"state": pg.state, "bundle_nodes": pg.bundle_nodes}
 
     # ------------------------------------------------------------- cluster
@@ -1607,11 +1635,14 @@ class HeadService:
                      "death_cause": a.death_cause}
                     for a in self.actors.values()]
         if kind == "placement_groups":
+            # REMOVED entries are tombstones for stale ready() polls,
+            # not live state — they stay out of listings.
             return [{"pg_id": pg.pg_id.hex(), "state": pg.state,
                      "strategy": pg.strategy,
                      "bundles": [dict(b.resources) for b in pg.bundles],
                      "bundle_nodes": list(pg.bundle_nodes)}
-                    for pg in self.pgs.values()]
+                    for pg in self.pgs.values()
+                    if pg.state != "REMOVED"]
         if kind == "tasks":
             return list(self.task_events)[-1000:]
         if kind == "objects":
@@ -1625,7 +1656,8 @@ class HeadService:
                 "workers": len(self.workers),
                 "actors_alive": sum(1 for a in self.actors.values()
                                     if a.state == "ALIVE"),
-                "placement_groups": len(self.pgs),
+                "placement_groups": sum(1 for p in self.pgs.values()
+                                        if p.state != "REMOVED"),
                 "task_events": len(self.task_events),
                 "resources_total": dict(self._cluster_totals()),
                 "resources_available": self._available_summary(),

@@ -99,6 +99,56 @@ class Checkpoint:
             out.append(arr)
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    # ---- sharded (orbax) payload helpers --------------------------------
+    @classmethod
+    def from_sharded_state(cls, state: Any,
+                           base_dir: Optional[str] = None,
+                           name: str = "sharded",
+                           path: Optional[str] = None) -> "Checkpoint":
+        """Save a pytree of (possibly sharded) ``jax.Array``s via orbax:
+        every process writes only ITS OWN shards — no host gather — so
+        1B+ GSPMD-sharded states checkpoint without materializing on one
+        host. The TPU-native upgrade over :meth:`from_state` (reference
+        capability: workers upload checkpoint dirs directly,
+        ``_internal/storage.py``; redesigned for sharded device arrays).
+
+        Multi-controller saves (``jax.distributed``) are collective:
+        every process MUST pass the same ``path`` (a directory on a
+        shared filesystem, typically derived from the step number) —
+        per-process ``mkdtemp`` naming would scatter one checkpoint's
+        shards across directories. Single-process callers may omit
+        ``path`` and get a fresh temp dir.
+        """
+        import orbax.checkpoint as ocp
+
+        if path is not None:
+            d = os.path.abspath(path)
+            os.makedirs(d, exist_ok=True)
+        else:
+            import jax
+
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "multi-process sharded save needs an explicit "
+                    "`path` every process agrees on (mkdtemp would "
+                    "scatter shards across directories)")
+            d = tempfile.mkdtemp(prefix="ckpt_", dir=base_dir)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(d, name), state,
+                       force=path is not None)
+        return cls(d)
+
+    def load_sharded_state(self, like: Any, name: str = "sharded") -> Any:
+        """Restore an orbax checkpoint straight onto devices. ``like``
+        fixes structure, dtypes, and TARGET shardings (real arrays or
+        ``jax.ShapeDtypeStruct``s with ``sharding`` set) — restoring
+        onto a different mesh shape than the save reshards on read."""
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(
+                os.path.join(self.as_directory(), name), like)
+
 
 class _TrackedCheckpoint:
     def __init__(self, checkpoint: Checkpoint, metrics: Dict[str, Any],

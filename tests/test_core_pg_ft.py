@@ -93,3 +93,46 @@ def test_worker_crash_no_retry(rt_fresh):
 
     with pytest.raises(Exception):
         rt.get(die.remote(), timeout=60)
+
+
+def test_remove_racing_pending_create_wins(rt_cluster):
+    """remove_placement_group on a still-PENDING (infeasible-for-now)
+    create must win: the create loop aborts instead of committing a
+    reservation nobody holds a handle to (leak)."""
+    import time
+
+    rt = rt_cluster
+    # Infeasible for the 4-CPU fixture cluster: stays PENDING.
+    pg = rt.placement_group([{"CPU": 64.0}], strategy="PACK")
+    with pytest.raises(Exception):
+        pg.ready(timeout=1.5)
+    rt.remove_placement_group(pg)
+    # Free capacity never lets the raced create come back to life.
+    time.sleep(1.0)
+    from ray_tpu.core.worker import CoreWorker
+
+    st = CoreWorker.current().head_call("pg_state", {"pg_id": pg._id.hex()})
+    assert st["state"] == "REMOVED"
+    listed = rt.state("placement_groups")
+    assert all(p["pg_id"] != pg._id.hex() for p in listed)
+
+
+def test_pg_state_unknown_id_grace_then_removed(rt_cluster):
+    """pg_state answers PENDING only inside a short grace window for an
+    id with no entry; a permanently-dead id then reads REMOVED so stale
+    handles fail fast instead of burning their whole timeout."""
+    import time
+
+    from ray_tpu._private.ids import PlacementGroupID
+    from ray_tpu.core.worker import CoreWorker
+
+    ghost = PlacementGroupID.from_random().hex()
+    core = CoreWorker.current()
+    assert core.head_call("pg_state", {"pg_id": ghost})["state"] == "PENDING"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = core.head_call("pg_state", {"pg_id": ghost})["state"]
+        if st == "REMOVED":
+            break
+        time.sleep(0.5)
+    assert st == "REMOVED"
