@@ -72,6 +72,15 @@ class ProxyActor:
     def ping(self) -> bool:
         return True
 
+    def set_tracing(self, enabled: bool) -> bool:
+        """Mirror the driver's tracing state into this proxy process so
+        per-request server spans record exactly when the driver traces
+        (serve.start propagates it on every call, both directions)."""
+        from ..util import tracing
+
+        tracing.enable() if enabled else tracing.disable()
+        return enabled
+
     def get_port(self) -> Optional[int]:
         return self._port
 
@@ -199,7 +208,7 @@ class ProxyActor:
         loop = asyncio.get_running_loop()
         if target.get("stream"):
             try:
-                gen = await asyncio.wait_for(
+                gen, span = await asyncio.wait_for(
                     loop.run_in_executor(
                         self._pool, self._call_app_stream, target, req),
                     timeout=self._request_timeout_s)
@@ -215,7 +224,13 @@ class ProxyActor:
                 try:
                     item = next(gen)
                 except StopIteration:
+                    if span is not None:
+                        span.finish()
                     return None
+                except BaseException:
+                    if span is not None:
+                        span.finish("error")
+                    raise
                 if isinstance(item, bytes):
                     return item
                 if isinstance(item, str):
@@ -240,13 +255,35 @@ class ProxyActor:
         return 200, ctype, body
 
     def _call_app(self, target: dict, req: Request):
-        handle = DeploymentHandle(target["app"], target["ingress"])
-        return handle.remote(req).result(timeout=self._request_timeout_s)
+        # Server span per request (recorded only when tracing is on in
+        # this proxy process, e.g. RT_TRACING_ENABLED=1 cluster-wide):
+        # the replica call inside becomes its child, so one trace reads
+        # proxy → handle submit → replica execute (reference: serve
+        # requests traced through the core task spans).
+        from ..util import tracing
+
+        with tracing.span(f"http {req.method} {req.path}", kind="server",
+                          route=target.get("prefix", "")):
+            handle = DeploymentHandle(target["app"], target["ingress"])
+            return handle.remote(req).result(
+                timeout=self._request_timeout_s)
 
     def _call_app_stream(self, target: dict, req: Request):
+        """Returns (generator, ManualSpan-or-None). The server span must
+        cover the whole STREAM, not the submission — the caller finishes
+        it when the last chunk is pulled (or the stream errors), which
+        happens on a different pool thread."""
+        from ..util import tracing
+
+        ms = tracing.manual_span(
+            f"http {req.method} {req.path} [stream]", "server",
+            route=target.get("prefix", ""))
         handle = DeploymentHandle(target["app"], target["ingress"],
                                   stream=True)
-        return handle.remote(req)
+        if ms is None:
+            return handle.remote(req), None
+        with ms.activate():
+            return handle.remote(req), ms
 
     # ---------------------------------------------------------- gRPC ingress
     def start_grpc(self, host: str, port: int) -> dict:
@@ -328,14 +365,19 @@ class ProxyActor:
 
     def _grpc_stream_call(self, target: dict, method: str):
         def call(data, context):
+            span = None
             try:
-                gen = self._call_app_stream(
+                gen, span = self._call_app_stream(
                     target, self._grpc_request(method, data, context))
                 for item in gen:
                     yield encode_body(item)[1]
+                if span is not None:
+                    span.finish()
             except Exception as e:  # noqa: BLE001
                 import grpc
 
+                if span is not None:
+                    span.finish("error")
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")
 

@@ -178,6 +178,17 @@ def start(http_options: Union[None, dict, HTTPOptions] = None,
         if _client["http"] is not None:
             rt.get(_client["controller"].set_http_info.remote(
                 _client["http"]), timeout=10)
+        if _client["proxy"] is not None:
+            from ..util import tracing
+
+            # Mirror the driver's tracing state (both directions) so
+            # per-request server spans record exactly when the driver
+            # traces; picked up on every serve.start()/serve.run().
+            try:
+                rt.get(_client["proxy"].set_tracing.remote(
+                    tracing.enabled()), timeout=10)
+            except Exception:  # noqa: BLE001 - adopted older proxy
+                pass
     return _client["controller"]
 
 

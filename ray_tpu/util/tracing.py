@@ -49,7 +49,11 @@ def enable() -> None:
     lazily: any task submitted while tracing is enabled carries a
     ``trace_ctx``, and executing a traced task records spans regardless
     of the worker-local flag (the decision belongs to the submitter,
-    like the reference's driver-side ``_tracing_startup_hook``)."""
+    like the reference's driver-side ``_tracing_startup_hook``).
+
+    Serve proxies mirror the driver's flag on the next
+    ``serve.start()``/``serve.run()`` call (or set ``RT_TRACING_ENABLED=1``
+    cluster-wide to trace every process from boot)."""
     global _enabled
     _enabled = True
 
@@ -116,6 +120,50 @@ def span(name: str, kind: str = "internal", **attrs):
         _record(name, kind, trace_id, span_id,
                 parent[1] if parent else None, start, time.time(),
                 attrs or None, status)
+
+
+class ManualSpan:
+    """Span whose lifetime crosses threads (streaming responses: opened
+    where the stream is submitted, finished wherever it ends). The
+    contextvar window for parenting child submissions is explicit
+    (:meth:`activate`), so no token is ever reset on a foreign thread.
+    """
+
+    def __init__(self, name: str, kind: str, parent, attrs):
+        self.name = name
+        self.kind = kind
+        self.trace_id = parent[0] if parent else _new_id(16)
+        self.span_id = _new_id(8)
+        self._parent_id = parent[1] if parent else None
+        self._attrs = attrs or None
+        self._start = time.time()
+        self._done = False
+
+    @contextlib.contextmanager
+    def activate(self):
+        token = _current.set((self.trace_id, self.span_id))
+        try:
+            yield self
+        finally:
+            _current.reset(token)
+
+    def finish(self, status: str = "ok") -> None:
+        if self._done:
+            return
+        self._done = True
+        _record(self.name, self.kind, self.trace_id, self.span_id,
+                self._parent_id, self._start, time.time(), self._attrs,
+                status)
+
+
+def manual_span(name: str, kind: str = "internal",
+                **attrs) -> Optional[ManualSpan]:
+    """Open a :class:`ManualSpan`, or None when tracing is off (callers
+    guard their ``activate``/``finish`` with that)."""
+    parent = _current.get()
+    if parent is None and not _enabled:
+        return None
+    return ManualSpan(name, kind, parent, attrs)
 
 
 def on_submit(name: str) -> Optional[Dict[str, str]]:

@@ -14,7 +14,7 @@ from ray_tpu.util import tracing
 
 @pytest.fixture
 def traced_cluster():
-    ray_tpu.init(num_cpus=2)
+    ray_tpu.init(num_cpus=6)
     tracing.enable()
     try:
         yield
@@ -171,6 +171,69 @@ def test_disabled_is_free():
         assert tracing.current_context() is None
     finally:
         ray_tpu.shutdown()
+
+
+def test_serve_request_spans(traced_cluster):
+    """An HTTP request through the Serve proxy produces one trace:
+    server span (proxy) → submit → replica execute."""
+    import urllib.request
+
+    from ray_tpu import serve
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    try:
+        @serve.deployment
+        class Pingable:
+            def __call__(self, req):
+                return "pong"
+
+        serve.run(Pingable.bind(), name="traced", route_prefix="/traced")
+        from ray_tpu.serve import api as serve_api
+
+        port = serve_api._client["http"]["port"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traced", timeout=30) as resp:
+            assert resp.read() == b"pong"
+
+        def trace_complete(ss):
+            # proxy and replica flush on independent ~1s loops: wait
+            # for the WHOLE trace, not just the first span to land
+            servers = [s for s in ss if s["kind"] == "server"]
+            return any(
+                {x["kind"] for x in ss
+                 if x["trace_id"] == s["trace_id"]} >= {
+                     "server", "submit", "execute"}
+                for s in servers)
+
+        spans = _wait_spans(trace_complete, timeout=20.0)
+        server = next(s for s in spans if s["kind"] == "server")
+        assert server["name"].startswith("http GET /traced")
+        mine = [s for s in spans if s["trace_id"] == server["trace_id"]]
+        kinds = {s["kind"] for s in mine}
+        assert "submit" in kinds and "execute" in kinds
+
+        # Streaming route: the server span covers the WHOLE stream
+        # (finished when the last chunk is pulled, not at submission).
+        @serve.deployment
+        class Tokens:
+            def __call__(self, req):
+                for i in range(3):
+                    time.sleep(0.05)
+                    yield f"t{i}"
+
+        serve.run(Tokens.bind(), name="tstream", route_prefix="/tstream")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tstream", timeout=30) as resp:
+            assert b"t2" in resp.read()
+        spans = _wait_spans(
+            lambda ss: any("[stream]" in s["name"] for s in ss
+                           if s["kind"] == "server"), timeout=20.0)
+        sspan = next(s for s in spans if s["kind"] == "server"
+                     and "[stream]" in s["name"])
+        assert sspan["end"] - sspan["start"] >= 0.1  # 3 x 50ms of body
+        assert sspan["status"] == "ok"
+    finally:
+        serve.shutdown()
 
 
 def test_timeline_includes_spans(traced_cluster):
