@@ -13,6 +13,7 @@ durations, labels as a frozen kv tuple.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -78,6 +79,15 @@ class Histogram(_Instrument):
         self.bounds = tuple(bounds) or self.DEFAULT_BOUNDS
         # labels -> [bucket counts..., +inf count, sum, n]
         self._values: Dict[LabelPairs, list] = {}
+
+    @contextlib.contextmanager
+    def timer(self, labels: Optional[Dict] = None):
+        """Context manager observing the block's wall time in seconds."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0, labels)
 
     def observe(self, value: float, labels: Optional[Dict] = None):
         key = _labels(labels)

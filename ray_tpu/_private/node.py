@@ -103,6 +103,12 @@ class NodeService:
                 pass
         if self._conn:
             await self._conn.close()
+        if self.shm_domain != socket.gethostname():
+            # Synthetic (per-cluster) domain: nothing outside this node
+            # can own its segments — sweep what SIGKILLed workers left.
+            from .object_store import sweep_domain_segments
+
+            sweep_domain_segments(self.shm_domain)
 
     async def run_forever(self):
         """Block until the head is gone for good. A dropped head

@@ -44,6 +44,30 @@ def _open_shm(name: str, create: bool = False, size: int = 0):
         resource_tracker.register = orig
 
 
+def sweep_domain_segments(domain: str) -> int:
+    """Unlink every shm segment of one shm DOMAIN (its name prefix is
+    derived from the domain string). For synthetic per-cluster domains
+    this is safe teardown hygiene — SIGKILL chaos leaves segments whose
+    creators died without unlinking; nothing outside the owning cluster
+    can hold that domain. Never call it for the shared host domain.
+    Returns the number of segments removed."""
+    import hashlib
+
+    prefix = "rt_" + hashlib.sha1(domain.encode()).hexdigest()[:6] + "_"
+    removed = 0
+    try:
+        for name in os.listdir("/dev/shm"):
+            if name.startswith(prefix):
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                    removed += 1
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return removed
+
+
 class MemoryStore:
     """In-process object store with blocking waiters (thread-safe).
 

@@ -113,6 +113,18 @@ class Cluster:
         return handle
 
     @staticmethod
+    def _sweep_node_segments(node: NodeHandle):
+        """Synthetic per-node shm domains are private to this cluster:
+        sweep whatever a killed node's workers left behind (SIGKILL
+        skips unlink) so repeated test runs don't accumulate segments."""
+        from ._private.object_store import sweep_domain_segments
+
+        try:
+            sweep_domain_segments(node.shm_domain)
+        except Exception:  # noqa: BLE001 - hygiene, never fail teardown
+            pass
+
+    @staticmethod
     def _node_env():
         from ._private.utils import spawn_env_with_pkg_root
 
@@ -139,6 +151,7 @@ class Cluster:
             self._nodes.remove(node)
         except ValueError:
             pass
+        self._sweep_node_segments(node)
 
     def wait_for_nodes(self, count: int, timeout: float = 30) -> List[dict]:
         """Wait until the cluster has ``count`` nodes (incl. head node)."""
@@ -182,5 +195,6 @@ class Cluster:
                 node.proc.wait(timeout=5)
             except Exception:  # noqa: BLE001
                 pass
+            self._sweep_node_segments(node)
         self._nodes.clear()
         self._head_thread.stop()

@@ -1,0 +1,28 @@
+"""Public user-metrics API: ``Counter`` / ``Gauge`` / ``Histogram``.
+
+Capability parity with the reference's application-metric surface
+(reference: ``python/ray/util/metrics.py:137,187,262``): user code in
+tasks/actors instruments with these, the per-process registry snapshots
+flush to the head alongside task events, and the head merges every
+process's series into the cluster-wide prometheus exposition
+(``/metrics`` on the dashboard, ``python -m ray_tpu metrics``).
+
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    requests = Counter("app_requests_total", "requests served")
+    latency = Histogram("app_latency_seconds", bounds=(0.01, 0.1, 1.0))
+
+    @rt.remote
+    class Svc:
+        def handle(self, x):
+            requests.inc()
+            with latency.timer():
+                ...
+"""
+from ray_tpu._private.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram"]

@@ -14,29 +14,11 @@ force_host_devices(8)
 os.environ.setdefault("RT_HEALTH_CHECK_PERIOD_S", "0.2")
 
 
-def _sweep_stale_shm(max_age_s: float = 3600.0):
-    """SIGKILL chaos tests orphan rt_* shm segments (their creators die
-    without unlinking). Sweep old ones at session start so repeated
-    suite runs don't accumulate gigabytes on a shared machine; the age
-    bound keeps concurrently-running clusters safe."""
-    import time as _time
-
-    now = _time.time()
-    try:
-        for name in os.listdir("/dev/shm"):
-            if not name.startswith("rt_"):
-                continue
-            p = os.path.join("/dev/shm", name)
-            try:
-                if now - os.stat(p).st_mtime > max_age_s:
-                    os.unlink(p)
-            except OSError:
-                pass
-    except OSError:
-        pass
-
-
-_sweep_stale_shm()
+# Stale-segment hygiene lives in the runtime, not here: synthetic test
+# domains are swept by Cluster.shutdown/remove_node and NodeService.stop
+# (each knows its own domain, so live clusters are never touched; a
+# blanket mtime-based sweep would be unsafe — mmap writes don't update
+# st_mtime).
 
 import faulthandler  # noqa: E402
 

@@ -40,6 +40,22 @@ def test_registry_instruments():
     assert "lat_seconds_count 6" in text
 
 
+def test_public_metrics_api_and_timer():
+    """ray_tpu.util.metrics re-exports the instruments (reference:
+    ``ray.util.metrics``) and Histogram.timer observes wall time."""
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    reg = m.MetricsRegistry()
+    h = Histogram("api_lat_seconds", bounds=(0.001, 10.0), registry=reg)
+    with h.timer():
+        time.sleep(0.005)
+    snap = reg.snapshot()["api_lat_seconds"]
+    assert snap["kind"] == "histogram"
+    ((_, ent),) = snap["values"]
+    assert ent[-1] == 1 and 0.001 < ent[-2] < 5.0  # one obs, sane sum
+    assert Counter is not None and Gauge is not None
+
+
 def test_cluster_metrics_and_state(rt_cluster):
     rt = rt_cluster
 
