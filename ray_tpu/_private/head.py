@@ -179,6 +179,7 @@ class HeadService:
         self._loop = asyncio.get_running_loop()
         os.makedirs(os.path.join(self.session_dir, "workers"), exist_ok=True)
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self._sweep_dead_sessions()
         # Head restart on an existing session dir adopts the durable
         # control-plane state (GCS-restart analogue).
         state_path = os.path.join(self.session_dir, "head_state.pkl")
@@ -288,6 +289,43 @@ class HeadService:
             await self._server.stop()
         if self._tcp_server:
             await self._tcp_server.stop()
+        # Last act of the session on this host: sweep the session's shm
+        # domain. Segment names are session-scoped (session_shm_domain),
+        # so only THIS session's leftovers — e.g. from SIGKILLed chaos
+        # workers, which never ran unlink — can match. Live mmaps held
+        # elsewhere stay valid (POSIX unlink semantics).
+        from .object_store import sweep_domain_segments
+        from .utils import session_shm_domain
+
+        sweep_domain_segments(session_shm_domain(self.session_dir))
+
+    def _sweep_dead_sessions(self):
+        """Reclaim shm segments of SESSIONS THAT DIED WITHOUT CLEANUP
+        (SIGKILLed heads skip the clean-stop sweep). Session domains are
+        derivable from the discovery-root session dirs, and a recorded
+        head pid that no longer runs proves the session is over. Our own
+        session dir is skipped — a crash-RESTARTED head adopts its live
+        segments (failover), it doesn't reclaim them."""
+        import glob as _glob
+
+        from .object_store import sweep_domain_segments
+        from .utils import session_shm_domain
+
+        root = os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu")
+        own = os.path.abspath(self.session_dir)
+        for path in _glob.glob(os.path.join(root, "*", "session.json")):
+            sdir = os.path.dirname(path)
+            if os.path.abspath(sdir) == own:
+                continue
+            try:
+                with open(path) as f:
+                    pid = json.load(f)["pid"]
+                os.kill(pid, 0)
+            except (OSError, KeyError, ValueError, json.JSONDecodeError):
+                try:
+                    sweep_domain_segments(session_shm_domain(sdir))
+                except Exception:  # noqa: BLE001 - hygiene only
+                    pass
 
     async def _reap_loop(self):
         period = self.config.health_check_period_s

@@ -56,6 +56,7 @@ class NodeService:
     def __init__(self, head_address: Tuple[str, int], session_dir: str,
                  resources: Dict[str, float],
                  shm_domain: Optional[str] = None,
+                 private_domain: bool = False,
                  labels: Optional[Dict[str, str]] = None,
                  node_ip: Optional[str] = None):
         self.head_address = head_address
@@ -66,7 +67,17 @@ class NodeService:
         # host shared memory; across domains they ship bytes over TCP. Tests
         # set a synthetic domain per node to exercise the cross-node path on
         # one machine.
-        self.shm_domain = shm_domain or socket.gethostname()
+        from .utils import session_shm_domain
+
+        # Session-scoped default, same recipe as CoreWorker: a daemon
+        # without an explicit domain gets one derived from ITS OWN
+        # session dir — never the bare hostname, which two sessions on
+        # one machine would collide on.
+        self.shm_domain = shm_domain or session_shm_domain(session_dir)
+        # Only a domain EXPLICITLY declared private may be swept at
+        # stop: an inferred guard (hostname comparison) would clobber
+        # nodes deliberately sharing a custom domain on one host.
+        self.private_domain = private_domain
         self.labels = dict(labels or {})
         # The IP other nodes dial to reach workers on this host. Must be
         # routable cluster-wide on a real multi-host deployment.
@@ -103,9 +114,21 @@ class NodeService:
                 pass
         if self._conn:
             await self._conn.close()
-        if self.shm_domain != socket.gethostname():
-            # Synthetic (per-cluster) domain: nothing outside this node
-            # can own its segments — sweep what SIGKILLed workers left.
+        if self.private_domain:
+            # Nothing outside this node can own segments of a private
+            # domain — sweep what SIGKILLed workers left. Wait for the
+            # just-terminated workers first: a worker mid-put could
+            # otherwise create a segment after the sweep listed
+            # /dev/shm.
+            deadline = time.time() + 2.0
+            for proc in self._procs.values():
+                while proc.poll() is None and time.time() < deadline:
+                    await asyncio.sleep(0.05)
+                if proc.poll() is None:
+                    try:
+                        proc.kill()
+                    except Exception:  # noqa: BLE001
+                        pass
             from .object_store import sweep_domain_segments
 
             sweep_domain_segments(self.shm_domain)

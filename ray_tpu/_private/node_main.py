@@ -25,6 +25,11 @@ def main(argv=None):
     parser.add_argument("--resources", default="{}",
                         help="extra resources as JSON")
     parser.add_argument("--shm-domain", default=None)
+    parser.add_argument("--private-shm-domain", action="store_true",
+                        help="this daemon's shm domain is exclusively "
+                             "its own: sweep leftover segments on stop "
+                             "(cluster_utils sets this for its "
+                             "synthetic per-node domains)")
     parser.add_argument("--labels", default="{}")
     parser.add_argument("--die-with-parent", action="store_true",
                         help="SIGKILL this daemon when its spawner dies "
@@ -53,6 +58,7 @@ def main(argv=None):
             session_dir=args.session_dir,
             resources=resources,
             shm_domain=args.shm_domain,
+            private_domain=args.private_shm_domain,
             labels=json.loads(args.labels),
         )
         await node.start()

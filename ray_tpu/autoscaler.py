@@ -20,6 +20,7 @@ from __future__ import annotations
 import math
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 
@@ -270,7 +271,9 @@ class Autoscaler:
         self._recent_launches: List[tuple] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.events: List[str] = []  # human-readable scaling decisions
+        # human-readable scaling decisions; bounded so a prolonged head
+        # outage (one reconcile error per poll) can't grow memory forever
+        self.events: Any = deque(maxlen=1000)
 
     # ------------------------------------------------------------- state
     def _demand(self) -> dict:
@@ -326,8 +329,9 @@ class Autoscaler:
         while not self._stop.wait(self.poll_period_s):
             try:
                 self.reconcile_once()
-            except Exception:  # noqa: BLE001 - transient head hiccups
-                pass
+            except Exception as e:  # noqa: BLE001 - transient head hiccups
+                self.events.append(
+                    f"reconcile error: {type(e).__name__}: {e}")
 
     def stop(self):
         self._stop.set()

@@ -26,6 +26,7 @@ import glob
 import json
 import os
 import sys
+import time as _time
 
 
 def _find_session(session_dir: str = "") -> dict:
@@ -197,6 +198,9 @@ def main(argv=None) -> int:
                          default=float(os.cpu_count() or 1))
     p_start.add_argument("--num-tpus", type=float, default=None)
     sub.add_parser("status")
+    p_stop = sub.add_parser("stop")
+    p_stop.add_argument("--force", action="store_true",
+                        help="SIGKILL instead of SIGTERM")
     p_list = sub.add_parser("list")
     p_list.add_argument("kind", choices=[
         "nodes", "workers", "actors", "placement_groups", "tasks"])
@@ -234,6 +238,37 @@ def main(argv=None) -> int:
     if args.cmd == "start":
         return _cmd_start(args)
     info = _find_session(args.session_dir)
+    if args.cmd == "stop":
+        # Reference: ``ray stop``. SIGTERM lets the head persist state
+        # and reap its workers (the child-subreaper takes orphans down
+        # with it); the session file is then stale by liveness check.
+        import signal as _signal
+
+        sig = _signal.SIGKILL if args.force else _signal.SIGTERM
+        try:
+            os.kill(info["pid"], sig)
+        except ProcessLookupError:
+            # Exited between the session liveness check and the signal:
+            # the desired end state already holds.
+            print(f"head (pid {info['pid']}) already stopped")
+            return 0
+        except OSError as e:
+            print(f"head pid {info['pid']}: {e}")
+            return 1
+        from ._private.utils import process_exited
+
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            if process_exited(info["pid"]):
+                break
+            _time.sleep(0.1)
+        else:
+            print(f"head pid {info['pid']} still shutting down "
+                  "(state persists on exit); --force to SIGKILL")
+            return 1
+        print(f"stopped head (pid {info['pid']}, "
+              f"session {info['session_dir']})")
+        return 0
     if args.cmd == "job":
         from .job_submission import JobSubmissionClient
 

@@ -86,6 +86,9 @@ class Cluster:
              "--resources", json.dumps(resources or {}),
              "--shm-domain", shm_domain,
              "--labels", json.dumps(labels or {}),
+             # The synthetic domain is exclusively this node's: its
+             # daemon may sweep leftovers at stop.
+             "--private-shm-domain",
              # Test nodes die with the test process — a SIGKILL'd run
              # must not leak daemons (and their workers) machine-wide.
              "--die-with-parent"],
@@ -116,10 +119,13 @@ class Cluster:
     def _sweep_node_segments(node: NodeHandle):
         """Synthetic per-node shm domains are private to this cluster:
         sweep whatever a killed node's workers left behind (SIGKILL
-        skips unlink) so repeated test runs don't accumulate segments."""
+        skips unlink) so repeated test runs don't accumulate segments.
+        The brief sleep lets pdeathsig finish off workers that might
+        otherwise create a segment after the sweep listed /dev/shm."""
         from ._private.object_store import sweep_domain_segments
 
         try:
+            time.sleep(0.2)
             sweep_domain_segments(node.shm_domain)
         except Exception:  # noqa: BLE001 - hygiene, never fail teardown
             pass

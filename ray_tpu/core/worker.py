@@ -438,7 +438,12 @@ class CoreWorker:
         self.node_id = node_id
         # Same shm_domain == objects exchangeable via host shared memory;
         # different domains ship bytes over the wire (cross-node transfer).
-        self.shm_domain = shm_domain or socket.gethostname()
+        from .._private.utils import session_shm_domain
+
+        # Session-scoped default (see session_shm_domain): all of one
+        # session's host-local processes agree, distinct sessions never
+        # collide on segment names. Spawned workers get it explicitly.
+        self.shm_domain = shm_domain or session_shm_domain(session_dir)
         self.listen_tcp = listen_tcp
         self.memory_store = MemoryStore()
         self.shm_store = SharedMemoryStore(

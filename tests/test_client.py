@@ -137,3 +137,25 @@ def test_cli_status_against_standalone_head(standalone_head):
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
     assert "nodes" in r.stdout
+
+
+def test_cli_stop_tears_down_head(standalone_head):
+    """``python -m ray_tpu stop`` terminates the head daemon (reference:
+    ``ray stop``) and the session file goes stale by liveness check."""
+    import subprocess as sp
+
+    head_pid = standalone_head["pid"]
+    r = sp.run([sys.executable, "-m", "ray_tpu", "--session-dir",
+                standalone_head["session_dir"], "stop"],
+               cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stopped head" in r.stdout
+    from ray_tpu._private.utils import process_exited
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if process_exited(head_pid):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("head still alive after stop")

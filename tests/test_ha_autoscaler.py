@@ -93,14 +93,17 @@ def test_autoscaler_scales_up_and_down(tmp_path):
         assert len(provider.non_terminated_nodes()) >= 1
         assert any("scale-up" in e for e in scaler.events)
 
-        # Idle long enough → scale back down to min_nodes.
-        deadline = time.time() + 60
+        # Idle long enough → scale back down to min_nodes. Wait on the
+        # EVENT: terminate pops the provider's list before the blocking
+        # node removal returns, so node emptiness races the record.
+        deadline = time.time() + 90
         while time.time() < deadline:
-            if not provider.non_terminated_nodes():
+            if any("scale-down" in e for e in scaler.events):
                 break
             time.sleep(1.0)
         assert not provider.non_terminated_nodes(), scaler.events
-        assert any("scale-down" in e for e in scaler.events)
+        assert any("scale-down" in e for e in scaler.events), \
+            scaler.events
     finally:
         scaler.stop()
         cluster.shutdown()
@@ -152,12 +155,16 @@ def test_tpu_slice_provider_gang_scale(tmp_path):
                     if "TPU-v5e-8-head" in n["total"]]
         assert len(anchored) == 1
 
-        # Idle past the timeout → the whole gang retires together.
-        deadline = time.time() + 60
-        while time.time() < deadline and provider.non_terminated_nodes():
+        # Idle past the timeout → the whole gang retires together
+        # (wait on the event; see the comment in the test above).
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if any("scale-down" in e for e in scaler.events):
+                break
             time.sleep(1.0)
         assert not provider.non_terminated_nodes(), scaler.events
-        assert any("scale-down" in e for e in scaler.events)
+        assert any("scale-down" in e for e in scaler.events), \
+            scaler.events
     finally:
         scaler.stop()
         cluster.shutdown()

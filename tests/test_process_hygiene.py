@@ -95,3 +95,41 @@ def test_node_daemon_dies_with_parent(tmp_path):
     for p in leaked:
         os.kill(p, signal.SIGKILL)
     assert not leaked, f"processes leaked after head SIGKILL: {leaked}"
+
+
+def test_head_startup_reclaims_dead_session_segments():
+    """A SIGKILLed session never runs its clean-stop sweep; the NEXT
+    head to start on this machine reclaims its shm segments (dead pid
+    in session.json proves the session is over)."""
+    import json as _json
+
+    import ray_tpu as rt
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import SharedMemoryStore
+    from ray_tpu._private.utils import session_shm_domain
+
+    if rt.is_initialized():
+        rt.shutdown()
+    # Fabricate a dead session in the discovery root: a session.json
+    # with a certainly-dead pid and one orphaned segment in its domain.
+    root = os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu")
+    dead_dir = os.path.join(root, f"session_deadtest_{os.getpid()}")
+    os.makedirs(dead_dir, exist_ok=True)
+    dead_pid = 2 ** 22 - 3  # beyond pid_max defaults: never running
+    with open(os.path.join(dead_dir, "session.json"), "w") as f:
+        _json.dump({"pid": dead_pid, "head_sock": "x"}, f)
+    store = SharedMemoryStore(1 << 20,
+                              domain=session_shm_domain(dead_dir))
+    oid = ObjectID.from_random()
+    store.create(oid, [b"h", b"orphan"])
+    seg = f"/dev/shm/{store._name(oid)}"
+    assert os.path.exists(seg)
+
+    rt.init(num_cpus=1)  # embedded head start runs the sweep
+    try:
+        assert not os.path.exists(seg), "dead session segment survived"
+    finally:
+        rt.shutdown()
+        import shutil
+
+        shutil.rmtree(dead_dir, ignore_errors=True)
