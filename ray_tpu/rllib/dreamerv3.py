@@ -38,9 +38,11 @@ def symexp(x, xp=np):
     return xp.sign(x) * (xp.exp(xp.abs(x)) - 1.0)
 
 
-NUM_BINS = 41  # twohot support: uniform bins over [-20, 20] in
+NUM_BINS = 255  # twohot support: uniform bins over [-20, 20] in
 # SYMLOG space (callers encode twohot(symlog(y)) and decode
-# symexp(bins @ p) — reference: DreamerV3 paper eq. 9/10).
+# symexp(bins @ p) — reference: DreamerV3 paper eq. 9/10; 255 bins
+# (the paper's count) give ~0.16 symlog resolution — coarse bins
+# can't discriminate sub-unit reward differences).
 
 
 def _bins(xp=np):
@@ -109,7 +111,8 @@ def init_dreamer_params(spec: RLModuleSpec, cfg, seed: int) -> Dict:
         "decoder": _mlp(rng, (feat, U, U)) + [_dense(rng, U, obs)],
         "reward": _mlp(rng, (feat, U)) + [_dense(rng, U, NUM_BINS, 0.0)],
         "cont": _mlp(rng, (feat, U)) + [_dense(rng, U, 1)],
-        "actor": _mlp(rng, (feat, U)) + [_dense(rng, U, act, 0.01)],
+        "actor": _mlp(rng, (feat, U)) + [_dense(
+            rng, U, 2 * act if spec.continuous else act, 0.01)],
         "critic": _mlp(rng, (feat, U)) + [_dense(rng, U, NUM_BINS, 0.0)],
     }
 
@@ -149,11 +152,17 @@ class SequenceReplay:
             is_first[1:] |= f["dones"][sl][:-1].astype(bool)
             out["obs"].append(f["obs"][sl])
             out["actions"].append(f["actions"][sl])
-            out["rewards"].append(f["rewards"][sl])
             # …but only TERMINATIONS train the continue head: a
             # time-limit truncation is not an MDP exit, and teaching
             # p(continue)=0 there poisons imagined returns (reference:
             # DreamerV3 continue target uses terminations only).
+            # NOTE on alignment: targets here are the OUTCOME of a_t at
+            # feat_t (which has absorbed a_{t-1}); the reference's
+            # arrival convention needs the terminal arrival observation
+            # in the stream, which this runner does not record yet —
+            # shifting without it silently zeroes every termination
+            # target (NOTES_r03).
+            out["rewards"].append(f["rewards"][sl])
             out["terms"].append(f["terms"][sl])
             out["is_first"].append(is_first)
         return {k: np.stack(v).astype(np.float32) if k != "actions"
@@ -186,6 +195,8 @@ class DreamerV3Learner:
         cfg = self.cfg
         D, S, C = cfg.deter_dim, cfg.stoch_dims, cfg.stoch_classes
         act_n = self.spec.num_actions
+        continuous = bool(self.spec.continuous)
+
 
         def mlp(layers, x, act_last=False):
             for i, l in enumerate(layers):
@@ -200,6 +211,13 @@ class DreamerV3Learner:
             r, u = jax.nn.sigmoid(r), jax.nn.sigmoid(u)
             cand = jnp.tanh(r * c)
             return u * cand + (1 - u) * h
+
+        if continuous:
+            a_low = jnp.asarray(self.spec.action_low, jnp.float32)
+            a_high = jnp.asarray(self.spec.action_high, jnp.float32)
+
+            def scale_action(t):
+                return a_low + (t + 1.0) * 0.5 * (a_high - a_low)
 
         def unimix_logits(logits):
             # 1% uniform mixing keeps KL finite (paper sec. 3).
@@ -232,8 +250,11 @@ class DreamerV3Learner:
             B, L = batch["obs"].shape[:2]
             emb = mlp(p["encoder"], symlog(batch["obs"], jnp),
                       act_last=True)
-            a_onehot = jax.nn.one_hot(batch["actions"].astype(jnp.int32),
-                                      act_n)
+            if continuous:
+                a_feed = batch["actions"].reshape(B, L, act_n)
+            else:
+                a_feed = jax.nn.one_hot(
+                    batch["actions"].astype(jnp.int32), act_n)
             keys = jax.random.split(key, L)
 
             def step(carry, t):
@@ -242,7 +263,7 @@ class DreamerV3Learner:
                 h = h * (1 - reset)
                 z = z * (1 - reset[..., None])
                 a_prev = jnp.where(
-                    t > 0, a_onehot[:, jnp.maximum(t - 1, 0)], 0.0)
+                    t > 0, a_feed[:, jnp.maximum(t - 1, 0)], 0.0)
                 a_prev = a_prev * (1 - reset)
                 h = gru(p["gru"],
                         h, jnp.concatenate([z.reshape(B, S * C),
@@ -294,18 +315,27 @@ class DreamerV3Learner:
             def step(carry, k):
                 h, z = carry
                 feat = feat_of(h, z)
-                a_lg = mlp(p["actor"], feat)
+                out = mlp(p["actor"], feat)
                 ka, kz = jax.random.split(k)
-                a = jax.random.categorical(ka, a_lg, -1)
-                a_one = jax.nn.one_hot(a, act_n)
+                if continuous:
+                    mean, log_std = jnp.split(out, 2, -1)
+                    log_std = jnp.clip(log_std, -5.0, 2.0)
+                    u = mean + jnp.exp(log_std) * jax.random.normal(
+                        ka, mean.shape)
+                    a_feed = scale_action(jnp.tanh(u))
+                    aux = (u, mean, log_std)
+                else:
+                    a = jax.random.categorical(ka, out, -1)
+                    a_feed = jax.nn.one_hot(a, act_n)
+                    aux = (out, a)
                 h = gru(p["gru"], h,
-                        jnp.concatenate([z.reshape(N, S * C), a_one], -1))
+                        jnp.concatenate([z.reshape(N, S * C),
+                                         a_feed], -1))
                 z = sample_z(kz, mlp(p["prior"], h)).reshape(N, S, C)
-                return (h, z), (h, z, a_lg, a)
+                return (h, z), (h, z) + aux
 
-            (_, _), (hs, zs, a_lgs, acts) = jax.lax.scan(
-                step, (h0, z0), keys)
-            return hs, zs, a_lgs, acts  # time-major [H, N, ...]
+            (_, _), outs = jax.lax.scan(step, (h0, z0), keys)
+            return outs  # time-major [H, N, ...]: (h, z, *aux)
 
         def lambda_returns(rew, cont, values, lam=0.95):
             """Bootstrapped lambda-returns, time-major [H, N];
@@ -321,26 +351,40 @@ class DreamerV3Learner:
             _, rets = jax.lax.scan(body, last, jnp.arange(H - 1, -1, -1))
             return rets[::-1]
 
-        def ac_loss(p, slow_critic, key, hs, zs):
+        def ac_loss(p, slow_critic, key, hs, zs, r_caps):
             # Imagination starts from every posterior state (flattened),
             # gradients do not flow back into the world model.
             h0 = sg(hs.reshape(-1, D))
             z0 = sg(zs.reshape(-1, S, C))
-            ih, iz, a_lgs, acts = imagine(
+            ih, iz, *aux = imagine(
                 {**p, "gru": sg_tree(p["gru"]), "prior": sg_tree(p["prior"]),
                  "reward": sg_tree(p["reward"]), "cont": sg_tree(p["cont"])},
                 key, h0, z0)
             feat = feat_of(ih, iz)  # [H, N, F]
             H, N = feat.shape[:2]
-            rew = twohot_mean(mlp(p["reward"], feat).reshape(H * N, -1),
+            r_lo, r_hi, v_cap = r_caps
+            # Heads are PARAM-stopped for the return estimate: with a
+            # pathwise (continuous) actor, un-stopped params would let
+            # the actor loss push reward/cont/critic predictions toward
+            # the caps instead of moving the policy. Features stay
+            # differentiable — that's the pathwise gradient.
+            rew = twohot_mean(mlp(sg_tree(p["reward"]),
+                                  feat).reshape(H * N, -1),
                               jnp).reshape(H, N)
-            rew = symexp(rew, jnp)
-            cont = jax.nn.sigmoid(mlp(p["cont"], feat)[..., 0])
-            v_lg = mlp(p["critic"], feat).reshape(H * N, -1)
-            values = symexp(twohot_mean(v_lg, jnp), jnp).reshape(H, N)
+            # Ground imagination in the DATA: off-distribution states
+            # (which a pathwise actor actively seeks out) can decode to
+            # symexp-huge rewards/values the environment never produced;
+            # clamping to the replayed range (in symlog space) removes
+            # the model-exploitation blow-up while leaving everything
+            # inside the observed support untouched.
+            rew = symexp(jnp.clip(rew, r_lo, r_hi), jnp)
+            cont = jax.nn.sigmoid(mlp(sg_tree(p["cont"]), feat)[..., 0])
+            v_lg = mlp(sg_tree(p["critic"]), feat).reshape(H * N, -1)
+            values = symexp(jnp.clip(twohot_mean(v_lg, jnp),
+                                     -v_cap, v_cap), jnp).reshape(H, N)
             start_feat = feat_of(h0, z0)
-            v0 = symexp(twohot_mean(
-                mlp(p["critic"], start_feat), jnp), jnp)
+            v0 = symexp(jnp.clip(twohot_mean(
+                mlp(p["critic"], start_feat), jnp), -v_cap, v_cap), jnp)
             vals_ext = jnp.concatenate([values, values[-1:]], 0)
             rets = lambda_returns(rew, cont, vals_ext)  # [H, N]
             # discount weights: product of continues up to t
@@ -349,8 +393,13 @@ class DreamerV3Learner:
 
             # Critic: twohot CE on symlog lambda-returns + EMA
             # regularization toward the slow critic (paper sec. 4).
+            # CE evaluates on STOPPED feats: with pathwise (continuous)
+            # actors the imagined states are differentiable wrt the
+            # actor, and an un-stopped critic CE would push the actor
+            # toward easily-predicted states instead of good ones.
             tgt = twohot(symlog(sg(rets), jnp).reshape(-1), jnp)
-            logp_v = jax.nn.log_softmax(v_lg, -1)
+            logp_v = jax.nn.log_softmax(
+                mlp(p["critic"], sg(feat)).reshape(H * N, -1), -1)
             l_critic = -(tgt * logp_v).sum(-1).reshape(H, N)
             slow_lg = mlp(slow_critic, sg(feat)).reshape(H * N, -1)
             l_slow = -(jax.nn.softmax(slow_lg, -1)
@@ -363,10 +412,33 @@ class DreamerV3Learner:
             lo = jnp.percentile(sg(rets), 5)
             hi = jnp.percentile(sg(rets), 95)
             scale = jnp.maximum(hi - lo, 1.0)
-            logp_a = jax.nn.log_softmax(a_lgs, -1)
-            lp = jnp.take_along_axis(logp_a, acts[..., None],
-                                     -1)[..., 0]
-            ent = -(jnp.exp(logp_a) * logp_a).sum(-1)
+            if continuous:
+                u, mean, log_std = aux
+                # Continuous actors train by DYNAMICS BACKPROP (the
+                # reference's continuous mode): u = mean + std*eps is
+                # reparameterized, actions feed the (param-stopped)
+                # world model differentiably, so the lambda-returns are
+                # a pathwise function of the actor parameters. REINFORCE
+                # on a reparameterized sample is invalid (score terms
+                # cancel), and score-function-with-sg learns far slower
+                # here than the exact pathwise gradient.
+                from .sac import squash_logp
+
+                ent = -squash_logp(sg(u), log_std, mean, jnp)
+                actor_loss = -(sg(disc) * rets / scale).mean() \
+                    - cfg.entropy_coeff * ent.mean()
+                metrics = {"ac/critic": critic_loss,
+                           "ac/actor": actor_loss,
+                           "ac/entropy": ent.mean(),
+                           "ac/return": rets.mean(),
+                           "ac/value": v0.mean()}
+                return actor_loss + critic_loss, metrics
+            else:
+                a_lgs, acts = aux
+                logp_a = jax.nn.log_softmax(a_lgs, -1)
+                lp = jnp.take_along_axis(logp_a, acts[..., None],
+                                         -1)[..., 0]
+                ent = -(jnp.exp(logp_a) * logp_a).sum(-1)
             actor_loss = -(sg(disc) * (lp * adv / scale
                                        + cfg.entropy_coeff * ent)).mean()
             metrics = {"ac/critic": critic_loss, "ac/actor": actor_loss,
@@ -380,7 +452,13 @@ class DreamerV3Learner:
         def loss_fn(p, slow_critic, key, batch):
             k1, k2 = jax.random.split(key)
             wm, (hs, zs, m1) = wm_loss(p, k1, batch)
-            ac, m2 = ac_loss(p, slow_critic, k2, hs, zs)
+            r_sym = symlog(batch["rewards"], jnp)
+            r_lo, r_hi = r_sym.min() - 0.5, r_sym.max() + 0.5
+            bound = jnp.maximum(jnp.abs(symexp(r_lo, jnp)),
+                                jnp.abs(symexp(r_hi, jnp)))
+            v_cap = symlog(bound / (1.0 - cfg.gamma) + 1.0, jnp)
+            ac, m2 = ac_loss(p, slow_critic, k2, hs, zs,
+                             (r_lo, r_hi, v_cap))
             return wm + ac, {**m1, **m2}
 
         self._opt = optax.chain(
@@ -502,11 +580,28 @@ class DreamerV3Module:
         feat = np.concatenate([h, z], -1)
         return h, z, feat
 
+    def _to_env(self, tanh_a):
+        lo, hi = self.spec.action_low, self.spec.action_high
+        return lo + (tanh_a + 1.0) * 0.5 * (hi - lo)
+
     def forward_exploration(self, obs: np.ndarray, rng):
         h, z, feat = self._step_state(obs)
-        logits = self._mlp(self.params["actor"], feat)
-        p = _softmax(logits)
+        out = self._mlp(self.params["actor"], feat)
         n = obs.shape[0]
+        if self.spec.continuous:
+            from .sac import squash_logp
+
+            mean, log_std = np.split(out, 2, -1)
+            log_std = np.clip(log_std, -5.0, 2.0)
+            u = mean + np.exp(log_std) * rng.standard_normal(mean.shape)
+            env_a = self._to_env(np.tanh(u)).astype(np.float32)
+            for i in range(n):
+                self._state[i] = (h[i], z[i], env_a[i])
+            logp = squash_logp(u, log_std, mean, np).astype(np.float32)
+            values = symexp(twohot_mean(
+                self._mlp(self.params["critic"], feat)))
+            return env_a, logp, values
+        p = _softmax(out)
         acts = np.array([rng.choice(len(row), p=row) for row in p])
         a_one = np.eye(self.spec.num_actions,
                        dtype=np.float32)[acts]
@@ -519,8 +614,14 @@ class DreamerV3Module:
 
     def forward_inference(self, obs: np.ndarray):
         h, z, feat = self._step_state(obs)
-        logits = self._mlp(self.params["actor"], feat)
-        acts = logits.argmax(-1)
+        out = self._mlp(self.params["actor"], feat)
+        if self.spec.continuous:
+            mean, _ = np.split(out, 2, -1)
+            env_a = self._to_env(np.tanh(mean)).astype(np.float32)
+            for i in range(obs.shape[0]):
+                self._state[i] = (h[i], z[i], env_a[i])
+            return env_a
+        acts = out.argmax(-1)
         a_one = np.eye(self.spec.num_actions, dtype=np.float32)[acts]
         for i in range(obs.shape[0]):
             self._state[i] = (h[i], z[i], a_one[i])
@@ -569,8 +670,6 @@ class DreamerV3(Algorithm):
 
     def _make_module_spec(self, config):
         spec = config.module_spec()
-        if spec.continuous:
-            raise ValueError("this DreamerV3 supports discrete actions")
         cfg = config
 
         class _Bound(DreamerV3Module):
