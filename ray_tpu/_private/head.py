@@ -309,7 +309,7 @@ class HeadService:
         import glob as _glob
 
         from .object_store import sweep_domain_segments
-        from .utils import session_shm_domain
+        from .utils import process_exited, session_shm_domain
 
         root = os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu")
         own = os.path.abspath(self.session_dir)
@@ -320,12 +320,17 @@ class HeadService:
             try:
                 with open(path) as f:
                     pid = json.load(f)["pid"]
-                os.kill(pid, 0)
             except (OSError, KeyError, ValueError, json.JSONDecodeError):
-                try:
-                    sweep_domain_segments(session_shm_domain(sdir))
-                except Exception:  # noqa: BLE001 - hygiene only
-                    pass
+                pid = None
+            # process_exited (not signal-0): a zombie head — dead but
+            # unreaped by its parent — still answers kill(pid, 0), and
+            # its session must be swept like any other dead one.
+            if pid is not None and not process_exited(pid):
+                continue
+            try:
+                sweep_domain_segments(session_shm_domain(sdir))
+            except Exception:  # noqa: BLE001 - hygiene only
+                pass
 
     async def _reap_loop(self):
         period = self.config.health_check_period_s
@@ -342,6 +347,17 @@ class HeadService:
                 if pg.state == "REMOVED" and pg.removed_at is not None \
                         and now - pg.removed_at > 600.0:
                     del self.pgs[pid]
+            # Unknown-pg grace entries are normally cleared by the next
+            # poll, but a client that polled once and went away would
+            # pin its entry forever. Sweep on the tombstone horizon:
+            # any re-poll within 600s still gets its fail-fast REMOVED
+            # verdict (entries older than the 10s grace answer REMOVED
+            # on sight); only a poller with a >600s gap between polls
+            # restarts its grace clock — accepted, ready() loops poll
+            # sub-second — in exchange for a bounded dict.
+            for ugid, t0 in list(self._pg_unknown_since.items()):
+                if now - t0 > 600.0:
+                    del self._pg_unknown_since[ugid]
             if time.time() - last_persist > 10.0:
                 last_persist = time.time()
                 try:

@@ -44,7 +44,22 @@ def process_exited(pid: int) -> bool:
             # field 3 is the state; comm (field 2) may contain spaces
             # and parens, so split on the LAST ')'
             return f.read().rsplit(")", 1)[1].split()[0] == "Z"
+    except PermissionError:
+        # hidepid mounts deny stat on other users' pids — the process
+        # EXISTS (ENOENT is how absence presents), so report alive.
+        return False
     except (OSError, IndexError):
         # IndexError: stat read raced final teardown (empty/partial
         # content instead of ESRCH on some kernels) — gone either way.
+        if not os.path.isdir("/proc"):
+            # No procfs at all (macOS, some containers): fall back to
+            # signal-0 probing — blind to zombies, but better than
+            # declaring every process exited.
+            try:
+                os.kill(pid, 0)
+                return False
+            except ProcessLookupError:
+                return True
+            except OSError:
+                return False  # EPERM: exists, owned by someone else
         return True

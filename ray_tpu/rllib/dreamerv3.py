@@ -307,7 +307,14 @@ class DreamerV3Learner:
             return loss, (hs, zs, metrics)
 
         def imagine(p, key, h0, z0):
-            """Actor rollout in latent space for `horizon` steps."""
+            """Actor rollout in latent space for `horizon` steps.
+
+            Emits the PRE-advance state each step — (s_t, a_t aux) with
+            s_0 = the start state — matching the reference's
+            dream_trajectory, which includes the start state so returns
+            and advantages index the state where the action was taken.
+            The final carry (s_H) is returned for the value bootstrap.
+            """
             H = cfg.horizon
             N = h0.shape[0]
             keys = jax.random.split(key, H)
@@ -328,14 +335,16 @@ class DreamerV3Learner:
                     a = jax.random.categorical(ka, out, -1)
                     a_feed = jax.nn.one_hot(a, act_n)
                     aux = (out, a)
-                h = gru(p["gru"], h,
-                        jnp.concatenate([z.reshape(N, S * C),
-                                         a_feed], -1))
-                z = sample_z(kz, mlp(p["prior"], h)).reshape(N, S, C)
-                return (h, z), (h, z) + aux
+                h_next = gru(p["gru"], h,
+                             jnp.concatenate([z.reshape(N, S * C),
+                                              a_feed], -1))
+                z_next = sample_z(kz,
+                                  mlp(p["prior"], h_next)).reshape(N, S, C)
+                return (h_next, z_next), (h, z) + aux
 
-            (_, _), outs = jax.lax.scan(step, (h0, z0), keys)
-            return outs  # time-major [H, N, ...]: (h, z, *aux)
+            (h_last, z_last), outs = jax.lax.scan(step, (h0, z0), keys)
+            # outs time-major [H, N, ...]: (s_t h, s_t z, *aux at s_t)
+            return outs, (h_last, z_last)
 
         def lambda_returns(rew, cont, values, lam=0.95):
             """Bootstrapped lambda-returns, time-major [H, N];
@@ -356,11 +365,11 @@ class DreamerV3Learner:
             # gradients do not flow back into the world model.
             h0 = sg(hs.reshape(-1, D))
             z0 = sg(zs.reshape(-1, S, C))
-            ih, iz, *aux = imagine(
+            (ih, iz, *aux), (h_last, z_last) = imagine(
                 {**p, "gru": sg_tree(p["gru"]), "prior": sg_tree(p["prior"]),
                  "reward": sg_tree(p["reward"]), "cont": sg_tree(p["cont"])},
                 key, h0, z0)
-            feat = feat_of(ih, iz)  # [H, N, F]
+            feat = feat_of(ih, iz)  # [H, N, F] — s_0..s_{H-1}
             H, N = feat.shape[:2]
             r_lo, r_hi, v_cap = r_caps
             # Heads are PARAM-stopped for the return estimate: with a
@@ -382,10 +391,14 @@ class DreamerV3Learner:
             v_lg = mlp(sg_tree(p["critic"]), feat).reshape(H * N, -1)
             values = symexp(jnp.clip(twohot_mean(v_lg, jnp),
                                      -v_cap, v_cap), jnp).reshape(H, N)
-            start_feat = feat_of(h0, z0)
-            v0 = symexp(jnp.clip(twohot_mean(
-                mlp(p["critic"], start_feat), jnp), -v_cap, v_cap), jnp)
-            vals_ext = jnp.concatenate([values, values[-1:]], 0)
+            # Bootstrap with V(s_H) from the final scan carry — the
+            # state one past the last emitted one — so the last
+            # lambda-return is rew(s_{H-1}) + gamma*cont*V(s_H), not a
+            # duplicated V(s_{H-1}).
+            v_last = symexp(jnp.clip(twohot_mean(
+                mlp(sg_tree(p["critic"]), feat_of(h_last, z_last)), jnp),
+                -v_cap, v_cap), jnp)
+            vals_ext = jnp.concatenate([values, v_last[None]], 0)
             rets = lambda_returns(rew, cont, vals_ext)  # [H, N]
             # discount weights: product of continues up to t
             disc = jnp.cumprod(
@@ -431,7 +444,7 @@ class DreamerV3Learner:
                            "ac/actor": actor_loss,
                            "ac/entropy": ent.mean(),
                            "ac/return": rets.mean(),
-                           "ac/value": v0.mean()}
+                           "ac/value": values[0].mean()}
                 return actor_loss + critic_loss, metrics
             else:
                 a_lgs, acts = aux
@@ -443,7 +456,7 @@ class DreamerV3Learner:
                                        + cfg.entropy_coeff * ent)).mean()
             metrics = {"ac/critic": critic_loss, "ac/actor": actor_loss,
                        "ac/entropy": ent.mean(),
-                       "ac/return": rets.mean(), "ac/value": v0.mean()}
+                       "ac/return": rets.mean(), "ac/value": values[0].mean()}
             return actor_loss + critic_loss, metrics
 
         def sg_tree(t):

@@ -157,7 +157,7 @@ def test_imagination_trains_the_actor():
     algo = cfg.build()
     try:
         ents, rets = [], []
-        for _ in range(12):
+        for _ in range(16):
             m = algo.train()
             ents.append(m["ac/entropy"])
             if m.get("episode_return_mean") is not None:
