@@ -1,7 +1,8 @@
 """ray_tpu.rllib — reinforcement learning (reference: ``rllib/``, new API
 stack, SURVEY.md §2.8): AlgorithmConfig → Algorithm with EnvRunnerGroup
 (CPU sampling actors, numpy inference) and jax LearnerGroup (jitted
-losses, mesh-sharded batches). Algorithms: PPO (sync on-policy), IMPALA
+losses, mesh-sharded batches). Algorithms: PPO (sync on-policy,
+single- AND multi-agent via ``.multi_agent(...)``), IMPALA
 (async + aggregators), APPO (async clipped surrogate), DQN (prioritized
 replay + double-Q), SAC (continuous control), CQL + BC + MARWIL
 (offline).
@@ -30,6 +31,14 @@ from .cql import CQL, CQLConfig  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .learner import LearnerGroup, PPOLearner, compute_gae  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentEnvRunnerGroup,
+    MultiAgentPPO,
+    MultiRLModule,
+    spec_from_spaces,
+)
 from .offline_data import OfflineData, rollout_to_rows, to_columns  # noqa: F401,E501
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .sac import SAC, SACConfig, SACLearner, SquashedGaussianModule  # noqa: F401,E501

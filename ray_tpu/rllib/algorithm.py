@@ -40,6 +40,10 @@ class AlgorithmConfig:
         self.mesh = None
         self.use_conv = False           # CNN torso (image observations)
         self.env_to_module_connector: Optional[Callable] = None
+        # multi-agent (None ⇒ single-agent; see multi_agent.py)
+        self.policies: Optional[Any] = None
+        self.policy_mapping_fn: Optional[Callable] = None
+        self.policies_to_train: Optional[List[str]] = None
 
     # fluent sections, reference-style
     def environment(self, env: Optional[str] = None, *,
@@ -80,6 +84,24 @@ class AlgorithmConfig:
             if not hasattr(self, k):
                 raise AttributeError(f"unknown training option {k!r}")
             setattr(self, k, v)
+        return self
+
+    def multi_agent(self, *, policies=None,
+                    policy_mapping_fn: Optional[Callable] = None,
+                    policies_to_train=None):
+        """Declare module ids and the agent→module mapping (reference
+        ``algorithm_config.py`` ``multi_agent()``). ``policies`` is a
+        dict ``module_id → RLModuleSpec | None`` (None ⇒ infer the spec
+        from the spaces of an agent that maps to it) or an iterable of
+        module ids. ``policy_mapping_fn(agent_id, env_index)`` returns
+        the module id acting for that agent; default maps each agent id
+        to a module of the same name."""
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if policies_to_train is not None:
+            self.policies_to_train = list(policies_to_train)
         return self
 
     def debugging(self, *, seed: Optional[int] = None):
@@ -130,6 +152,14 @@ class AlgorithmConfig:
         return spec
 
     def build(self) -> "Algorithm":
+        if self.policies:
+            # Only configs that override build() (PPO) dispatch to a
+            # multi-agent algorithm; anything else would silently train
+            # a wrong single-agent setup on a dict-keyed env.
+            raise NotImplementedError(
+                f"multi_agent() is not supported by "
+                f"{type(self).__name__}; multi-agent training is "
+                f"available for PPO (PPOConfig.multi_agent(...))")
         return self.algo_class(self)  # type: ignore[attr-defined]
 
 
@@ -144,13 +174,7 @@ class Algorithm:
             rt.init(ignore_reinit_error=True)
         self.config = config
         self.module_spec = self._make_module_spec(config)
-        self.env_runner_group = EnvRunnerGroup(
-            config.make_env_creator(), self.module_spec,
-            num_env_runners=config.num_env_runners,
-            num_envs_per_runner=config.num_envs_per_runner,
-            rollout_fragment_length=config.rollout_fragment_length,
-            seed=config.seed,
-            connector_factory=config.env_to_module_connector)
+        self.env_runner_group = self._build_env_runner_group()
         self.learner_group = self._build_learner_group()
         self.iteration = 0
         self._timesteps = 0
@@ -161,6 +185,18 @@ class Algorithm:
         """Overridable: algorithms may swap the module class (e.g. DQN's
         epsilon-greedy module) before runners pickle the spec."""
         return config.module_spec()
+
+    def _build_env_runner_group(self):
+        """Overridable: multi-agent algorithms swap in a runner group
+        that speaks the dict-keyed env API."""
+        config = self.config
+        return EnvRunnerGroup(
+            config.make_env_creator(), self.module_spec,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed,
+            connector_factory=config.env_to_module_connector)
 
     def _build_learner_group(self) -> LearnerGroup:
         raise NotImplementedError

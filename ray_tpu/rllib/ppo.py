@@ -18,6 +18,13 @@ class PPOConfig(AlgorithmConfig):
         super().__init__()
         self.algo_class = PPO
 
+    def build(self) -> "Algorithm":
+        if self.policies:  # .multi_agent(...) was called
+            from .multi_agent import MultiAgentPPO
+
+            return MultiAgentPPO(self)
+        return PPO(self)
+
 
 class PPO(Algorithm):
     def _build_learner_group(self) -> LearnerGroup:
