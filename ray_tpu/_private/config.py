@@ -69,6 +69,15 @@ _flag("spill_directory", str, "", "Directory for object spilling ('' = tmp).")
 _flag("enable_timeline", bool, True, "Record task timeline events.")
 _flag("lineage_enabled", bool, True,
       "Keep task specs for lineage reconstruction of lost objects.")
+_flag("memory_usage_threshold", float, 0.95,
+      "Node memory usage fraction above which workers are OOM-killed.")
+_flag("memory_monitor_refresh_ms", int, 250,
+      "Memory monitor sampling period (0 disables the monitor).")
+_flag("memory_monitor_min_free_bytes", int, -1,
+      "Additionally require this much free memory (-1 = fraction only).")
+_flag("memory_monitor_kill_grace_s", float, 2.0,
+      "Minimum seconds between OOM kills on one node (lets a kill "
+      "actually release memory before the next policy decision).")
 
 # --- TPU --------------------------------------------------------------------
 _flag("tpu_chips_per_host", int, 4, "Logical TPU chips advertised per host.")

@@ -55,6 +55,7 @@ class WorkerInfo:
     # charge tuple: ("node", node_hex, req) | ("pg", pg_id, idx, req) | None
     charge: Any = None
     started_at: float = field(default_factory=time.time)
+    leased_at: Optional[float] = None  # last lease grant (OOM ranking)
 
 
 @dataclass
@@ -173,6 +174,11 @@ class HeadService:
         # Job submission (reference: dashboard/modules/job JobManager):
         # job_id → {entrypoint, status, proc, log_path, ...}
         self.jobs: Dict[str, dict] = {}
+        # OOM kill ledger (reference: raylet worker-killing events in the
+        # state API): newest-first visibility for debugging memory kills.
+        self.oom_kills: deque = deque(maxlen=1000)
+        self._last_oom_kill: Dict[str, float] = {}  # node hex -> ts
+        self._memmon_task = None
 
     # ------------------------------------------------------------- lifecycle
     async def start(self):
@@ -228,6 +234,9 @@ class HeadService:
         if restored:
             self._loop.create_task(self._reconcile_after_restart())
         self._reaper_task = self._loop.create_task(self._reap_loop())
+        if self.config.memory_monitor_refresh_ms > 0:
+            self._memmon_task = self._loop.create_task(
+                self._memory_monitor_loop())
         if getattr(self.config, "dashboard_port", 0) >= 0:
             from .dashboard import DashboardServer
 
@@ -262,6 +271,8 @@ class HeadService:
             await self.dashboard.stop()
         if self._reaper_task:
             self._reaper_task.cancel()
+        if self._memmon_task:
+            self._memmon_task.cancel()
         for w in list(self.workers.values()):
             if w.proc is not None:
                 try:
@@ -331,6 +342,107 @@ class HeadService:
                 sweep_domain_segments(session_shm_domain(sdir))
             except Exception:  # noqa: BLE001 - hygiene only
                 pass
+
+    # --------------------------------------------------- memory monitor
+    async def _memory_monitor_loop(self):
+        """Sample the HEAD host's memory and run the kill policy on
+        breach (node daemons sample their own hosts and report via
+        ``memory_pressure``). Reference: ``memory_monitor.h:52`` —
+        monitor fires a callback per interval; the raylet kills via a
+        WorkerKillingPolicy."""
+        from .memory_monitor import kill_threshold_bytes, sample_memory
+
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                snap = await self._loop.run_in_executor(None, sample_memory)
+                thr = kill_threshold_bytes(
+                    snap, self.config.memory_usage_threshold,
+                    self.config.memory_monitor_min_free_bytes)
+                if snap.used_bytes > thr:
+                    await self._handle_memory_pressure(
+                        self.local_node.node_id, snap.used_bytes,
+                        snap.total_bytes, thr)
+            except Exception:  # noqa: BLE001 - keep the monitor alive
+                pass
+
+    def _select_oom_victim(self, node_hex: str):
+        """Retriable-newest-first policy (reference:
+        ``worker_killing_policy.h:1``): prefer the NEWEST leased task
+        worker — its task loses the least progress and retries via the
+        normal ConnectionLost path (lineage recovery rebuilds its lost
+        objects) — then the newest actor worker that still has restart
+        budget. Leased workers are presumed retriable (leases are
+        task-agnostic here; a max_retries=0 task on a killed lease
+        surfaces WorkerCrashedError to its caller, the reference's
+        OutOfMemoryError analog). Actors without restart budget and
+        idle pool workers are never killed — better to let the kernel
+        OOM killer make that call than to silently destroy
+        unrestartable state."""
+        cands = [w for w in self.workers.values() if w.node == node_hex]
+        leased = [w for w in cands if w.assignment == "lease"]
+        if leased:
+            return (max(leased,
+                        key=lambda w: w.leased_at or w.started_at),
+                    "leased task")
+        restartable = []
+        for w in cands:
+            if isinstance(w.assignment, ActorID):
+                a = self.actors.get(w.assignment)
+                if a and a.state != "DEAD" and \
+                        a.restarts_used < a.max_restarts:
+                    restartable.append(w)
+        if restartable:
+            return (max(restartable, key=lambda w: w.started_at),
+                    "restartable actor")
+        return None, None
+
+    async def _handle_memory_pressure(self, node_hex: str, used: int,
+                                      total: int, threshold: int):
+        now = time.time()
+        if now - self._last_oom_kill.get(node_hex, 0.0) < \
+                self.config.memory_monitor_kill_grace_s:
+            return  # let the previous kill actually release memory
+        w, kind = self._select_oom_victim(node_hex)
+        if w is None:
+            return
+        self._last_oom_kill[node_hex] = now
+        cause = (f"OOM-killed by the memory monitor: node {node_hex[:12]} "
+                 f"used {used / 2**30:.2f}GiB of {total / 2**30:.2f}GiB "
+                 f"(threshold {threshold / 2**30:.2f}GiB); policy chose "
+                 f"the newest {kind}")
+        self.oom_kills.append({
+            "time": now, "node_id": node_hex,
+            "worker_id": w.worker_id.hex(), "pid": w.pid, "kind": kind,
+            "used_bytes": used, "total_bytes": total,
+            "threshold_bytes": threshold,
+        })
+        from .metrics import core_metrics
+
+        core_metrics()["oom_workers_killed"].inc()
+        if w.proc is not None:  # head-local: SIGKILL releases NOW
+            try:
+                w.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        else:
+            node = self.nodes.get(node_hex)
+            if node is not None and node.conn is not None:
+                try:
+                    await node.conn.call_simple(
+                        "kill_worker",
+                        {"worker_id": w.worker_id.hex(), "force": True})
+                except Exception:  # noqa: BLE001 - daemon reap covers it
+                    pass
+        await self._on_worker_death(w, cause)
+
+    async def _rpc_memory_pressure(self, payload, bufs):
+        """Pushed by a node daemon whose host crossed the threshold."""
+        await self._handle_memory_pressure(
+            payload["node_id"], int(payload["used_bytes"]),
+            int(payload["total_bytes"]), int(payload["threshold_bytes"]))
+        return {}
 
     async def _reap_loop(self):
         period = self.config.health_check_period_s
@@ -766,6 +878,8 @@ class HeadService:
             self._release_charged(charge)
             raise
         w.assignment = "lease"
+        w.leased_at = time.time()  # OOM policy ranks by LEASE age —
+        # pooled workers' process age says nothing about task progress
         w.charge = charge
         from .metrics import core_metrics
 
@@ -1699,6 +1813,8 @@ class HeadService:
                     if pg.state != "REMOVED"]
         if kind == "tasks":
             return list(self.task_events)[-1000:]
+        if kind == "oom_kills":
+            return list(self.oom_kills)
         if kind == "objects":
             return {"snapshots": {
                 k: {n: d for n, d in snap.items()

@@ -234,6 +234,10 @@ def core_metrics() -> dict:
             objects_recovered=Counter(
                 "objects_recovered_total",
                 "Lost objects rebuilt via lineage re-execution"),
+            oom_workers_killed=Counter(
+                "oom_workers_killed_total",
+                "Workers killed by the memory monitor under host "
+                "memory pressure"),
         )
     return _core
 

@@ -84,6 +84,9 @@ class NodeService:
         self.node_ip = node_ip or os.environ.get("RT_NODE_IP") or \
             _detect_node_ip(head_address)
         self._conn: Optional[rpc.Connection] = None
+        from .config import Config
+
+        self.config = Config()  # replaced by the head's at registration
         self._procs: Dict[str, subprocess.Popen] = {}  # worker hex -> proc
         self._reap_task: Optional[asyncio.Task] = None
         self._stopping = False
@@ -93,12 +96,13 @@ class NodeService:
     async def start(self):
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self._conn = await rpc.connect(self.head_address, self._handle)
-        await self._conn.call_simple("register_node", {
+        resp = await self._conn.call_simple("register_node", {
             "node_id": self.node_id.hex(),
             "hostname": self.shm_domain,
             "resources": self.resources,
             "labels": self.labels,
         })
+        self._adopt_head_config(resp)
         self._reap_task = asyncio.get_running_loop().create_task(
             self._reap_loop())
         return self
@@ -162,17 +166,30 @@ class NodeService:
         while not self._stopping and time.time() < deadline:
             try:
                 conn = await rpc.connect(self.head_address, self._handle)
-                await conn.call_simple("register_node", {
+                resp = await conn.call_simple("register_node", {
                     "node_id": self.node_id.hex(),
                     "hostname": self.shm_domain,
                     "resources": self.resources,
                     "labels": self.labels,
                 })
+                self._adopt_head_config(resp)
                 self._conn = conn
                 return True
             except Exception:  # noqa: BLE001 - head still down
                 await asyncio.sleep(0.5)
         return False
+
+    def _adopt_head_config(self, register_resp: dict):
+        """Resolve flags as local env > HEAD's cluster config > default,
+        so ``system_config`` passed to init()/Cluster governs remote
+        daemons too (reference: raylet receives the GCS's
+        system-config blob at registration)."""
+        from .config import Config
+
+        try:
+            self.config = Config(register_resp.get("config") or {})
+        except (ValueError, TypeError):  # version-skewed head: defaults
+            self.config = Config()
 
     # ------------------------------------------------------------- handler
     async def _handle(self, method: str, payload: Any, bufs: List[bytes],
@@ -180,7 +197,8 @@ class NodeService:
         if method == "spawn_worker":
             return await self._spawn_worker(payload["worker_id"])
         if method == "kill_worker":
-            return self._kill_worker(payload["worker_id"])
+            return self._kill_worker(payload["worker_id"],
+                                     force=payload.get("force", False))
         if method == "ping":
             return {"ok": True, "node_id": self.node_id.hex()}
         if method == "tail_log":
@@ -209,17 +227,24 @@ class NodeService:
         self._procs[worker_hex] = proc
         return {"pid": proc.pid}
 
-    def _kill_worker(self, worker_hex: str):
+    def _kill_worker(self, worker_hex: str, force: bool = False):
         proc = self._procs.pop(worker_hex, None)
         if proc is not None:
             try:
-                proc.terminate()
+                # force (OOM kills): SIGKILL releases the memory NOW —
+                # a SIGTERM handler in a thrashing worker may never run
+                proc.kill() if force else proc.terminate()
             except Exception:
                 pass
         return {}
 
     async def _reap_loop(self):
+        from .memory_monitor import kill_threshold_bytes, sample_memory
+
+        last_memcheck = 0.0
         while not self._stopping:
+            cfg = self.config  # re-read: a reconnect may refresh it
+            refresh_s = cfg.memory_monitor_refresh_ms / 1000.0
             await asyncio.sleep(0.2)
             for hex_id, proc in list(self._procs.items()):
                 code = proc.poll()
@@ -231,6 +256,27 @@ class NodeService:
                             "cause": f"exit code {code}"})
                     except Exception:
                         pass
+            # Memory monitor: sample THIS host, report breaches to the
+            # head — the kill policy needs assignment info only the
+            # head has (reference: MemoryMonitor callback → raylet's
+            # WorkerKillingPolicy, ``memory_monitor.h:52``).
+            now = time.time()
+            if refresh_s > 0 and now - last_memcheck >= refresh_s:
+                last_memcheck = now
+                try:
+                    snap = sample_memory()
+                    thr = kill_threshold_bytes(
+                        snap, cfg.memory_usage_threshold,
+                        cfg.memory_monitor_min_free_bytes)
+                    if snap.used_bytes > thr:
+                        self._conn.push("memory_pressure", {
+                            "node_id": self.node_id.hex(),
+                            "used_bytes": snap.used_bytes,
+                            "total_bytes": snap.total_bytes,
+                            "threshold_bytes": thr,
+                        })
+                except Exception:  # noqa: BLE001 - monitoring only
+                    pass
 
 
 def _detect_node_ip(head_address: Tuple[str, int]) -> str:

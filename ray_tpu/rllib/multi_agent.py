@@ -208,7 +208,11 @@ class MultiAgentEnvRunner:
         all_term = bool(term.get("__all__", False))
         for (ei, agent) in [k for k in self._pending if k[0] == i]:
             a_term = bool(term.get(agent, all_term))
-            a_trunc = bool(trunc.get(agent, not a_term))
+            # The episode IS over for everyone: any non-terminated agent
+            # is truncated at this point regardless of what its
+            # per-agent flag says — an un-cut final transition would
+            # let GAE leak into the NEXT episode sharing this stream.
+            a_trunc = not a_term
             nv = None
             if not a_term:
                 # bootstrap the truncated tail with V(arrival obs);
@@ -503,26 +507,22 @@ class MultiAgentPPO(Algorithm):
         specs: Dict[str, RLModuleSpec] = {}
         try:
             for pid, spec in items:
-                if isinstance(spec, RLModuleSpec):
-                    specs[pid] = spec
-                    continue
-                agents = [a for a in env.possible_agents
-                          if mapping(a, 0) == pid]
-                if not agents:
-                    raise ValueError(
-                        f"no agent in possible_agents maps to module "
-                        f"{pid!r}; pass an explicit RLModuleSpec")
-                a = agents[0]
-                inferred = spec_from_spaces(
-                    env.observation_spaces[a], env.action_spaces[a],
-                    config.hidden)
-                if inferred.continuous:
+                if not isinstance(spec, RLModuleSpec):
+                    agents = [a for a in env.possible_agents
+                              if mapping(a, 0) == pid]
+                    if not agents:
+                        raise ValueError(
+                            f"no agent in possible_agents maps to module "
+                            f"{pid!r}; pass an explicit RLModuleSpec")
+                    a = agents[0]
+                    spec = spec_from_spaces(
+                        env.observation_spaces[a], env.action_spaces[a],
+                        config.hidden)
+                if spec.continuous:  # explicit AND inferred specs
                     raise NotImplementedError(
-                        f"module {pid!r} (agent {a!r}) has a Box action "
-                        f"space; MultiAgentPPO trains discrete actions "
-                        f"only — wrap the env or provide a discrete "
-                        f"action space")
-                specs[pid] = inferred
+                        f"module {pid!r} has a continuous action space; "
+                        f"MultiAgentPPO trains discrete actions only")
+                specs[pid] = spec
         finally:
             if env is not None:
                 env.close()

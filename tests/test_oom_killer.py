@@ -1,0 +1,115 @@
+"""Memory monitor + OOM worker-killing (reference:
+``src/ray/common/memory_monitor.h:52``,
+``src/ray/raylet/worker_killing_policy.h:1``): a task ballooning past
+the node threshold is killed, its retry completes, the node survives,
+and the kill is visible in metrics + state API."""
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.memory_monitor import (
+    MemorySnapshot,
+    kill_threshold_bytes,
+    sample_memory,
+)
+
+
+def test_sample_memory_sane():
+    snap = sample_memory()
+    assert 0 < snap.used_bytes < snap.total_bytes
+    assert 0.0 < snap.used_fraction < 1.0
+
+
+def test_threshold_math():
+    snap = MemorySnapshot(used_bytes=50, total_bytes=100)
+    assert kill_threshold_bytes(snap, 0.95) == 95
+    # min_free tightens the fraction threshold
+    assert kill_threshold_bytes(snap, 0.95, min_free_bytes=20) == 80
+    assert kill_threshold_bytes(snap, 0.95, min_free_bytes=-1) == 95
+
+
+def test_env_cap_limits_total(monkeypatch):
+    real = sample_memory()
+    cap = real.total_bytes // 2
+    monkeypatch.setenv("RT_MEMORY_LIMIT_BYTES", str(cap))
+    assert sample_memory().total_bytes == cap
+
+
+def test_oom_kill_and_retry(monkeypatch, tmp_path):
+    """The chaos gate: a ballooning retriable task is OOM-killed by the
+    monitor; the retry (which allocates nothing) completes; the node
+    survives; the kill shows up in the state API and metrics."""
+    import ray_tpu as rt
+
+    headroom = 400 * 2**20
+    snap = sample_memory()
+    # Choose limit + threshold so that: current usage is ~comfortably
+    # below the kill line, but a +800MiB balloon crosses it.
+    limit = snap.used_bytes + 2 * headroom
+    threshold = (snap.used_bytes + headroom) / limit
+    monkeypatch.setenv("RT_MEMORY_LIMIT_BYTES", str(limit))
+    monkeypatch.setenv("RT_MEMORY_USAGE_THRESHOLD", f"{threshold:.6f}")
+    monkeypatch.setenv("RT_MEMORY_MONITOR_REFRESH_MS", "100")
+    monkeypatch.setenv("RT_MEMORY_MONITOR_KILL_GRACE_S", "1.0")
+    sentinel = str(tmp_path / "attempt.marker")
+
+    rt.init(num_cpus=2, num_tpus=0)
+    try:
+        @rt.remote(max_retries=3)
+        def balloon(sentinel):
+            import time as _t
+
+            if os.path.exists(sentinel):
+                return "retried-ok"  # second attempt: no allocation
+            with open(sentinel, "w") as f:
+                f.write("1")
+            hog = []
+            for _ in range(16):  # 16 × 50MiB of incompressible pages
+                hog.append(np.random.bytes(50 * 2**20))
+                _t.sleep(0.05)
+            _t.sleep(30)  # hold until the monitor kills us
+            return "survived"  # must not happen
+
+        result = rt.get(balloon.remote(sentinel), timeout=90)
+        assert result == "retried-ok"
+        # state API shows the kill with its policy verdict
+        kills = rt.state("oom_kills")
+        assert len(kills) >= 1
+        assert kills[0]["kind"] == "leased task"
+        assert kills[0]["used_bytes"] > kills[0]["threshold_bytes"]
+        # node survived: normal work still schedules
+        assert rt.get(rt.remote(lambda: 7).remote(), timeout=30) == 7
+    finally:
+        rt.shutdown()
+
+
+def test_oom_retry_exhaustion_surfaces_error(monkeypatch):
+    """Under UNRECLAIMABLE pressure (threshold below baseline usage),
+    every retry gets killed too; the caller sees WorkerCrashedError
+    after the budget drains (the reference surfaces OutOfMemoryError
+    to the caller the same way) instead of hanging forever."""
+    import ray_tpu as rt
+    from ray_tpu.exceptions import TaskError, WorkerCrashedError
+
+    snap = sample_memory()
+    # threshold below CURRENT usage → every sample reports pressure
+    monkeypatch.setenv("RT_MEMORY_LIMIT_BYTES", str(snap.used_bytes * 2))
+    monkeypatch.setenv("RT_MEMORY_USAGE_THRESHOLD", "0.01")
+    monkeypatch.setenv("RT_MEMORY_MONITOR_REFRESH_MS", "100")
+    monkeypatch.setenv("RT_MEMORY_MONITOR_KILL_GRACE_S", "0.2")
+
+    rt.init(num_cpus=1, num_tpus=0)
+    try:
+        @rt.remote(max_retries=1)
+        def steady():
+            import time as _t
+
+            _t.sleep(30.0)  # killed well before this returns
+            return "done"
+
+        with pytest.raises((WorkerCrashedError, TaskError)):
+            rt.get(steady.remote(), timeout=120)
+        assert len(rt.state("oom_kills")) >= 2  # original + retry
+    finally:
+        rt.shutdown()
