@@ -39,6 +39,7 @@ from . import reaper, rpc
 from .config import Config
 from .ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from .utils import spawn_env_with_pkg_root
+from .wal import HeadWAL
 
 
 @dataclass
@@ -70,6 +71,9 @@ class NodeInfo:
     state: str = "ALIVE"  # ALIVE | DEAD
     is_head: bool = False
     labels: Dict[str, str] = field(default_factory=dict)
+    # Physical host (gethostname): co-hosted nodes share one memory
+    # pool, so OOM kill grace is keyed on this, not the node id.
+    phys_host: str = ""
 
     def utilization(self) -> float:
         fracs = [1.0 - self.available.get(k, 0.0) / v
@@ -133,7 +137,7 @@ class HeadService:
         local = NodeInfo(node_id=self.node_id.hex(),
                          hostname=socket.gethostname(),
                          total=dict(resources), available=dict(resources),
-                         is_head=True)
+                         is_head=True, phys_host=socket.gethostname())
         self.nodes: Dict[str, NodeInfo] = {local.node_id: local}
         self.local_node = local
         self.workers: Dict[WorkerID, WorkerInfo] = {}
@@ -179,6 +183,14 @@ class HeadService:
         self.oom_kills: deque = deque(maxlen=1000)
         self._last_oom_kill: Dict[str, float] = {}  # node hex -> ts
         self._memmon_task = None
+        # Mutation WAL: actor/PG/KV/job changes are appended (and
+        # flushed) before the RPC reply, so a kill -9 between periodic
+        # snapshots loses nothing a client saw acknowledged.
+        self.wal = HeadWAL(session_dir)
+        # One persist at a time: two concurrent roll+write+drop cycles
+        # could delete a WAL generation covered only by the NEWER
+        # snapshot and then overwrite it with the older one.
+        self._persist_lock = asyncio.Lock()
 
     # ------------------------------------------------------------- lifecycle
     async def start(self):
@@ -197,6 +209,15 @@ class HeadService:
                 restored = True
             except Exception:  # noqa: BLE001 - a bad snapshot can't brick
                 pass
+        else:
+            # Killed before the first snapshot: the WAL alone is the
+            # durable state.
+            try:
+                if self._replay_wal(0):
+                    restored = True
+            except Exception:  # noqa: BLE001
+                pass
+        self.wal.open_active()
         # A SIGKILL'd predecessor leaves its socket file behind; the new
         # head must re-bind the same path (workers reconnect to it). But
         # NEVER steal the socket of a LIVE head — probe it first, or a
@@ -264,7 +285,7 @@ class HeadService:
     async def stop(self):
         self._shutting_down = True
         try:
-            self.persist_state()
+            await self.persist_state(offload=False)
         except Exception:  # noqa: BLE001
             pass
         if self.dashboard is not None:
@@ -309,6 +330,7 @@ class HeadService:
         from .utils import session_shm_domain
 
         sweep_domain_segments(session_shm_domain(self.session_dir))
+        self.wal.close()
 
     def _sweep_dead_sessions(self):
         """Reclaim shm segments of SESSIONS THAT DIED WITHOUT CLEANUP
@@ -401,13 +423,19 @@ class HeadService:
     async def _handle_memory_pressure(self, node_hex: str, used: int,
                                       total: int, threshold: int):
         now = time.time()
-        if now - self._last_oom_kill.get(node_hex, 0.0) < \
+        # Grace keyed by PHYSICAL host: a co-hosted head + daemons all
+        # observe the same breach within one sampling period, and one
+        # kill must cover all of them.
+        n = self.nodes.get(node_hex)
+        grace_key = (n.phys_host if n is not None and n.phys_host
+                     else node_hex)
+        if now - self._last_oom_kill.get(grace_key, 0.0) < \
                 self.config.memory_monitor_kill_grace_s:
             return  # let the previous kill actually release memory
         w, kind = self._select_oom_victim(node_hex)
         if w is None:
             return
-        self._last_oom_kill[node_hex] = now
+        self._last_oom_kill[grace_key] = now
         cause = (f"OOM-killed by the memory monitor: node {node_hex[:12]} "
                  f"used {used / 2**30:.2f}GiB of {total / 2**30:.2f}GiB "
                  f"(threshold {threshold / 2**30:.2f}GiB); policy chose "
@@ -475,9 +503,7 @@ class HeadService:
                 try:
                     # Dict walk on the loop (no concurrent mutation);
                     # only pickle+write leave the thread.
-                    data = self.snapshot_state()
-                    await self._loop.run_in_executor(
-                        None, self._write_snapshot, data)
+                    await self.persist_state()
                 except Exception:  # noqa: BLE001 - keep the reaper alive
                     import traceback as _tb
 
@@ -667,6 +693,8 @@ class HeadService:
         actor.worker = None
         if actor.name:
             self.named_actors.pop(actor.name, None)
+        self.wal.append({"op": "actor_dead",
+                         "actor_id": actor.actor_id.hex(), "cause": cause})
         self.publish(f"actor:{actor.actor_id.hex()}",
                      {"state": "DEAD", "cause": cause})
 
@@ -972,6 +1000,7 @@ class HeadService:
             available=dict(payload["resources"]),
             conn=conn,
             labels=dict(payload.get("labels") or {}),
+            phys_host=payload.get("host") or payload.get("hostname") or "?",
         )
         self.nodes[node.node_id] = node
         prev_close = conn.on_close
@@ -1106,6 +1135,7 @@ class HeadService:
         self.actors[actor_id] = actor
         if name:
             self.named_actors[name] = actor_id
+        self.wal.append({"op": "actor", "rec": self._actor_record(actor)})
         return actor
 
     async def _rpc_register_actor(self, payload, bufs):
@@ -1228,6 +1258,8 @@ class HeadService:
         if not overwrite and k in store:
             return {"added": False}
         store[k] = bufs[0] if bufs else payload.get("value", b"")
+        self.wal.append({"op": "kv_put", "ns": ns, "key": k,
+                         "value": bytes(store[k])})
         return {"added": True}
 
     async def _rpc_kv_get(self, payload, bufs):
@@ -1240,6 +1272,9 @@ class HeadService:
     async def _rpc_kv_del(self, payload, bufs):
         ns = payload.get("ns", "default")
         existed = self.kv[ns].pop(payload["key"], None) is not None
+        if existed:
+            self.wal.append({"op": "kv_del", "ns": ns,
+                             "key": payload["key"]})
         return {"deleted": existed}
 
     async def _rpc_kv_keys(self, payload, bufs):
@@ -1323,6 +1358,7 @@ class HeadService:
         pg = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy,
                                 state="PENDING", name=payload.get("name", ""))
         self.pgs[pg_id] = pg
+        self.wal.append({"op": "pg", "rec": self._pg_record(pg)})
         deadline = time.time() + payload.get(
             "timeout", self.config.worker_lease_timeout_s)
         while True:
@@ -1340,6 +1376,7 @@ class HeadService:
                 # the create-RPC-in-flight race.
                 pg.state = "REMOVED"
                 pg.removed_at = time.time()
+                self.wal.append({"op": "pg_remove", "pg_id": pg_id.hex()})
                 raise rpc.RpcError(
                     f"placement group infeasible: strategy {strategy}, "
                     f"bundles {[b.resources for b in bundles]}, "
@@ -1366,6 +1403,7 @@ class HeadService:
                     self._node_release(node, b.resources)
         pg.state = "REMOVED"
         pg.removed_at = time.time()
+        self.wal.append({"op": "pg_remove", "pg_id": pg_id.hex()})
         self._pump_leases()
         return {}
 
@@ -1594,6 +1632,8 @@ class HeadService:
             "started_at": time.time(), "finished_at": None,
             "returncode": None,
         }
+        self.wal.append({"op": "job",
+                         "rec": self._job_public(self.jobs[job_id])})
         return {"job_id": job_id}
 
     def _poll_jobs(self):
@@ -1605,6 +1645,8 @@ class HeadService:
                 job["status"] = ("SUCCEEDED" if proc.returncode == 0
                                  else "FAILED")
                 job["finished_at"] = time.time()
+                self.wal.append({"op": "job",
+                                 "rec": self._job_public(job)})
 
     def _job_public(self, job: dict) -> dict:
         return {k: v for k, v in job.items() if k != "proc"}
@@ -1652,17 +1694,9 @@ class HeadService:
 
         MUST run on the event-loop thread (it iterates live dicts);
         pickling/writing the result may be offloaded."""
-        actors = [{
-            "actor_id": a.actor_id.hex(), "name": a.name, "state": a.state,
-            "resources": dict(a.resources), "max_restarts": a.max_restarts,
-            "spec_meta": a.creation_spec_meta, "strategy": a.strategy,
-            "detached": a.detached, "death_cause": a.death_cause,
-        } for a in list(self.actors.values())]
-        pgs = [{
-            "pg_id": pg.pg_id.hex(), "strategy": pg.strategy,
-            "name": pg.name,
-            "bundles": [dict(b.resources) for b in pg.bundles],
-        } for pg in list(self.pgs.values()) if pg.state != "REMOVED"]
+        actors = [self._actor_record(a) for a in list(self.actors.values())]
+        pgs = [self._pg_record(pg) for pg in list(self.pgs.values())
+               if pg.state != "REMOVED"]
         return {
             "kv": {ns: dict(store) for ns, store in list(self.kv.items())},
             "actors": actors,
@@ -1673,7 +1707,28 @@ class HeadService:
             # daemons/workers/drivers reconnect to the address they know.
             "tcp_port": self._tcp_server._port if self._tcp_server
             else None,
+            # First WAL generation NOT covered by this snapshot
+            # (persist rolls the WAL immediately before capturing).
+            "wal_gen": self.wal.gen,
             "timestamp": time.time(),
+        }
+
+    @staticmethod
+    def _actor_record(a: ActorInfo) -> dict:
+        """Durable form of an actor — shared by snapshot and WAL."""
+        return {
+            "actor_id": a.actor_id.hex(), "name": a.name, "state": a.state,
+            "resources": dict(a.resources), "max_restarts": a.max_restarts,
+            "spec_meta": a.creation_spec_meta, "strategy": a.strategy,
+            "detached": a.detached, "death_cause": a.death_cause,
+        }
+
+    @staticmethod
+    def _pg_record(pg: PlacementGroupInfo) -> dict:
+        return {
+            "pg_id": pg.pg_id.hex(), "strategy": pg.strategy,
+            "name": pg.name,
+            "bundles": [dict(b.resources) for b in pg.bundles],
         }
 
     def _write_snapshot(self, data: dict) -> str:
@@ -1686,8 +1741,24 @@ class HeadService:
         os.replace(path + ".tmp", path)
         return path
 
-    def persist_state(self) -> str:
-        return self._write_snapshot(self.snapshot_state())
+    def _snapshot_for_persist(self) -> dict:
+        """Roll the WAL, then capture — both on the event loop, so the
+        snapshot covers exactly the generations below the new one."""
+        self.wal.roll()
+        return self.snapshot_state()
+
+    async def persist_state(self, offload: bool = True) -> str:
+        """Serialized snapshot+WAL-cleanup cycle (reaper, RPC, and stop
+        all funnel here — see ``_persist_lock``)."""
+        async with self._persist_lock:
+            data = self._snapshot_for_persist()
+            if offload:
+                path = await self._loop.run_in_executor(
+                    None, self._write_snapshot, data)
+            else:
+                path = self._write_snapshot(data)
+            self.wal.drop_below(data["wal_gen"])
+            return path
 
     def restore_state(self, path: str) -> None:
         """Adopt a previous head's durable state. Actors whose processes
@@ -1702,48 +1773,93 @@ class HeadService:
             self.kv[ns].update(store)
         self._restored_tcp_port = st.get("tcp_port")
         for rec in st["actors"]:
-            actor_id = ActorID.from_hex(rec["actor_id"])
-            was_live = rec["state"] not in ("DEAD",)
-            a = ActorInfo(
-                actor_id=actor_id, name=rec["name"],
-                # Live actors' processes may have survived the head
-                # crash (node-daemon workers): hold them RESTARTING for
-                # the reconnect grace window; workers that reattach with
-                # ``hosting_actors`` flip them back to ALIVE, the rest
-                # go through the normal failure/restart path (reference:
-                # ``gcs_failover_worker_reconnect_timeout``,
-                # ``ray_config_def.h:60``).
-                state="RESTARTING" if was_live else "DEAD",
-                worker=None, resources=rec["resources"],
-                max_restarts=rec["max_restarts"],
-                creation_spec_meta=rec["spec_meta"],
-                strategy=rec["strategy"], detached=rec["detached"],
-                death_cause=(rec["death_cause"] if not was_live
-                             else ""),
-                registered_at=time.time(),
-            )
-            self.actors[actor_id] = a
-            if a.name and a.name not in self.named_actors:
-                self.named_actors[a.name] = actor_id
+            self._restore_actor_record(rec)
         for rec in st["pgs"]:
-            pg_id = PlacementGroupID.from_hex(rec["pg_id"])
-            bundles = [Bundle(i, dict(r))
-                       for i, r in enumerate(rec["bundles"])]
-            self.pgs[pg_id] = PlacementGroupInfo(
-                pg_id=pg_id, bundles=bundles, strategy=rec["strategy"],
-                state="PENDING", name=rec["name"],
-                remaining=[dict(b.resources) for b in bundles],
-                bundle_nodes=[None] * len(bundles))
+            self._restore_pg_record(rec)
         for job in st["jobs"]:
-            job = dict(job)
-            if job["status"] in ("PENDING", "RUNNING"):
-                job["status"] = "FAILED"
-                job["finished_at"] = job.get("finished_at") or time.time()
-            self.jobs[job["job_id"]] = job
+            self._restore_job_record(job)
         self.job_counter = max(self.job_counter, st.get("job_counter", 0))
+        self._replay_wal(st.get("wal_gen", 0))
+
+    def _restore_actor_record(self, rec: dict):
+        actor_id = ActorID.from_hex(rec["actor_id"])
+        was_live = rec["state"] not in ("DEAD",)
+        a = ActorInfo(
+            actor_id=actor_id, name=rec["name"],
+            # Live actors' processes may have survived the head
+            # crash (node-daemon workers): hold them RESTARTING for
+            # the reconnect grace window; workers that reattach with
+            # ``hosting_actors`` flip them back to ALIVE, the rest
+            # go through the normal failure/restart path (reference:
+            # ``gcs_failover_worker_reconnect_timeout``,
+            # ``ray_config_def.h:60``).
+            state="RESTARTING" if was_live else "DEAD",
+            worker=None, resources=rec["resources"],
+            max_restarts=rec["max_restarts"],
+            creation_spec_meta=rec["spec_meta"],
+            strategy=rec["strategy"], detached=rec["detached"],
+            death_cause=(rec["death_cause"] if not was_live
+                         else ""),
+            registered_at=time.time(),
+        )
+        self.actors[actor_id] = a
+        # Live actors (re)claim their name; dead ones keep it resolvable
+        # for diagnosis only if nobody else holds it.
+        if a.name and (was_live or a.name not in self.named_actors):
+            self.named_actors[a.name] = actor_id
+
+    def _restore_pg_record(self, rec: dict):
+        pg_id = PlacementGroupID.from_hex(rec["pg_id"])
+        bundles = [Bundle(i, dict(r))
+                   for i, r in enumerate(rec["bundles"])]
+        self.pgs[pg_id] = PlacementGroupInfo(
+            pg_id=pg_id, bundles=bundles, strategy=rec["strategy"],
+            state="PENDING", name=rec["name"],
+            remaining=[dict(b.resources) for b in bundles],
+            bundle_nodes=[None] * len(bundles))
+
+    def _restore_job_record(self, job: dict):
+        job = dict(job)
+        if job["status"] in ("PENDING", "RUNNING"):
+            job["status"] = "FAILED"
+            job["finished_at"] = job.get("finished_at") or time.time()
+        self.jobs[job["job_id"]] = job
+
+    def _replay_wal(self, first_gen: int) -> int:
+        """Apply mutations logged after the snapshot being restored.
+        Records replay in append order over the snapshot state; the
+        appliers are upserts, so a record both snapshotted AND logged
+        (snapshot raced the write) converges to the same state."""
+        n = 0
+        for rec in self.wal.replay_from(first_gen):
+            n += 1
+            op = rec.get("op")
+            if op == "kv_put":
+                self.kv[rec["ns"]][rec["key"]] = rec["value"]
+            elif op == "kv_del":
+                self.kv[rec["ns"]].pop(rec["key"], None)
+            elif op == "actor":
+                self._restore_actor_record(rec["rec"])
+            elif op == "actor_dead":
+                a = self.actors.get(ActorID.from_hex(rec["actor_id"]))
+                if a is not None:
+                    a.state = "DEAD"
+                    a.death_cause = rec.get("cause", "")
+                    if a.name:
+                        self.named_actors.pop(a.name, None)
+            elif op == "pg":
+                self._restore_pg_record(rec["rec"])
+            elif op == "pg_remove":
+                self.pgs.pop(
+                    PlacementGroupID.from_hex(rec["pg_id"]), None)
+            elif op == "job":
+                self._restore_job_record(rec["rec"])
+            elif op == "job_counter":
+                self.job_counter = max(self.job_counter, rec["value"])
+        return n
 
     async def _rpc_persist_state(self, payload, bufs):
-        return {"path": self.persist_state()}
+        return {"path": await self.persist_state()}
 
     async def _rpc_autoscaler_state(self, payload, bufs):
         """Demand signals for the autoscaler loop (reference: v2 instance
@@ -1874,6 +1990,9 @@ class HeadService:
 
     async def _rpc_new_job_id(self, payload, bufs):
         self.job_counter += 1
+        # Durable before reply: a restarted head must never hand out a
+        # job index that collides with one it already granted.
+        self.wal.append({"op": "job_counter", "value": self.job_counter})
         return {"job_index": self.job_counter}
 
     async def _rpc_prestart_workers(self, payload, bufs):

@@ -99,6 +99,7 @@ class NodeService:
         resp = await self._conn.call_simple("register_node", {
             "node_id": self.node_id.hex(),
             "hostname": self.shm_domain,
+            "host": socket.gethostname(),
             "resources": self.resources,
             "labels": self.labels,
         })
@@ -169,6 +170,7 @@ class NodeService:
                 resp = await conn.call_simple("register_node", {
                     "node_id": self.node_id.hex(),
                     "hostname": self.shm_domain,
+                    "host": socket.gethostname(),
                     "resources": self.resources,
                     "labels": self.labels,
                 })
