@@ -1,0 +1,125 @@
+"""Serve framework-overhead benchmark: echo deployment, zero device
+work (reference budget: sub-ms proxy+router+replica overhead per
+request, SURVEY.md §3.5 / ``python/ray/serve/benchmarks``).
+
+Isolates what the framework itself costs: HTTP proxy parse →
+deployment handle router → replica asyncio call → response encode,
+with a no-op replica body. Two paths are measured:
+
+- ``http``: closed-loop clients through the real HTTP/1.1 proxy with
+  keep-alive (the full ingress stack).
+- ``handle``: DeploymentHandle calls from a driver (router + replica
+  transport only — what a composed deployment graph pays per hop).
+
+Run: ``python benchmarks/serve_echo.py [--clients 8] [--secs 8]``;
+prints one JSON line per metric.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentiles(lat):
+    import numpy as np
+
+    a = np.asarray(lat)
+    return (float(np.percentile(a, 50) * 1e3),
+            float(np.percentile(a, 99) * 1e3))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--clients", type=int, default=8)
+    parser.add_argument("--secs", type=float, default=8.0)
+    args = parser.parse_args()
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    rt.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+    @serve.deployment(max_ongoing_requests=256)
+    class Echo:
+        async def __call__(self, request):
+            return b"ok"
+
+        async def ping(self, payload):
+            return payload
+
+    handle = serve.run(Echo.bind(), name="echo", route_prefix="/echo")
+    port = serve.status()["http"]["port"]
+
+    # ---------------------------------------------------------- HTTP path
+    import http.client
+
+    host = "127.0.0.1"
+    lat_lock = threading.Lock()
+    lats: list = []
+    stop_at = time.time() + args.secs
+
+    def client_loop():
+        conn = http.client.HTTPConnection(host, int(port))
+        mine = []
+        while time.time() < stop_at:
+            t0 = time.perf_counter()
+            conn.request("GET", "/echo")
+            resp = conn.getresponse()
+            resp.read()
+            mine.append(time.perf_counter() - t0)
+        with lat_lock:
+            lats.extend(mine)
+        conn.close()
+
+    # warmup (connection setup, route table, replica import)
+    warm = threading.Thread(target=client_loop)
+    saved = stop_at
+    stop_at = time.time() + 1.0
+    warm.start()
+    warm.join()
+    lats.clear()
+    stop_at = saved
+
+    threads = [threading.Thread(target=client_loop)
+               for _ in range(args.clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    p50, p99 = _percentiles(lats)
+    print(json.dumps({
+        "metric": "serve_echo_http_p50_ms", "value": round(p50, 3),
+        "p99_ms": round(p99, 3), "unit": "ms", "clients": args.clients,
+        "throughput_rps": round(len(lats) / wall, 1)}))
+
+    # -------------------------------------------------------- handle path
+    # sequential closed loop: per-hop latency of a composed graph
+    ping = handle.options(method_name="ping")
+    for _ in range(200):  # warmup
+        ping.remote(b"x").result()
+    hl = []
+    end = time.time() + args.secs / 2
+    while time.time() < end:
+        t0 = time.perf_counter()
+        ping.remote(b"x").result()
+        hl.append(time.perf_counter() - t0)
+    p50h, p99h = _percentiles(hl)
+    print(json.dumps({
+        "metric": "serve_echo_handle_p50_ms", "value": round(p50h, 3),
+        "p99_ms": round(p99h, 3), "unit": "ms", "calls": len(hl)}))
+
+    serve.shutdown()
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,9 @@ class NodeInfo:
     labels: Dict[str, str] = field(default_factory=dict)
     # Physical host (gethostname): co-hosted nodes share one memory
     # pool, so OOM kill grace is keyed on this, not the node id.
+    # Assumes hostnames are unique across machines in one cluster (the
+    # usual case; containers sharing a fixed hostname would couple
+    # their kill grace windows — conservative, never unsafe).
     phys_host: str = ""
 
     def utilization(self) -> float:
@@ -211,10 +214,15 @@ class HeadService:
                 pass
         else:
             # Killed before the first snapshot: the WAL alone is the
-            # durable state.
+            # durable state, and the predecessor's session.json is the
+            # only record of the TCP port remote peers keep redialing.
             try:
                 if self._replay_wal(0):
                     restored = True
+                    with open(os.path.join(self.session_dir,
+                                           "session.json")) as f:
+                        self._restored_tcp_port = json.load(
+                            f)["tcp_address"][1]
             except Exception:  # noqa: BLE001
                 pass
         self.wal.open_active()

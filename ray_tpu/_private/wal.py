@@ -81,19 +81,26 @@ class HeadWAL:
                 self._f.close()
             except OSError:
                 pass
-            self._f = open(self._path(self.gen), "ab")
             try:
+                self._f = open(self._path(self.gen), "ab")
                 self._f.truncate(pos)
             except OSError:
-                pass
+                # Damaged file unrepairable: abandon it for a fresh
+                # generation — replay treats its torn tail as that
+                # file's end and CONTINUES with later generations, so
+                # subsequent acked records stay reachable.
+                try:
+                    self._f = open(self._path(self.gen + 1), "ab")
+                    self.gen += 1
+                except OSError:
+                    self._f = None  # no durability until next roll
             raise
 
     def replay_from(self, first_gen: int) -> Iterator[dict]:
         """Records of every generation >= ``first_gen``, in append
-        order. A torn tail (kill -9 mid-append) ends that file's
-        replay; later generations still replay — they can only exist
-        if the torn file was fully covered by a snapshot roll, which
-        never tears."""
+        order. A torn tail (kill -9 mid-append, or a file abandoned
+        after an unrepairable failed append) ends that file's replay;
+        later generations still replay."""
         for g in self.existing_gens():
             if g < first_gen:
                 continue

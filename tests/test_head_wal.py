@@ -2,15 +2,12 @@
 Redis, ``src/ray/gcs/store_client/redis_store_client.h``): mutations
 acknowledged moments before a kill -9 survive the restart — no
 snapshot-cadence loss window."""
-import json
 import os
 import signal
 import subprocess
 import sys
 import tempfile
 import time
-
-import pytest
 
 import ray_tpu as rt
 from ray_tpu._private.wal import HeadWAL
