@@ -63,9 +63,8 @@ def main():
     host = "127.0.0.1"
     lat_lock = threading.Lock()
     lats: list = []
-    stop_at = time.time() + args.secs
 
-    def client_loop():
+    def client_loop(stop_at):
         conn = http.client.HTTPConnection(host, int(port))
         mine = []
         while time.time() < stop_at:
@@ -79,15 +78,15 @@ def main():
         conn.close()
 
     # warmup (connection setup, route table, replica import)
-    warm = threading.Thread(target=client_loop)
-    saved = stop_at
-    stop_at = time.time() + 1.0
+    warm = threading.Thread(target=client_loop,
+                            args=(time.time() + 1.0,))
     warm.start()
     warm.join()
     lats.clear()
-    stop_at = saved
 
-    threads = [threading.Thread(target=client_loop)
+    # measurement window starts NOW, full --secs long
+    stop_at = time.time() + args.secs
+    threads = [threading.Thread(target=client_loop, args=(stop_at,))
                for _ in range(args.clients)]
     t0 = time.time()
     for t in threads:

@@ -89,6 +89,11 @@ class HeadWAL:
                 # generation — replay treats its torn tail as that
                 # file's end and CONTINUES with later generations, so
                 # subsequent acked records stay reachable.
+                if self._f is not None:
+                    try:
+                        self._f.close()  # don't leak the damaged fd
+                    except OSError:
+                        pass
                 try:
                     self._f = open(self._path(self.gen + 1), "ab")
                     self.gen += 1

@@ -12,10 +12,13 @@ from .datasource import (  # noqa: F401
     from_pandas,
     range,
     range_tensor,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 from .executor import (  # noqa: F401
     ActorPoolStrategy,

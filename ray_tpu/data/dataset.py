@@ -266,6 +266,20 @@ class Dataset:
                     for r in rows:
                         w.writerow([r])
 
+    def write_tfrecords(self, path: str) -> None:
+        """One TFRecord file per block, tf.train.Example rows
+        (reference ``Dataset.write_tfrecords``)."""
+        import os
+
+        from .tfrecords import write_tfrecord_file
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self._exec_blocks()):
+            rows = (r if isinstance(r, dict) else {"data": r}
+                    for r in B.iter_rows(blk))
+            write_tfrecord_file(
+                os.path.join(path, f"part_{i:05d}.tfrecords"), rows)
+
     def write_parquet(self, path: str) -> None:
         import os
 
@@ -392,45 +406,135 @@ def _limit_iter(it: Iterator[B.Block], n: int) -> Iterator[B.Block]:
         yield blk
 
 
+def _take_rows(blk: B.Block, idx) -> B.Block:
+    """Select rows of a block by an integer index array."""
+    if B.is_tabular(blk):
+        return {k: np.asarray(v)[idx] for k, v in blk.items()}
+    return [blk[i] for i in idx]
+
+
 def _repartition(it: Iterator[B.Block], n: int) -> Iterator[B.Block]:
-    merged = B.concat_blocks(list(it))
-    total = B.block_len(merged)
-    per = max(1, total // n) if total else 0
-    for i in range(n):
-        lo = i * per
-        hi = (i + 1) * per if i < n - 1 else total
-        if lo >= total:
-            yield type(merged)() if not B.is_tabular(merged) else \
-                {k: v[:0] for k, v in merged.items()}
-        else:
-            yield B.slice_block(merged, lo, hi)
+    """Distributed ORDER-PRESERVING repartition (reference:
+    ``planner/exchange/split_repartition_task_scheduler.py``): stage
+    blocks while recording row counts (one block in driver memory at a
+    time), compute global split points, then map tasks slice their
+    block by global offset and reduce i concatenates range i in block
+    order — rows come out exactly as they went in."""
+    from .executor import refs_exchange
+
+    in_refs, offsets, total = [], [], 0
+    for blk in it:
+        in_refs.append(rt.put(blk))
+        offsets.append(total)
+        total += B.block_len(blk)
+        del blk
+    if not in_refs:
+        return
+    per = total // n
+    # partition p covers global rows [cuts[p], cuts[p+1])
+    cuts = [p * per for p in range(n)] + [total]
+
+    def split(blk, idx, P):
+        base = offsets[idx]
+        ln = B.block_len(blk)
+        out = []
+        for p in range(P):
+            lo = max(cuts[p] - base, 0)
+            hi = min(cuts[p + 1] - base, ln)
+            out.append(B.slice_block(blk, lo, hi) if lo < hi else [])
+        return out
+
+    def reduce(parts, pidx):
+        return B.concat_blocks([p for p in parts if B.block_len(p)])
+
+    yield from _resolve(refs_exchange(in_refs, split, reduce,
+                                      num_partitions=n))
 
 
 def _shuffle(it: Iterator[B.Block], seed) -> Iterator[B.Block]:
-    blocks = list(it)
-    rng = np.random.default_rng(seed)
-    merged = B.concat_blocks(blocks)
-    total = B.block_len(merged)
-    perm = rng.permutation(total)
-    if B.is_tabular(merged):
-        shuffled: B.Block = {k: v[perm] for k, v in merged.items()}
-    else:
-        shuffled = [merged[i] for i in perm]
-    n = max(1, len(blocks))
-    per = max(1, total // n)
-    for i in range(n):
-        lo, hi = i * per, ((i + 1) * per if i < n - 1 else total)
-        if lo < total:
-            yield B.slice_block(shuffled, lo, hi)
+    """Distributed random shuffle: map tasks scatter rows to random
+    partitions, reduce tasks permute within their partition — the
+    classic two-stage block exchange (reference:
+    ``planner/exchange/shuffle_task_spec.py``)."""
+    from .executor import exchange_stage
+
+    # unseeded shuffles must differ run to run: draw fresh entropy
+    base = seed if seed is not None else np.random.SeedSequence().entropy
+
+    def split(blk, idx, P):
+        rng = np.random.default_rng((base, idx))
+        part = rng.integers(0, P, B.block_len(blk))
+        return [_take_rows(blk, np.nonzero(part == p)[0])
+                for p in range(P)]
+
+    def reduce(parts, pidx):
+        merged = B.concat_blocks([p for p in parts if B.block_len(p)])
+        rng = np.random.default_rng((base, 0x0F, pidx))
+        return _take_rows(merged, rng.permutation(B.block_len(merged)))
+
+    yield from _resolve(exchange_stage(it, split, reduce))
 
 
 def _sort(it: Iterator[B.Block], key, descending) -> Iterator[B.Block]:
-    rows = []
-    for blk in it:
-        rows.extend(B.iter_rows(blk))
+    """Distributed sample sort: sample keys per block → P-1 range
+    boundaries → map tasks range-partition → reduce tasks sort their
+    range; partitions concatenate to a global order (reference:
+    ``planner/exchange/sort_task_spec.py`` SortTaskSpec.sample_boundaries).
+    """
+    from .executor import refs_exchange, sample_stage
+
     keyfn = key if callable(key) else (lambda r: r[key])
-    rows.sort(key=keyfn, reverse=descending)
-    yield B.rows_to_block(rows)
+
+    def sample(blk):
+        ln = B.block_len(blk)
+        if not ln:
+            return []
+        step = max(1, ln // 16)
+        if B.is_tabular(blk) and not callable(key):
+            return list(np.asarray(blk[key])[::step])
+        # strided scan without materializing every row into a list
+        return [keyfn(r) for i, r in enumerate(B.iter_rows(blk))
+                if i % step == 0]
+
+    in_refs, samples = sample_stage(it, sample)
+    if not in_refs:
+        return
+    P = len(in_refs)
+    flat = sorted(s for chunk in samples for s in chunk)
+    if flat:
+        bounds = [flat[int(len(flat) * (i + 1) / P)]
+                  for i in range(P - 1)
+                  if int(len(flat) * (i + 1) / P) < len(flat)]
+    else:
+        bounds = []
+
+    def split(blk, idx, P):
+        import bisect
+
+        rows = list(B.iter_rows(blk))
+        buckets: List[List[Any]] = [[] for _ in range(P)]
+        for r in rows:
+            p = bisect.bisect_right(bounds, keyfn(r)) if bounds else 0
+            buckets[min(p, P - 1)].append(r)
+        return [B.rows_to_block(b) for b in buckets]
+
+    def reduce(parts, pidx):
+        rows = []
+        for p in parts:
+            rows.extend(B.iter_rows(p))
+        rows.sort(key=keyfn)
+        return B.rows_to_block(rows)
+
+    out = list(refs_exchange(in_refs, split, reduce, num_partitions=P))
+    if descending:
+        out = out[::-1]
+    for ref in out:
+        blk = rt.get(ref, timeout=300)
+        if descending:
+            ln = B.block_len(blk)
+            blk = _take_rows(blk, np.arange(ln - 1, -1, -1))
+        if B.block_len(blk):
+            yield blk
 
 
 def _zip(a: Iterator[B.Block], b: Iterator[B.Block]) -> Iterator[B.Block]:

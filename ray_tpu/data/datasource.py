@@ -135,3 +135,72 @@ def read_text(path: str) -> Dataset:
                 yield B.rows_to_block(lines)
 
     return Dataset([_Op("read", make_blocks=make)])
+
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def read_images(path: str, *, size: Optional[tuple] = None,
+                mode: Optional[str] = None,
+                include_paths: bool = False) -> Dataset:
+    """Image directory → rows of ``{"image": HxWxC uint8}`` (reference:
+    ``python/ray/data/datasource/image_datasource.py`` —
+    ``ImageDatasource`` with size/mode options). One block per file
+    keeps decode parallel under the streaming executor."""
+
+    def make():
+        from PIL import Image
+
+        if os.path.isdir(path):
+            paths = sorted(
+                p for ext in _IMAGE_EXTS
+                for p in _glob.glob(os.path.join(path, f"*{ext}")))
+        else:
+            paths = sorted(_glob.glob(path)) or [path]
+        for p in paths:
+            img = Image.open(p)
+            if mode is not None:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize((size[1], size[0]))
+            row: Dict[str, Any] = {"image": np.asarray(img)}
+            if include_paths:
+                row["path"] = p
+            yield [row]  # simple block: image shapes may differ per file
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def read_binary_files(path: str, *, include_paths: bool = False) -> Dataset:
+    """Raw file bytes (reference ``binary_datasource.py``)."""
+
+    def make():
+        paths = (sorted(_glob.glob(os.path.join(path, "*")))
+                 if os.path.isdir(path)
+                 else sorted(_glob.glob(path)) or [path])
+        for p in paths:
+            if not os.path.isfile(p):
+                continue
+            with open(p, "rb") as f:
+                row: Dict[str, Any] = {"bytes": f.read()}
+            if include_paths:
+                row["path"] = p
+            yield [row]
+
+    return Dataset([_Op("read", make_blocks=make)])
+
+
+def read_tfrecords(path: str) -> Dataset:
+    """TFRecord files of tf.train.Example rows — parsed by the built-in
+    dependency-free codec (``tfrecords.py``; reference
+    ``tfrecords_datasource.py``)."""
+
+    def make():
+        from .tfrecords import read_tfrecord_file
+
+        for p in _expand_paths(path, ".tfrecords"):
+            rows = list(read_tfrecord_file(p))
+            if rows:
+                yield B.rows_to_block(rows)
+
+    return Dataset([_Op("read", make_blocks=make)])

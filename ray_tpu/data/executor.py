@@ -182,6 +182,72 @@ def task_pool_stage(ref_iter: Iterator, transform: Callable,
         yield pending.pop(0)
 
 
+def exchange_stage(block_iter: Iterator, split_fn: Callable,
+                   reduce_fn: Callable,
+                   num_partitions: Optional[int] = None,
+                   num_cpus: float = 1) -> Iterator:
+    """All-to-all block exchange (reference:
+    ``python/ray/data/_internal/planner/exchange/`` — ShuffleTaskSpec's
+    map/reduce split): MAP tasks split every input block into P
+    partition blocks, REDUCE tasks merge the i-th partition of every
+    map output. All data moves through the object store — the driver
+    streams input blocks one at a time into the store and afterwards
+    holds only refs, so shuffles scale past driver memory.
+
+    ``split_fn(block, block_idx, P) -> list[P blocks]``;
+    ``reduce_fn(list[blocks], partition_idx) -> block``.
+    Yields refs of the P reduced blocks, in partition order.
+    """
+    # Stage the input stream: one block in driver memory at a time.
+    in_refs = []
+    for blk in block_iter:
+        in_refs.append(rt.put(blk))
+        del blk
+    yield from refs_exchange(in_refs, split_fn, reduce_fn,
+                             num_partitions, num_cpus)
+
+
+def sample_stage(block_iter: Iterator, sample_fn: Callable,
+                 num_cpus: float = 1):
+    """Run ``sample_fn(block) -> small sample`` on every block remotely
+    and ALSO hand back the staged refs, so a sampling pass (sort's
+    boundary estimation) doesn't force a second materialization.
+
+    Returns ``(staged_refs, samples)``.
+    """
+    in_refs = [rt.put(blk) for blk in block_iter]
+    fn = rt.remote(sample_fn).options(num_cpus=num_cpus)
+    samples = [rt.get(r, timeout=300)
+               for r in [fn.remote(ref) for ref in in_refs]]
+    return in_refs, samples
+
+
+def refs_exchange(in_refs: List, split_fn: Callable, reduce_fn: Callable,
+                  num_partitions: Optional[int] = None,
+                  num_cpus: float = 1) -> Iterator:
+    """exchange_stage over already-staged refs (sort path: the sample
+    pass staged them)."""
+    if not in_refs:
+        return
+    P = num_partitions or len(in_refs)
+
+    def _map(blk, idx):
+        parts = split_fn(blk, idx, P)
+        return tuple(parts) if P > 1 else parts[0]
+
+    def _reduce(pidx, *parts):
+        return reduce_fn(list(parts), pidx)
+
+    map_remote = rt.remote(_map).options(num_returns=P, num_cpus=num_cpus)
+    red_remote = rt.remote(_reduce).options(num_cpus=num_cpus)
+    map_refs = []
+    for idx, ref in enumerate(in_refs):
+        refs = map_remote.remote(ref, idx)
+        map_refs.append(refs if isinstance(refs, list) else [refs])
+    for p in range(P):
+        yield red_remote.remote(p, *[m[p] for m in map_refs])
+
+
 def actor_pool_stage(ref_iter: Iterator, fn_constructor: Callable,
                      transform: Callable, pool: ActorPoolStrategy,
                      max_in_flight_per_actor: int = 2) -> Iterator:

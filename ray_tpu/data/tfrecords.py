@@ -1,0 +1,274 @@
+"""TFRecord container + tf.train.Example codec, dependency-free.
+
+Reference: ``python/ray/data/datasource/tfrecords_datasource.py`` (which
+leans on TensorFlow); here the wire formats are implemented directly —
+they are tiny and stable:
+
+- TFRecord framing: ``uint64 len | u32 maskedcrc(len) | data |
+  u32 maskedcrc(data)`` with CRC32C (Castagnoli) and TF's mask
+  ``((crc >> 15 | crc << 17) + 0xa282ead8)``.
+- ``tf.train.Example`` protobuf: Features map of name → Feature, where
+  Feature is a oneof of BytesList (field 1), FloatList (2, packed
+  fixed32), Int64List (3, packed varints).
+
+Files written here load in TensorFlow, and TF-written files load here.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List
+
+import numpy as np
+
+# ------------------------------------------------------------------ crc32c
+
+_CRC_TABLE: List[int] = []
+
+
+def _crc_table() -> List[int]:
+    if not _CRC_TABLE:
+        poly = 0x82F63B78  # Castagnoli, reflected
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------------ varint
+
+
+def _write_varint(out: bytearray, v: int):
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, off: int):
+    result = shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def _key(field: int, wire: int) -> int:
+    return (field << 3) | wire
+
+
+# ----------------------------------------------------------- Example proto
+
+
+def _encode_feature(value: Any) -> bytes:
+    """Feature message bytes for one python/numpy value."""
+    inner = bytearray()
+    is_bytes_seq = (isinstance(value, (list, tuple)) and value
+                    and all(isinstance(v, (bytes, str)) for v in value))
+    if isinstance(value, (bytes, str)) or is_bytes_seq:
+        values = [value] if isinstance(value, (bytes, str)) else list(value)
+        values = [v.encode() if isinstance(v, str) else bytes(v)
+                  for v in values]
+        lst = bytearray()
+        for v in values:
+            _write_varint(lst, _key(1, 2))
+            _write_varint(lst, len(v))
+            lst += v
+        _write_varint(inner, _key(1, 2))  # bytes_list
+    else:
+        arr = np.asarray(value)
+        lst = bytearray()
+        if arr.dtype.kind in ("S", "U", "O"):
+            # numpy bytes/str arrays (tabular blocks store bytes
+            # columns this way; note numpy S-arrays drop trailing
+            # NULs on item access — binary payloads with trailing
+            # zeros should stay python lists)
+            vals = [v.encode() if isinstance(v, str) else bytes(v)
+                    for v in arr.reshape(-1).tolist()]
+            for v in vals:
+                _write_varint(lst, _key(1, 2))
+                _write_varint(lst, len(v))
+                lst += v
+            _write_varint(inner, _key(1, 2))  # bytes_list
+        elif np.issubdtype(arr.dtype, np.integer) or arr.dtype == bool:
+            packed = bytearray()
+            for v in arr.reshape(-1).tolist():
+                _write_varint(packed, v & 0xFFFFFFFFFFFFFFFF)
+            _write_varint(lst, _key(1, 2))
+            _write_varint(lst, len(packed))
+            lst += packed
+            _write_varint(inner, _key(3, 2))  # int64_list
+        elif np.issubdtype(arr.dtype, np.floating):
+            packed = arr.reshape(-1).astype("<f4").tobytes()
+            _write_varint(lst, _key(1, 2))
+            _write_varint(lst, len(packed))
+            lst += packed
+            _write_varint(inner, _key(2, 2))  # float_list
+        else:
+            raise TypeError(
+                f"unsupported TFRecord feature dtype: {arr.dtype}")
+    _write_varint(inner, len(lst))
+    inner += lst
+    return bytes(inner)
+
+
+def encode_example(row: Dict[str, Any]) -> bytes:
+    """Serialize a dict row as a tf.train.Example."""
+    features = bytearray()
+    for name, value in row.items():
+        entry = bytearray()
+        nb = name.encode()
+        _write_varint(entry, _key(1, 2))  # key
+        _write_varint(entry, len(nb))
+        entry += nb
+        fb = _encode_feature(value)
+        _write_varint(entry, _key(2, 2))  # value (Feature)
+        _write_varint(entry, len(fb))
+        entry += fb
+        _write_varint(features, _key(1, 2))  # map entry
+        _write_varint(features, len(entry))
+        features += entry
+    out = bytearray()
+    _write_varint(out, _key(1, 2))  # Example.features
+    _write_varint(out, len(features))
+    out += features
+    return bytes(out)
+
+
+def _decode_list(buf: bytes, kind: int):
+    """Decode BytesList/FloatList/Int64List message bytes."""
+    off = 0
+    out: List[Any] = []
+    while off < len(buf):
+        key, off = _read_varint(buf, off)
+        if key != _key(1, 2):
+            raise ValueError(f"unexpected list field key {key}")
+        n, off = _read_varint(buf, off)
+        chunk = buf[off:off + n]
+        off += n
+        if kind == 1:  # bytes
+            out.append(chunk)
+        elif kind == 2:  # packed float32
+            out.extend(np.frombuffer(chunk, "<f4").tolist())
+        else:  # packed int64 varints
+            o = 0
+            while o < len(chunk):
+                v, o = _read_varint(chunk, o)
+                if v >= 1 << 63:
+                    v -= 1 << 64
+                out.append(v)
+    return out
+
+
+def _decode_feature(buf: bytes):
+    off = 0
+    while off < len(buf):
+        key, off = _read_varint(buf, off)
+        field, wire = key >> 3, key & 7
+        n, off = _read_varint(buf, off)
+        chunk = buf[off:off + n]
+        off += n
+        if field in (1, 2, 3):
+            vals = _decode_list(chunk, field)
+            if field == 2:
+                vals = [np.float32(v) for v in vals]
+            return vals
+    return []
+
+
+def decode_example(data: bytes) -> Dict[str, Any]:
+    """Parse a tf.train.Example; singleton lists decode to scalars,
+    longer lists to numpy arrays (bytes stay lists of bytes)."""
+    row: Dict[str, Any] = {}
+    off = 0
+    while off < len(data):
+        key, off = _read_varint(data, off)
+        n, off = _read_varint(data, off)
+        chunk = data[off:off + n]
+        off += n
+        if key != _key(1, 2):
+            continue
+        # Features message: map entries
+        o2 = 0
+        while o2 < len(chunk):
+            k2, o2 = _read_varint(chunk, o2)
+            n2, o2 = _read_varint(chunk, o2)
+            entry = chunk[o2:o2 + n2]
+            o2 += n2
+            if k2 != _key(1, 2):
+                continue
+            name, vals = None, []
+            o3 = 0
+            while o3 < len(entry):
+                k3, o3 = _read_varint(entry, o3)
+                n3, o3 = _read_varint(entry, o3)
+                part = entry[o3:o3 + n3]
+                o3 += n3
+                if k3 == _key(1, 2):
+                    name = part.decode()
+                elif k3 == _key(2, 2):
+                    vals = _decode_feature(part)
+            if name is None:
+                continue
+            if len(vals) == 1:
+                row[name] = vals[0]
+            elif vals and isinstance(vals[0], bytes):
+                row[name] = vals
+            else:
+                row[name] = np.asarray(vals)
+    return row
+
+
+# ------------------------------------------------------------ file framing
+
+
+def write_tfrecord_file(path: str, rows: Iterator[Dict[str, Any]]) -> int:
+    n = 0
+    with open(path, "wb") as f:
+        for row in rows:
+            data = encode_example(row)
+            header = struct.pack("<Q", len(data))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+            n += 1
+    return n
+
+
+def read_tfrecord_file(path: str) -> Iterator[Dict[str, Any]]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != _masked_crc(header):
+                raise ValueError(f"{path}: corrupt record header")
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if dcrc != _masked_crc(data):
+                raise ValueError(f"{path}: corrupt record data")
+            yield decode_example(data)
