@@ -10,9 +10,17 @@ re-designed for this runtime:
 - ``env_vars`` apply at worker level: the lease shape key includes the
   runtime-env hash, so tasks with different envs never share a worker
   (the reference isolates the same way — dedicated workers per env),
-- ``pip`` is validated import-only: this deployment is zero-egress, so
-  packages must already be present; missing ones raise a clear error
-  instead of silently downloading.
+- ``pip`` installs from a LOCAL WHEELHOUSE into per-env-hash cached
+  package dirs (reference: ``runtime_env/pip.py`` virtualenv-per-hash +
+  ``uri_cache.py`` eviction, re-designed for zero egress):
+  ``pip={"packages": [...], "wheelhouse": "/path/to/wheels"}`` (or the
+  ``RT_PIP_WHEELHOUSE`` env var) runs ``pip install --no-index
+  --find-links <wheelhouse> --target <cache>/<hash>`` once per env
+  hash, then prepends the cached dir to the dedicated worker's
+  ``sys.path`` (the lease shape key isolates workers per env, so this
+  is the venv-interpreter isolation without a respawn). Without a
+  wheelhouse, ``pip`` degrades to import-validation: packages must be
+  baked into the image, missing ones raise instead of downloading.
 """
 from __future__ import annotations
 
@@ -40,6 +48,33 @@ def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in env_vars.items()):
         raise ValueError("runtime_env env_vars must be str->str")
+    pip = runtime_env.get("pip")
+    if pip is not None:
+        if isinstance(pip, dict):
+            if set(pip) - {"packages", "wheelhouse"}:
+                raise ValueError(
+                    "runtime_env pip dict accepts only "
+                    "'packages' and 'wheelhouse'")
+            pkgs = pip.get("packages")
+            wh = pip.get("wheelhouse")
+            if pkgs is not None and (isinstance(pkgs, str) or not all(
+                    isinstance(p, str) for p in pkgs)):
+                raise ValueError(
+                    "runtime_env pip packages must be a LIST of "
+                    "requirement strings (a bare string would be "
+                    "split into characters)")
+            if wh is not None and not isinstance(wh, str):
+                raise ValueError("runtime_env pip wheelhouse must be "
+                                 "a directory path string")
+        elif isinstance(pip, (list, tuple)):
+            if not all(isinstance(p, str) for p in pip):
+                raise ValueError(
+                    "runtime_env pip must be a list of requirement "
+                    "strings")
+        else:
+            raise ValueError(
+                "runtime_env pip must be a list of requirements or "
+                "{'packages': [...], 'wheelhouse': <dir>}")
     return runtime_env
 
 
@@ -100,21 +135,46 @@ def prepare(runtime_env: Dict[str, Any], kv_put) -> Dict[str, Any]:
         mods.append((os.path.basename(mod_path.rstrip("/")), key))
     if mods:
         out["py_module_keys"] = mods
-    if runtime_env.get("pip"):
-        out["pip"] = list(runtime_env["pip"])
+    pip = runtime_env.get("pip")
+    if pip:
+        if isinstance(pip, dict):
+            wh = pip.get("wheelhouse")
+            out["pip"] = {
+                "packages": list(pip.get("packages") or []),
+                "wheelhouse": os.path.abspath(wh) if wh else None,
+            }
+        else:
+            out["pip"] = {"packages": list(pip), "wheelhouse": None}
     return out
 
 
 def apply(wire_env: Dict[str, Any], kv_get, scratch_dir: str) -> None:
     """Worker side: materialize the env in THIS process (the worker is
     dedicated to this env via the lease shape key)."""
-    for name in wire_env.get("pip") or []:
-        base = name.split("==")[0].split(">=")[0].split("[")[0]
-        base = base.replace("-", "_")
-        if importlib.util.find_spec(base) is None:
-            raise RuntimeError(
-                f"runtime_env pip package {name!r} is not available and "
-                "this deployment is zero-egress; bake it into the image")
+    pip = wire_env.get("pip")
+    if pip:
+        if isinstance(pip, dict):
+            packages = pip.get("packages") or []
+            wheelhouse = pip.get("wheelhouse") or \
+                os.environ.get("RT_PIP_WHEELHOUSE")
+        else:  # legacy wire form: bare list
+            packages, wheelhouse = list(pip), \
+                os.environ.get("RT_PIP_WHEELHOUSE")
+        if wheelhouse and packages:
+            env_dir = ensure_pip_env(packages, wheelhouse)
+            if env_dir not in sys.path:
+                sys.path.insert(0, env_dir)
+            importlib.invalidate_caches()
+        else:
+            for name in packages:
+                base = name.split("==")[0].split(">=")[0].split("[")[0]
+                base = base.replace("-", "_")
+                if importlib.util.find_spec(base) is None:
+                    raise RuntimeError(
+                        f"runtime_env pip package {name!r} is not "
+                        "available and this deployment is zero-egress; "
+                        "bake it into the image or provide a "
+                        "'wheelhouse' (RT_PIP_WHEELHOUSE)")
     for k, v in (wire_env.get("env_vars") or {}).items():
         os.environ[k] = v
     wd_key = wire_env.get("working_dir_key")
@@ -132,6 +192,95 @@ def apply(wire_env: Dict[str, Any], kv_get, scratch_dir: str) -> None:
             os.symlink(target, link)
         if parent not in sys.path:
             sys.path.insert(0, parent)
+
+
+def _pip_cache_root() -> str:
+    return os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu",
+                        "pip_envs")
+
+
+def ensure_pip_env(packages, wheelhouse: str) -> str:
+    """Install ``packages`` from the local wheelhouse into a cached
+    per-hash package dir; return it (reference: ``pip.py``'s
+    virtualenv-per-hash + ``uri_cache.py``'s eviction). Concurrent
+    workers serialize on a file lock; a hit only touches the marker
+    (its mtime is the LRU clock)."""
+    import fcntl
+    import subprocess
+
+    root = _pip_cache_root()
+    os.makedirs(root, exist_ok=True)
+    h = hashlib.sha256(json.dumps(
+        [sorted(packages), os.path.abspath(wheelhouse)]).encode()
+    ).hexdigest()[:16]
+    env_dir = os.path.join(root, h)
+    marker = env_dir + ".ok"
+    with open(os.path.join(root, h + ".lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                os.utime(marker)  # LRU touch
+                return env_dir
+            # Install into a staging dir and rename: a crash mid-install
+            # must not leave a partial env that a retrying pip would
+            # "Target directory already exists"-skip yet get markered.
+            import shutil
+
+            stage = env_dir + ".staging"
+            shutil.rmtree(stage, ignore_errors=True)
+            proc = subprocess.run(
+                [sys.executable, "-m", "pip", "install", "--quiet",
+                 "--no-index", "--find-links", wheelhouse,
+                 "--target", stage, *packages],
+                capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                shutil.rmtree(stage, ignore_errors=True)
+                raise RuntimeError(
+                    f"pip install from wheelhouse {wheelhouse!r} failed "
+                    f"for {list(packages)}: {proc.stderr[-2000:]}")
+            shutil.rmtree(env_dir, ignore_errors=True)
+            os.replace(stage, env_dir)
+            open(marker, "w").close()
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    _evict_pip_envs(keep=env_dir)
+    return env_dir
+
+
+def _evict_pip_envs(keep: str = "",
+                    cap: Optional[int] = None) -> None:
+    """Drop least-recently-used cached pip envs beyond the cap
+    (``RT_PIP_ENV_CACHE_SIZE``, default 10). Best-effort: an env
+    evicted while an old worker still imports from it only affects
+    that worker's COLD imports, and the next use reinstalls."""
+    import shutil
+
+    root = _pip_cache_root()
+    cap = cap if cap is not None else int(
+        os.environ.get("RT_PIP_ENV_CACHE_SIZE", "10"))
+    try:
+        markers = sorted(
+            (os.path.join(root, f) for f in os.listdir(root)
+             if f.endswith(".ok")),
+            key=os.path.getmtime)
+    except OSError:
+        return
+    excess = len(markers) - cap
+    for m in markers:
+        if excess <= 0:
+            break
+        env_dir = m[:-3]
+        if env_dir == keep:
+            continue
+        try:
+            os.unlink(m)  # marker first: a racing hit re-installs
+            shutil.rmtree(env_dir, ignore_errors=True)
+            # the .lock file STAYS: unlinking it would let a racing
+            # ensure_pip_env flock a fresh inode while another holds
+            # the old one — two concurrent installs into one dir
+        except OSError:
+            pass
+        excess -= 1
 
 
 def _extract(key: str, kv_get, scratch_dir: str) -> str:

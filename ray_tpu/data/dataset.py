@@ -430,9 +430,11 @@ def _repartition(it: Iterator[B.Block], n: int) -> Iterator[B.Block]:
         del blk
     if not in_refs:
         return
-    per = total // n
-    # partition p covers global rows [cuts[p], cuts[p+1])
-    cuts = [p * per for p in range(n)] + [total]
+    per, rem = divmod(total, n)
+    # partition p covers global rows [cuts[p], cuts[p+1]); the
+    # remainder spreads one row per leading partition so sizes differ
+    # by at most 1 (load balance for downstream parallel stages)
+    cuts = [p * per + min(p, rem) for p in range(n)] + [total]
 
     def split(blk, idx, P):
         base = offsets[idx]
@@ -522,17 +524,14 @@ def _sort(it: Iterator[B.Block], key, descending) -> Iterator[B.Block]:
         rows = []
         for p in parts:
             rows.extend(B.iter_rows(p))
-        rows.sort(key=keyfn)
+        rows.sort(key=keyfn, reverse=descending)  # in the REDUCE task
         return B.rows_to_block(rows)
 
     out = list(refs_exchange(in_refs, split, reduce, num_partitions=P))
     if descending:
-        out = out[::-1]
+        out = out[::-1]  # highest range first; rows already descend
     for ref in out:
         blk = rt.get(ref, timeout=300)
-        if descending:
-            ln = B.block_len(blk)
-            blk = _take_rows(blk, np.arange(ln - 1, -1, -1))
         if B.block_len(blk):
             yield blk
 

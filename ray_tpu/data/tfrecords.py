@@ -261,14 +261,20 @@ def read_tfrecord_file(path: str) -> Iterator[Dict[str, Any]]:
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
-            if len(header) < 8:
+            if not header:
                 return
+            hcrc_b = f.read(4) if len(header) == 8 else b""
+            if len(header) < 8 or len(hcrc_b) < 4:
+                raise ValueError(f"{path}: truncated record header")
             (length,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", f.read(4))
+            (hcrc,) = struct.unpack("<I", hcrc_b)
             if hcrc != _masked_crc(header):
                 raise ValueError(f"{path}: corrupt record header")
             data = f.read(length)
-            (dcrc,) = struct.unpack("<I", f.read(4))
+            dcrc_b = f.read(4) if len(data) == length else b""
+            if len(data) < length or len(dcrc_b) < 4:
+                raise ValueError(f"{path}: truncated record")
+            (dcrc,) = struct.unpack("<I", dcrc_b)
             if dcrc != _masked_crc(data):
                 raise ValueError(f"{path}: corrupt record data")
             yield decode_example(data)

@@ -1,0 +1,114 @@
+"""Wheelhouse pip runtime env (reference:
+``python/ray/_private/runtime_env/pip.py`` + ``uri_cache.py``): a
+wheel-only package ships to a dedicated worker through a local
+wheelhouse install, cached per env hash with LRU eviction."""
+import base64
+import hashlib
+import os
+import time
+import zipfile
+
+import pytest
+
+from ray_tpu._private import runtime_env as renv
+
+
+def build_wheel(wheelhouse: str, name: str = "tinypkg",
+                version: str = "0.1.0", value: int = 42) -> str:
+    """Hand-craft a minimal valid wheel (a wheel IS a zip + dist-info)."""
+    os.makedirs(wheelhouse, exist_ok=True)
+    whl = os.path.join(wheelhouse,
+                       f"{name}-{version}-py3-none-any.whl")
+    files = {
+        f"{name}/__init__.py": f"VALUE = {value}\n".encode(),
+        f"{name}-{version}.dist-info/METADATA":
+            f"Metadata-Version: 2.1\nName: {name}\n"
+            f"Version: {version}\n".encode(),
+        f"{name}-{version}.dist-info/WHEEL":
+            b"Wheel-Version: 1.0\nGenerator: test\n"
+            b"Root-Is-Purelib: true\nTag: py3-none-any\n",
+    }
+    record = []
+    with zipfile.ZipFile(whl, "w") as z:
+        for fn, data in files.items():
+            z.writestr(fn, data)
+            digest = base64.urlsafe_b64encode(
+                hashlib.sha256(data).digest()).rstrip(b"=").decode()
+            record.append(f"{fn},sha256={digest},{len(data)}")
+        record.append(f"{name}-{version}.dist-info/RECORD,,")
+        z.writestr(f"{name}-{version}.dist-info/RECORD",
+                   "\n".join(record) + "\n")
+    return whl
+
+
+def test_ensure_pip_env_installs_and_caches(tmp_path, monkeypatch):
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    wh = str(tmp_path / "wheelhouse")
+    build_wheel(wh)
+    env_dir = renv.ensure_pip_env(["tinypkg"], wh)
+    assert os.path.isdir(os.path.join(env_dir, "tinypkg"))
+    # cache hit: pip must NOT run again
+    import subprocess as sp
+
+    def boom(*a, **k):
+        raise AssertionError("pip ran on a cache hit")
+
+    monkeypatch.setattr(sp, "run", boom)
+    assert renv.ensure_pip_env(["tinypkg"], wh) == env_dir
+
+
+def test_pip_env_eviction(tmp_path, monkeypatch):
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    root = renv._pip_cache_root()
+    os.makedirs(root)
+    for i in range(5):
+        d = os.path.join(root, f"env{i}")
+        os.makedirs(d)
+        open(d + ".ok", "w").close()
+        open(d + ".lock", "w").close()
+        t = time.time() - 1000 + i
+        os.utime(d + ".ok", (t, t))
+    renv._evict_pip_envs(cap=2)
+    left = sorted(f for f in os.listdir(root) if f.endswith(".ok"))
+    assert left == ["env3.ok", "env4.ok"]
+
+
+def test_missing_package_clear_error(tmp_path, monkeypatch):
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    wh = str(tmp_path / "wheelhouse")
+    os.makedirs(wh)
+    with pytest.raises(RuntimeError, match="pip install from wheelhouse"):
+        renv.ensure_pip_env(["no-such-package-xyz"], wh)
+
+
+def test_worker_imports_wheel_only_package(tmp_path, monkeypatch):
+    """The e2e gate: a package existing ONLY as a wheel in a local
+    wheelhouse imports inside a dedicated worker; a second task in the
+    same env reuses the cached install."""
+    import ray_tpu as rt
+
+    wh = str(tmp_path / "wheelhouse")
+    build_wheel(wh, value=1234)
+    env = {"pip": {"packages": ["tinypkg"], "wheelhouse": wh}}
+
+    rt.init(num_cpus=2, num_tpus=0)
+    try:
+        @rt.remote(runtime_env=env)
+        def use_pkg():
+            import tinypkg
+
+            return tinypkg.VALUE, tinypkg.__file__
+
+        value, path = rt.get(use_pkg.remote(), timeout=120)
+        assert value == 1234
+        assert "pip_envs" in path
+        # driver process must NOT see it (isolation)
+        with pytest.raises(ImportError):
+            import tinypkg  # noqa: F401
+        # second use: cached (marker mtime identical modulo touch is
+        # hard to observe cross-process; instead assert same env dir)
+        value2, path2 = rt.get(use_pkg.remote(), timeout=60)
+        assert (value2, os.path.dirname(path2)) == (
+            value, os.path.dirname(path))
+    finally:
+        rt.shutdown()
