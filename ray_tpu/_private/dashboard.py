@@ -89,17 +89,48 @@ refresh(); setInterval(refresh, 2000);
 """
 
 
+async def read_get_request(reader):
+    """Parse a GET request line + drain headers; returns (path, query
+    dict) or None for non-GET. Shared by the head dashboard and the
+    per-node agents — one HTTP parser to maintain."""
+    request = await asyncio.wait_for(reader.readline(), 10)
+    while True:  # drain headers
+        line = await asyncio.wait_for(reader.readline(), 10)
+        if line in (b"\r\n", b"\n", b""):
+            break
+    parts = request.decode("latin1").split()
+    if len(parts) < 2 or parts[0] != "GET":
+        return None
+    url = urlparse(parts[1])
+    return url.path, {k: v[0] for k, v in parse_qs(url.query).items()}
+
+
+async def respond(writer, code: int, ctype: str, body: bytes):
+    reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}
+    head = (f"HTTP/1.1 {code} {reason.get(code, '?')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n").encode()
+    writer.write(head + body)
+    await writer.drain()
+
+
 class DashboardServer:
     def __init__(self, state_fn: Callable[[str], object],
                  metrics_fn: Callable[[], str],
                  timeline_fn: Callable[[], list],
-                 log_fn=None, host: str = "127.0.0.1", port: int = 0):
+                 log_fn=None, node_fn=None,
+                 host: str = "127.0.0.1", port: int = 0):
         self._state_fn = state_fn
         self._metrics_fn = metrics_fn
         self._timeline_fn = timeline_fn
         # async (query dict) -> {"data": str}|{"files": [...]}; serves
         # /api/logs (reference: dashboard log module).
         self._log_fn = log_fn
+        # async (query dict with node_id) -> stats dict; serves
+        # /api/node — the head proxying every node's agent (reference:
+        # dashboard head aggregating per-node agents).
+        self._node_fn = node_fn
         self._host = host
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -125,31 +156,34 @@ class DashboardServer:
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter):
         try:
-            request = await asyncio.wait_for(reader.readline(), 10)
-            while True:  # drain headers
-                line = await asyncio.wait_for(reader.readline(), 10)
-                if line in (b"\r\n", b"\n", b""):
-                    break
-            parts = request.decode("latin1").split()
-            if len(parts) < 2 or parts[0] != "GET":
+            parsed = await read_get_request(reader)
+            if parsed is None:
                 await self._respond(writer, 405, "text/plain",
                                     b"GET only")
                 return
-            url = urlparse(parts[1])
-            q = {k: v[0] for k, v in parse_qs(url.query).items()}
-            if url.path == "/metrics":
+            path, q = parsed
+            if path == "/metrics":
                 body = self._metrics_fn().encode()
                 await self._respond(
                     writer, 200, "text/plain; version=0.0.4", body)
-            elif url.path == "/api/state":
+            elif path == "/api/state":
                 data = self._state_fn(q.get("kind", "summary"))
                 await self._respond(writer, 200, "application/json",
                                     json.dumps(data).encode())
-            elif url.path == "/api/timeline":
+            elif path == "/api/timeline":
                 await self._respond(
                     writer, 200, "application/json",
                     json.dumps(self._timeline_fn()).encode())
-            elif url.path == "/api/logs" and self._log_fn is not None:
+            elif path == "/api/node" and self._node_fn is not None:
+                try:
+                    data = await self._node_fn(q)
+                    await self._respond(writer, 200, "application/json",
+                                        json.dumps(data).encode())
+                except Exception as e:  # noqa: BLE001 - unknown node
+                    await self._respond(writer, 404, "application/json",
+                                        json.dumps(
+                                            {"error": str(e)}).encode())
+            elif path == "/api/logs" and self._log_fn is not None:
                 try:
                     data = await self._log_fn(q)
                     await self._respond(writer, 200, "application/json",
@@ -158,7 +192,7 @@ class DashboardServer:
                     await self._respond(writer, 404, "application/json",
                                         json.dumps(
                                             {"error": str(e)}).encode())
-            elif url.path == "/":
+            elif path == "/":
                 await self._respond(writer, 200, "text/html",
                                     _INDEX_HTML.encode())
             else:
@@ -173,12 +207,4 @@ class DashboardServer:
             except Exception:
                 pass
 
-    @staticmethod
-    async def _respond(writer, code: int, ctype: str, body: bytes):
-        reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}
-        head = (f"HTTP/1.1 {code} {reason.get(code, '?')}\r\n"
-                f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\n"
-                f"Connection: close\r\n\r\n").encode()
-        writer.write(head + body)
-        await writer.drain()
+    _respond = staticmethod(respond)

@@ -77,6 +77,8 @@ class NodeInfo:
     # usual case; containers sharing a fixed hostname would couple
     # their kill grace windows — conservative, never unsafe).
     phys_host: str = ""
+    # Per-node dashboard agent endpoint (reference dashboard/agent.py)
+    agent_url: Optional[str] = None
 
     def utilization(self) -> float:
         fracs = [1.0 - self.available.get(k, 0.0) / v
@@ -272,6 +274,7 @@ class HeadService:
             self.dashboard = DashboardServer(
                 self.state_listing, self.metrics_text, self.chrome_trace,
                 log_fn=lambda q: self._rpc_worker_log(q, []),
+                node_fn=lambda q: self._rpc_node_stats(q, []),
                 port=getattr(self.config, "dashboard_port", 0))
             await self.dashboard.start()
         # Discovery file for the CLI (`python -m ray_tpu status`).
@@ -1009,6 +1012,7 @@ class HeadService:
             conn=conn,
             labels=dict(payload.get("labels") or {}),
             phys_host=payload.get("host") or payload.get("hostname") or "?",
+            agent_url=payload.get("agent_url"),
         )
         self.nodes[node.node_id] = node
         prev_close = conn.on_close
@@ -1451,8 +1455,31 @@ class HeadService:
         return [{"node_id": n.node_id, "hostname": n.hostname,
                  "is_head": n.is_head, "state": n.state,
                  "total": dict(n.total), "available": dict(n.available),
-                 "labels": dict(n.labels)}
+                 "labels": dict(n.labels), "agent_url": n.agent_url}
                 for n in self.nodes.values()]
+
+    async def _rpc_node_stats(self, payload, bufs):
+        """Per-node stats, proxied through the head (reference: the
+        dashboard head aggregating every agent's node_stats). The
+        head's own node is served locally; remote nodes answer over
+        their daemon RPC connection."""
+        node_hex = payload.get("node_id") or self.local_node.node_id
+        node = self.nodes.get(node_hex)
+        if node is None:
+            raise rpc.RpcError(f"no such node {node_hex[:12]}")
+        if node.node_id == self.local_node.node_id:
+            from .node_agent import collect_node_stats
+
+            pids = {w.worker_id.hex(): w.pid
+                    for w in self.workers.values()
+                    if w.node == node_hex and w.proc is not None}
+            stats = collect_node_stats(pids)
+            stats["node_id"] = node_hex
+            return stats
+        if node.conn is None:
+            raise rpc.RpcError(f"node {node_hex[:12]} has no daemon "
+                               "connection")
+        return await node.conn.call_simple("agent_stats", {})
 
     async def _rpc_get_head_tcp_address(self, payload, bufs):
         return {"address": list(self.tcp_address)}
@@ -1915,7 +1942,9 @@ class HeadService:
         if kind == "nodes":
             return [{"node_id": n.node_id, "hostname": n.hostname,
                      "is_head": n.is_head, "state": n.state,
-                     "total": dict(n.total), "available": dict(n.available)}
+                     "total": dict(n.total),
+                     "available": dict(n.available),
+                     "agent_url": n.agent_url}
                     for n in self.nodes.values()]
         if kind == "workers":
             return [{"worker_id": w.worker_id.hex(), "pid": w.pid,

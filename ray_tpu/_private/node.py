@@ -39,6 +39,10 @@ def tail_worker_log(session_dir: str, payload: dict) -> dict:
             return {"files": sorted(os.listdir(logs_dir))}
         except OSError:
             return {"files": []}
+    if not all(c in "0123456789abcdefABCDEF" for c in wid):
+        # worker ids are hex; anything else is a path-traversal probe
+        # (the agent HTTP endpoint feeds user-supplied strings here)
+        raise rpc.RpcError(f"invalid worker id {wid[:32]!r}")
     nbytes = int(payload.get("bytes", 65536))
     path = os.path.join(logs_dir, f"worker-{wid[:12]}.log")
     try:
@@ -87,6 +91,7 @@ class NodeService:
         from .config import Config
 
         self.config = Config()  # replaced by the head's at registration
+        self._agent = None  # NodeAgentServer, started in start()
         self._procs: Dict[str, subprocess.Popen] = {}  # worker hex -> proc
         self._reap_task: Optional[asyncio.Task] = None
         self._stopping = False
@@ -95,6 +100,16 @@ class NodeService:
 
     async def start(self):
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        # Per-node dashboard agent (reference ``dashboard/agent.py:28``):
+        # node-local stats/logs over HTTP, also proxied by the head.
+        from .node_agent import NodeAgentServer
+
+        self._agent = NodeAgentServer(
+            stats_fn=self._agent_stats,
+            workers_fn=lambda: [{"worker_id": h[:12], "pid": p.pid}
+                                for h, p in self._procs.items()],
+            log_fn=lambda q: tail_worker_log(self.session_dir, q))
+        await self._agent.start()
         self._conn = await rpc.connect(self.head_address, self._handle)
         resp = await self._conn.call_simple("register_node", {
             "node_id": self.node_id.hex(),
@@ -102,14 +117,25 @@ class NodeService:
             "host": socket.gethostname(),
             "resources": self.resources,
             "labels": self.labels,
+            "agent_url": f"http://{self.node_ip}:{self._agent.port}",
         })
         self._adopt_head_config(resp)
         self._reap_task = asyncio.get_running_loop().create_task(
             self._reap_loop())
         return self
 
+    def _agent_stats(self) -> dict:
+        from .node_agent import collect_node_stats
+
+        stats = collect_node_stats(
+            {h: p.pid for h, p in self._procs.items()})
+        stats["node_id"] = self.node_id.hex()
+        return stats
+
     async def stop(self):
         self._stopping = True
+        if self._agent:
+            await self._agent.stop()
         if self._reap_task:
             self._reap_task.cancel()
         for proc in self._procs.values():
@@ -173,6 +199,9 @@ class NodeService:
                     "host": socket.gethostname(),
                     "resources": self.resources,
                     "labels": self.labels,
+                    "agent_url": (
+                        f"http://{self.node_ip}:{self._agent.port}"
+                        if self._agent else None),
                 })
                 self._adopt_head_config(resp)
                 self._conn = conn
@@ -205,6 +234,8 @@ class NodeService:
             return {"ok": True, "node_id": self.node_id.hex()}
         if method == "tail_log":
             return tail_worker_log(self.session_dir, payload)
+        if method == "agent_stats":
+            return self._agent_stats()
         if method == "pubsub":
             return {}
         raise rpc.RpcError(f"node daemon: unknown method {method}")

@@ -1,0 +1,134 @@
+"""Per-node dashboard agent (reference: ``dashboard/agent.py:28`` —
+the DashboardAgent process every raylet hosts, serving node-local
+stats and logs that the dashboard head aggregates).
+
+Re-designed for this runtime: instead of a separate agent process per
+node, the agent is a tiny asyncio HTTP server INSIDE the node daemon
+(and the head, for its own host) — same endpoints, one fewer process
+to babysit:
+
+- ``GET /api/stats``   → host cpu/mem/load + per-worker pid/rss/cpu
+- ``GET /api/workers`` → worker ids + pids this daemon owns
+- ``GET /api/logs``    → log file list / tail (``worker_id=``, ``bytes=``)
+
+The head additionally proxies every node's stats/logs over its
+existing daemon RPC connections (``/api/node?node_id=…`` on the head
+dashboard), so one URL serves the whole cluster on multi-host
+deployments where agent ports may not be reachable from outside.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Callable, Dict, Optional
+
+
+def collect_node_stats(worker_pids: Dict[str, int]) -> dict:
+    """Node-local stats snapshot (psutil-backed, like the reference's
+    agent ``node_stats``)."""
+    import psutil
+
+    vm = psutil.virtual_memory()
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = 0.0
+    workers = []
+    for hexid, pid in worker_pids.items():
+        try:
+            p = psutil.Process(pid)
+            with p.oneshot():
+                workers.append({
+                    "worker_id": hexid[:12], "pid": pid,
+                    "rss_bytes": p.memory_info().rss,
+                    "cpu_percent": p.cpu_percent(interval=None),
+                    "status": p.status(),
+                })
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            workers.append({"worker_id": hexid[:12], "pid": pid,
+                            "status": "gone"})
+    return {
+        "time": time.time(),
+        "cpu_percent": psutil.cpu_percent(interval=None),
+        "cpu_count": psutil.cpu_count(),
+        "mem_total_bytes": vm.total,
+        "mem_available_bytes": vm.available,
+        "mem_percent": vm.percent,
+        "load_avg": [load1, load5, load15],
+        "num_workers": len(worker_pids),
+        "workers": workers,
+    }
+
+
+class NodeAgentServer:
+    """The agent's HTTP face: dependency-free GET-only asyncio server
+    (same parser discipline as the head's dashboard-lite)."""
+
+    def __init__(self, stats_fn: Callable[[], dict],
+                 workers_fn: Callable[[], list],
+                 log_fn: Callable[[dict], dict],
+                 host: str = "0.0.0.0", port: int = 0):
+        self._stats_fn = stats_fn
+        self._workers_fn = workers_fn
+        self._log_fn = log_fn
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._serve, host=self._host, port=self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _serve(self, reader, writer):
+        from .dashboard import read_get_request, respond
+
+        try:
+            parsed = await read_get_request(reader)
+            if parsed is None:
+                await respond(writer, 405, "application/json",
+                              b'{"error":"GET only"}')
+                return
+            path, q = parsed
+            if path == "/api/stats":
+                body = json.dumps(self._stats_fn()).encode()
+            elif path == "/api/workers":
+                body = json.dumps(self._workers_fn()).encode()
+            elif path == "/api/logs":
+                try:
+                    body = json.dumps(self._log_fn(q)).encode()
+                except Exception as e:  # noqa: BLE001 - missing file
+                    await respond(writer, 404, "application/json",
+                                  json.dumps({"error": str(e)}).encode())
+                    return
+            elif path == "/":
+                body = json.dumps({"endpoints": [
+                    "/api/stats", "/api/workers", "/api/logs"]}).encode()
+            else:
+                await respond(writer, 404, "application/json",
+                              b'{"error":"not found"}')
+                return
+            await respond(writer, 200, "application/json", body)
+        except Exception:  # noqa: BLE001 - bad client mustn't kill daemon
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass

@@ -30,6 +30,7 @@ import io
 import json
 import os
 import sys
+import time
 import zipfile
 from typing import Any, Dict, Optional, Tuple
 
@@ -57,8 +58,9 @@ def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
                     "'packages' and 'wheelhouse'")
             pkgs = pip.get("packages")
             wh = pip.get("wheelhouse")
-            if pkgs is not None and (isinstance(pkgs, str) or not all(
-                    isinstance(p, str) for p in pkgs)):
+            if pkgs is not None and (
+                    not isinstance(pkgs, (list, tuple))
+                    or not all(isinstance(p, str) for p in pkgs)):
                 raise ValueError(
                     "runtime_env pip packages must be a LIST of "
                     "requirement strings (a bare string would be "
@@ -258,6 +260,7 @@ def _evict_pip_envs(keep: str = "",
     root = _pip_cache_root()
     cap = cap if cap is not None else int(
         os.environ.get("RT_PIP_ENV_CACHE_SIZE", "10"))
+    listed_at = time.time()
     try:
         markers = sorted(
             (os.path.join(root, f) for f in os.listdir(root)
@@ -265,6 +268,8 @@ def _evict_pip_envs(keep: str = "",
             key=os.path.getmtime)
     except OSError:
         return
+    import fcntl
+
     excess = len(markers) - cap
     for m in markers:
         if excess <= 0:
@@ -272,7 +277,23 @@ def _evict_pip_envs(keep: str = "",
         env_dir = m[:-3]
         if env_dir == keep:
             continue
+        # Evict only under the env's lock (non-blocking): a concurrent
+        # ensure_pip_env holding it may be mid-install or about to
+        # return this dir to a fresh worker — skip rather than delete
+        # a directory someone just adopted.
         try:
+            lockf = open(env_dir + ".lock", "w")
+        except OSError:
+            continue
+        try:
+            try:
+                fcntl.flock(lockf, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                continue  # in use right now
+            # a hit may have touched the marker after we listed it —
+            # it is no longer LRU, and its adopter is importing from it
+            if os.path.getmtime(m) >= listed_at - 1.0:
+                continue
             os.unlink(m)  # marker first: a racing hit re-installs
             shutil.rmtree(env_dir, ignore_errors=True)
             # the .lock file STAYS: unlinking it would let a racing
@@ -280,6 +301,8 @@ def _evict_pip_envs(keep: str = "",
             # the old one — two concurrent installs into one dir
         except OSError:
             pass
+        finally:
+            lockf.close()
         excess -= 1
 
 

@@ -1,0 +1,87 @@
+"""Per-node dashboard agents (reference ``dashboard/agent.py:28``):
+each node daemon serves node-local stats/logs over HTTP, and the head
+proxies any node's stats + logs through one URL."""
+import json
+import os
+import urllib.request
+
+import pytest
+
+from ray_tpu._private.node_agent import collect_node_stats
+from ray_tpu.cluster_utils import Cluster
+
+
+def _fetch(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_collect_node_stats_shape():
+    stats = collect_node_stats({"ab" * 14: os.getpid()})
+    assert stats["mem_total_bytes"] > 0
+    assert stats["cpu_count"] >= 1
+    assert stats["num_workers"] == 1
+    (w,) = stats["workers"]
+    assert w["pid"] == os.getpid()
+    assert w["rss_bytes"] > 0
+
+
+def test_agents_through_head_and_direct():
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    c = Cluster(head_resources={"CPU": 0})
+    c.add_node(num_cpus=2)
+    rt = c.connect()
+    try:
+        # run a task so the remote node has a worker + a log file
+        @rt.remote
+        def hello():
+            print("agent-test-marker")
+            return "hi"
+
+        assert rt.get(hello.remote(), timeout=60) == "hi"
+
+        dash = rt.dashboard_url()
+        nodes = _fetch(f"{dash}/api/state?kind=nodes")
+        remote = [n for n in nodes if not n["is_head"]]
+        assert len(remote) == 1
+        node = remote[0]
+        # daemons advertise their agent endpoint
+        assert node["agent_url"] and node["agent_url"].startswith("http")
+
+        # 1) the head proxies the REMOTE node's stats over its daemon
+        #    RPC connection — one URL serves the whole cluster
+        stats = _fetch(f"{dash}/api/node?node_id={node['node_id']}")
+        assert stats["node_id"] == node["node_id"]
+        assert stats["mem_total_bytes"] > 0
+        assert stats["num_workers"] >= 1
+        assert any(w.get("rss_bytes", 0) > 0 for w in stats["workers"])
+
+        # 2) the head's own node answers too
+        head_node = [n for n in nodes if n["is_head"]][0]
+        hstats = _fetch(f"{dash}/api/node?node_id={head_node['node_id']}")
+        assert hstats["node_id"] == head_node["node_id"]
+
+        # 3) direct agent access (multi-host debugging path)
+        astats = _fetch(f"{node['agent_url']}/api/stats")
+        assert astats["node_id"] == node["node_id"]
+        workers = _fetch(f"{node['agent_url']}/api/workers")
+        assert len(workers) >= 1
+        files = _fetch(f"{node['agent_url']}/api/logs")["files"]
+        assert any(f.startswith("worker-") for f in files)
+        wid = workers[0]["worker_id"]
+        tail = _fetch(f"{node['agent_url']}/api/logs?worker_id={wid}")
+        assert "data" in tail
+
+        # 4) the remote worker's LOG reaches the driver through the
+        #    head URL as well (fan-out through the daemon)
+        log = _fetch(f"{dash}/api/logs?worker_id={wid}")
+        assert "agent-test-marker" in log["data"]
+
+        # unknown node → clean 404
+        with pytest.raises(urllib.error.HTTPError):
+            _fetch(f"{dash}/api/node?node_id=deadbeef")
+    finally:
+        c.shutdown()
