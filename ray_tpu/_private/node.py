@@ -102,14 +102,21 @@ class NodeService:
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         # Per-node dashboard agent (reference ``dashboard/agent.py:28``):
         # node-local stats/logs over HTTP, also proxied by the head.
-        from .node_agent import NodeAgentServer
+        # Binds the node's cluster IP — not the wildcard — so only the
+        # cluster network reaches it; RT_AGENT_BIND overrides
+        # (127.0.0.1 for loopback-only, "off" to disable; the head
+        # proxy path still serves stats/logs either way).
+        bind = os.environ.get("RT_AGENT_BIND", self.node_ip)
+        if bind and bind.lower() not in ("off", "disabled", "none"):
+            from .node_agent import NodeAgentServer
 
-        self._agent = NodeAgentServer(
-            stats_fn=self._agent_stats,
-            workers_fn=lambda: [{"worker_id": h[:12], "pid": p.pid}
-                                for h, p in self._procs.items()],
-            log_fn=lambda q: tail_worker_log(self.session_dir, q))
-        await self._agent.start()
+            self._agent = NodeAgentServer(
+                stats_fn=self._agent_stats,
+                workers_fn=lambda: [{"worker_id": h[:12], "pid": p.pid}
+                                    for h, p in self._procs.items()],
+                log_fn=lambda q: tail_worker_log(self.session_dir, q),
+                host=bind)
+            await self._agent.start()
         self._conn = await rpc.connect(self.head_address, self._handle)
         resp = await self._conn.call_simple("register_node", {
             "node_id": self.node_id.hex(),
@@ -117,7 +124,8 @@ class NodeService:
             "host": socket.gethostname(),
             "resources": self.resources,
             "labels": self.labels,
-            "agent_url": f"http://{self.node_ip}:{self._agent.port}",
+            "agent_url": (f"http://{self.node_ip}:{self._agent.port}"
+                          if self._agent else None),
         })
         self._adopt_head_config(resp)
         self._reap_task = asyncio.get_running_loop().create_task(

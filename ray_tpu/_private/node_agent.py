@@ -69,7 +69,7 @@ class NodeAgentServer:
     def __init__(self, stats_fn: Callable[[], dict],
                  workers_fn: Callable[[], list],
                  log_fn: Callable[[dict], dict],
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0):
         self._stats_fn = stats_fn
         self._workers_fn = workers_fn
         self._log_fn = log_fn

@@ -18,10 +18,13 @@ from .loggers import (  # noqa: F401
     JsonLoggerCallback,
 )
 from .schedulers import (  # noqa: F401
+    PB2,
     AsyncHyperBandScheduler,
+    DistributeResources,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
 )
 from .search import (  # noqa: F401

@@ -45,6 +45,9 @@ class Trial:
         self.actor = None
         self.iteration = 0
         self.premature = False  # stopped by budget/kill, not by decision
+        # Per-trial resource shape (ResourceChangingScheduler); None →
+        # the experiment-wide resources_per_trial.
+        self.resources: Optional[Dict[str, float]] = None
         self.dir = os.path.join(exp_dir, trial_id)
         os.makedirs(self.dir, exist_ok=True)
 
@@ -92,6 +95,9 @@ class TuneController:
         self._num_suggested = 0
         self.searcher.set_search_space(param_space or {})
         self.scheduler = scheduler or FIFOScheduler()
+        if hasattr(self.scheduler, "set_controller"):
+            # ResourceChangingScheduler needs the live-trial/cluster view
+            self.scheduler.set_controller(self)
         self.max_concurrent = max_concurrent_trials
         self.resources = resources_per_trial or {"CPU": 1}
         self.exp_dir = exp_dir
@@ -146,9 +152,10 @@ class TuneController:
     # ------------------------------------------------------------ actors
     def _launch(self, trial: Trial,
                 resume_checkpoint: Optional[Checkpoint] = None):
-        opts = {"num_cpus": self.resources.get("CPU", 1)}
-        if self.resources.get("TPU"):
-            opts["num_tpus"] = int(self.resources["TPU"])
+        res = trial.resources or self.resources
+        opts = {"num_cpus": res.get("CPU", 1)}
+        if res.get("TPU"):
+            opts["num_tpus"] = int(res["TPU"])
         cls = rt.remote(RayTrainWorker)
         trial.actor = cls.options(**opts).remote(0, 1)
         session_kwargs = {
@@ -297,6 +304,12 @@ class TuneController:
                     if relaunched:
                         # remaining items belong to the killed incarnation
                         break
+                new_res = getattr(trial, "_new_resources", None)
+                if new_res:
+                    trial._new_resources = None
+                    relaunched = self._resize(trial, new_res)
+                    if relaunched:
+                        break
             if trial.status != RUNNING or relaunched:
                 # done/err below describe the OLD actor — not the fresh
                 # incarnation an exploit just launched
@@ -340,6 +353,21 @@ class TuneController:
             cb.on_trial_result(trial, result)
         return self.scheduler.on_trial_result(trial, result)
 
+    def _resize(self, trial: Trial, new_resources: Dict[str, float]) -> bool:
+        """ResourceChangingScheduler: restart the trial actor with a new
+        resource shape from its latest checkpoint (reference
+        ``resource_changing_scheduler.py`` — resize happens at the next
+        checkpoint boundary). No checkpoint yet → defer (keep training
+        at the old size rather than lose progress)."""
+        if new_resources == (trial.resources or self.resources):
+            return False
+        if trial.checkpoint is None:
+            return False
+        self._stop_actor(trial)
+        trial.resources = dict(new_resources)
+        self._launch(trial, resume_checkpoint=trial.checkpoint)
+        return True
+
     def _exploit(self, trial: Trial, donor_id: str) -> bool:
         """PBT: restart this trial from the donor's checkpoint with a
         perturbed config (reference ``pbt.py`` exploit/explore).
@@ -349,9 +377,16 @@ class TuneController:
                      None)
         if donor is None or donor.checkpoint is None:
             return False
-        assert isinstance(self.scheduler, PopulationBasedTraining)
-        new_cfg = self.scheduler.explore(
-            {**trial.config, **donor.config})
+        # _pbt_exploit may come from a PBT/PB2 wrapped inside a
+        # ResourceChangingScheduler — explore on the scheduler that
+        # actually made the decision.
+        sched = self.scheduler
+        if not isinstance(sched, PopulationBasedTraining):
+            sched = getattr(sched, "base", None)
+        assert isinstance(sched, PopulationBasedTraining)
+        new_cfg = sched.explore(
+            {**trial.config, **donor.config}, donor_id=donor_id,
+            trial_id=trial.trial_id)
         # Snapshot the donor checkpoint into THIS trial's dir: the donor
         # prunes its own checkpoints as it keeps training, which would
         # race with the clone's asynchronous restore.

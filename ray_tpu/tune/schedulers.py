@@ -1,8 +1,11 @@
-"""Trial schedulers: FIFO, ASHA, PBT.
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT, PB2,
+resource-changing.
 
-Reference: ``python/ray/tune/schedulers/`` — ``async_hyperband.py`` (ASHA),
-``pbt.py``. Decisions are made per reported result; stopping a function
-trainable kills its actor (same observable behavior as the reference).
+Reference: ``python/ray/tune/schedulers/`` — ``async_hyperband.py``
+(ASHA), ``pbt.py``, ``pb2.py`` (GP-bandit explore),
+``resource_changing_scheduler.py``. Decisions are made per reported
+result; stopping a function trainable kills its actor (same observable
+behavior as the reference).
 """
 from __future__ import annotations
 
@@ -167,7 +170,9 @@ class PopulationBasedTraining(TrialScheduler):
                 trial._pbt_exploit = donor
         return CONTINUE
 
-    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+    def explore(self, config: Dict[str, Any],
+                donor_id: Optional[str] = None,
+                trial_id: Optional[str] = None) -> Dict[str, Any]:
         from .search import Domain
 
         out = dict(config)
@@ -184,3 +189,186 @@ class PopulationBasedTraining(TrialScheduler):
                 if isinstance(cur, (int, float)):
                     out[key] = cur * self.rng.choice([0.8, 1.2])
         return out
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference ``pb2.py``, 507 LoC): PBT
+    where EXPLORE selects the clone's new hyperparameters by GP-bandit
+    UCB instead of random perturbation. A GP is fit on rows
+    ``[t, reward_at_interval_start, hyperparams] → reward improvement``
+    pooled across the population, and the candidate maximizing
+    ``mu + kappa * sigma`` at the donor's (t, reward) coordinates wins.
+
+    The reference leans on GPy's time-varying kernel; here the
+    surrogate is the same numpy RBF-GP recipe as BayesOptSearch —
+    time and reward enter as ordinary (normalized) GP inputs, which
+    captures the non-stationarity that matters (different good
+    hyperparams at different training phases) without the extra
+    machinery.
+
+    ``hyperparam_bounds``: ``{key: [low, high]}`` — continuous only,
+    per the PB2 algorithm.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict[str, List[float]]] = None,
+                 quantile_fraction: float = 0.25,
+                 kappa: float = 2.0,
+                 seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = kappa
+        import numpy as np
+
+        self._np = np
+        self._nprng = np.random.default_rng(seed)
+        # pooled improvement data: X rows [t, r_start, *hp], y = dr
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        # trial_id -> (t, reward, config) at its last recorded point
+        self._prev: Dict[str, tuple] = {}
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is not None and v is not None:
+            prev = self._prev.get(trial.trial_id)
+            if prev is None or t - prev[0] >= self.interval:
+                if prev is not None and t > prev[0]:
+                    pt, pv, pcfg = prev
+                    row = [float(pt), self._norm(float(pv))] + [
+                        float(pcfg.get(k, (lo + hi) / 2))
+                        for k, (lo, hi) in self.bounds.items()]
+                    self._X.append(row)
+                    # improvement per unit time, max-oriented
+                    self._y.append((self._norm(float(v)) -
+                                    self._norm(float(pv))) / (t - prev[0]))
+                    if len(self._X) > 500:
+                        self._X.pop(0)
+                        self._y.pop(0)
+                self._prev[trial.trial_id] = (t, float(v),
+                                              dict(trial.config))
+        return super().on_trial_result(trial, result)
+
+    # ------------------------------------------------------- GP explore
+    def explore(self, config: Dict[str, Any],
+                donor_id: Optional[str] = None,
+                trial_id: Optional[str] = None) -> Dict[str, Any]:
+        np = self._np
+        # The exploited trial restarts from the DONOR's checkpoint: its
+        # pre-exploit record must not seed the next improvement row, or
+        # the donor-level reward jump gets credited to the old (bad)
+        # hyperparameters and poisons the GP.
+        if trial_id is not None:
+            self._prev.pop(trial_id, None)
+        out = dict(config)
+        keys = list(self.bounds)
+        lo = np.array([self.bounds[k][0] for k in keys])
+        hi = np.array([self.bounds[k][1] for k in keys])
+        if len(self._X) < 4:
+            # cold start: uniform in bounds (reference does the same)
+            samp = self._nprng.uniform(lo, hi)
+            out.update({k: float(s) for k, s in zip(keys, samp)})
+            return out
+        X = np.asarray(self._X, np.float64)
+        y = np.asarray(self._y, np.float64)
+        # normalize all inputs to [0, 1]; standardize y
+        mins = X.min(0)
+        maxs = X.max(0)
+        fixed_src = self._prev.get(donor_id) if donor_id else None
+        t_now, r_now = ((fixed_src[0], self._norm(fixed_src[1]))
+                        if fixed_src else (X[:, 0].max(), X[:, 1].max()))
+        span = np.where(maxs > mins, maxs - mins, 1.0)
+
+        def unit(rows):
+            return (rows - mins) / span
+
+        Xu = unit(X)
+        ystd = y.std() or 1.0
+        yu = (y - y.mean()) / ystd
+        n_cand = 256
+        cand_hp = self._nprng.uniform(lo, hi, size=(n_cand, len(keys)))
+        cand = np.concatenate(
+            [np.full((n_cand, 1), t_now),
+             np.full((n_cand, 1), r_now), cand_hp], axis=1)
+        Cu = unit(cand)
+        ls, noise = 0.25, 1e-3
+
+        def k(a, b):
+            d = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d / (2 * ls * ls))
+
+        K = k(Xu, Xu) + noise * np.eye(len(Xu))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yu))
+        Ks = k(Cu, Xu)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        sigma = np.sqrt(np.maximum(1.0 - (v ** 2).sum(0), 1e-12))
+        best = int(np.argmax(mu + self.kappa * sigma))
+        out.update({k2: float(c)
+                    for k2, c in zip(keys, cand_hp[best])})
+        return out
+
+
+class DistributeResources:
+    """Default allocation policy for ResourceChangingScheduler
+    (reference ``resource_changing_scheduler.py`` DistributeResources):
+    split the cluster's CPUs evenly over live trials, never below the
+    experiment's base request."""
+
+    def __init__(self, base_cpus: float = 1.0):
+        self.base_cpus = base_cpus
+
+    def __call__(self, controller, trial, result) -> Dict[str, float]:
+        import ray_tpu as rt
+
+        try:
+            total = rt.cluster_resources().get("CPU", self.base_cpus)
+        except Exception:  # noqa: BLE001 - no cluster: keep base
+            return {"CPU": self.base_cpus}
+        n = max(1, len([t for t in controller.trials
+                        if t.status == "RUNNING"]))
+        return {"CPU": max(self.base_cpus, float(int(total / n)))}
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Wraps a base scheduler and reallocates trial resources as the
+    experiment evolves (reference ``resource_changing_scheduler.py``):
+    after each result the allocation function proposes a resource
+    shape; a changed shape restarts the trial actor from its latest
+    checkpoint with the new size (fewer trials → bigger trials)."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc = resources_allocation_function or DistributeResources()
+        self._controller = None
+
+    def set_controller(self, controller):
+        self._controller = controller
+
+    def on_trial_result(self, trial, result):
+        decision = self.base.on_trial_result(trial, result)
+        if decision == CONTINUE and self._controller is not None:
+            try:
+                new = self.alloc(self._controller, trial, result)
+            except Exception:  # noqa: BLE001 - allocation is advisory
+                new = None
+            if new:
+                trial._new_resources = new
+        return decision
+
+    def on_trial_complete(self, trial, result):
+        return self.base.on_trial_complete(trial, result)
+
+    def choose_trial_to_run(self, pending):
+        return self.base.choose_trial_to_run(pending)
