@@ -25,6 +25,7 @@ from .api import (  # noqa: F401
     is_initialized,
     kill,
     list_actors,
+    method,
     metrics_text,
     nodes,
     placement_group,

@@ -1193,7 +1193,10 @@ class HeadService:
         return {"state": a.state,
                 "address": a.worker.address if a.worker else None,
                 "death_cause": a.death_cause,
-                "name": a.name}
+                "name": a.name,
+                "has_concurrency_groups": bool(
+                    (a.creation_spec_meta or {}).get(
+                        "concurrency_groups"))}
 
     async def _rpc_get_named_actor(self, payload, bufs):
         name = payload["name"]

@@ -92,6 +92,7 @@ class NodeService:
 
         self.config = Config()  # replaced by the head's at registration
         self._agent = None  # NodeAgentServer, started in start()
+        self._agent_adv_host = self.node_ip
         self._procs: Dict[str, subprocess.Popen] = {}  # worker hex -> proc
         self._reap_task: Optional[asyncio.Task] = None
         self._stopping = False
@@ -117,6 +118,12 @@ class NodeService:
                 log_fn=lambda q: tail_worker_log(self.session_dir, q),
                 host=bind)
             await self._agent.start()
+            # Advertise the address the agent actually LISTENS on
+            # (wildcard → the routable node IP); a loopback bind must
+            # not publish a cluster-wide URL nobody can reach.
+            self._agent_adv_host = (self.node_ip
+                                    if bind in ("0.0.0.0", "::")
+                                    else bind)
         self._conn = await rpc.connect(self.head_address, self._handle)
         resp = await self._conn.call_simple("register_node", {
             "node_id": self.node_id.hex(),
@@ -124,8 +131,9 @@ class NodeService:
             "host": socket.gethostname(),
             "resources": self.resources,
             "labels": self.labels,
-            "agent_url": (f"http://{self.node_ip}:{self._agent.port}"
-                          if self._agent else None),
+            "agent_url": (
+                f"http://{self._agent_adv_host}:{self._agent.port}"
+                if self._agent else None),
         })
         self._adopt_head_config(resp)
         self._reap_task = asyncio.get_running_loop().create_task(
@@ -208,7 +216,8 @@ class NodeService:
                     "resources": self.resources,
                     "labels": self.labels,
                     "agent_url": (
-                        f"http://{self.node_ip}:{self._agent.port}"
+                        f"http://{self._agent_adv_host}:"
+                        f"{self._agent.port}"
                         if self._agent else None),
                 })
                 self._adopt_head_config(resp)

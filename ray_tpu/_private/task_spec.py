@@ -58,6 +58,10 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     method_name: str = ""
     seq_no: int = -1  # per-handle ordering for actor tasks
+    # Named concurrency group this call runs in (None = method default
+    # or the actor's default executor). Reference:
+    # ``concurrency_group_manager.h``.
+    concurrency_group: Optional[str] = None
     max_restarts: int = 0
     max_concurrency: int = 1
     name: str = ""

@@ -366,13 +366,19 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1,
+                concurrency_group: Optional[str] = None,
+                method_name: Optional[str] = None):
+        return ActorMethod(self._handle, method_name or self._name,
+                           num_returns, concurrency_group)
 
     def bind(self, *upstreams):
         """Build a compiled-DAG node (see :mod:`ray_tpu.dag`);
@@ -385,7 +391,8 @@ class ActorMethod:
         core = _core()
         refs = core.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns)
+            num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group)
         if self._num_returns == "streaming":
             return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
@@ -452,8 +459,26 @@ class ActorClass:
             strategy=_strategy_from_options(self._options),
             lifetime=self._options.get("lifetime"),
             runtime_env=self._options.get("runtime_env"),
+            concurrency_groups=self._options.get("concurrency_groups"),
         )
         return ActorHandle(actor_id)
+
+
+def method(*, concurrency_group: Optional[str] = None):
+    """``@method`` decorator binding an actor method to a named
+    concurrency group (reference: ``ray.method(concurrency_group=)``,
+    ``concurrency_group_manager.h``). Declare the groups on the class:
+    ``@remote(concurrency_groups={"io": 2, "compute": 4})``; calls to a
+    bound method run on that group's dedicated thread pool, and
+    ``handle.m.options(concurrency_group="io")`` overrides per call.
+    (Per-call return counts use ``handle.m.options(num_returns=N)``.)"""
+
+    def decorate(fn):
+        if concurrency_group is not None:
+            fn.__rt_concurrency_group__ = concurrency_group
+        return fn
+
+    return decorate
 
 
 def remote(*args, **options):

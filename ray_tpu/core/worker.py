@@ -485,6 +485,10 @@ class CoreWorker:
         # worker-mode execution state
         self._actors_local: Dict[bytes, Any] = {}  # actor_id -> instance
         self._actor_executors: Dict[bytes, Any] = {}
+        # actor -> {group name -> dedicated ThreadPoolExecutor}
+        self._actor_group_executors: Dict[bytes, Dict[str, Any]] = {}
+        # actor -> {group name -> asyncio.Semaphore} (async methods)
+        self._actor_group_sems: Dict[bytes, Dict[str, Any]] = {}
         self._actor_order: Dict[bytes, dict] = {}
         self._exec_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(8, (os.cpu_count() or 1) * 4),
@@ -1788,6 +1792,8 @@ class CoreWorker:
             meta["actor_id"] = spec.actor_id.binary()
             meta["method_name"] = spec.method_name
             meta["seq_no"] = spec.seq_no
+            if spec.concurrency_group:
+                meta["concurrency_group"] = spec.concurrency_group
         if spec.name:
             meta["name"] = spec.name
         if spec.max_concurrency != 1:
@@ -1927,7 +1933,8 @@ class CoreWorker:
     # ------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, *, resources=None, name="",
                      max_restarts=0, max_concurrency=1, strategy=None,
-                     lifetime=None, runtime_env=None) -> "ActorID":
+                     lifetime=None, runtime_env=None,
+                     concurrency_groups=None) -> "ActorID":
         actor_id = ActorID.from_random()
         wire_env = self._prepare_runtime_env(runtime_env)
         cls_key = self.export_function(cls)
@@ -1946,6 +1953,9 @@ class CoreWorker:
             "name": name,
             "runtime_env": wire_env,
         }
+        if concurrency_groups:
+            spec_meta["concurrency_groups"] = {
+                str(k): int(v) for k, v in concurrency_groups.items()}
         strategy = strategy or SchedulingStrategy()
         payload = {
             "actor_id": actor_id.hex(),
@@ -1966,7 +1976,9 @@ class CoreWorker:
             },
         }
         st = {"state": "PENDING", "address": None, "error": None,
-              "event": threading.Event()}
+              "event": threading.Event(),
+              # group actors bypass wire batching (see submit_actor_task)
+              "groups": bool(concurrency_groups)}
         self._actor_state[actor_id.binary()] = st
         registered = threading.Event()
         reg_err: list = []
@@ -2040,7 +2052,8 @@ class CoreWorker:
             # address races the instance registration on the worker.
             addr = meta["address"] if meta["state"] == "ALIVE" else None
             st = {"state": meta["state"], "address": addr,
-                  "error": None, "event": threading.Event()}
+                  "error": None, "event": threading.Event(),
+                  "groups": bool(meta.get("has_concurrency_groups"))}
             st["event"].set()
             self._actor_state[actor_id.binary()] = st
 
@@ -2069,7 +2082,7 @@ class CoreWorker:
         return st["address"]
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
-                          kwargs, num_returns=1):
+                          kwargs, num_returns=1, concurrency_group=None):
         task_id = TaskID.from_random()
         streaming = num_returns == "streaming"
         ser_args, kw_keys, borrowed = self._serialize_args(args, kwargs)
@@ -2085,6 +2098,14 @@ class CoreWorker:
         # out actor calls) racing the unlocked read-increment would mint
         # duplicate seq_nos, and the receiver's ordered stream then
         # waits forever for the gap — a hang, not a perf bug.
+        # Group actors take the per-call direct path: a chunked RPC's
+        # reply waits for its SLOWEST call, which would let a long call
+        # in one group delay another group's result delivery — the
+        # isolation groups exist to provide. (A foreign handle's very
+        # first burst may still batch before the head metadata arrives;
+        # routing stays correct, only that burst shares a reply.)
+        group_actor = concurrency_group is not None or bool(
+            (self._actor_state.get(key) or {}).get("groups"))
         with self._actor_struct_lock:
             seq = self._actor_seq[key]
             self._actor_seq[key] = seq + 1
@@ -2095,6 +2116,7 @@ class CoreWorker:
                 kwargs_keys=kw_keys,
                 num_returns=0 if streaming else num_returns,
                 actor_id=actor_id, method_name=method_name, seq_no=seq,
+                concurrency_group=concurrency_group,
                 owner_address=self.address, is_generator=streaming,
                 trace_ctx=trace_ctx,
             )
@@ -2102,8 +2124,9 @@ class CoreWorker:
                 direct = None  # enqueue outside the lock
             else:
                 q = self._actor_batch.setdefault(key, deque())
-                if not q and not self._actor_pump_active.get(key) and \
-                        not self._actor_direct_inflight[key]:
+                if group_actor or (
+                        not q and not self._actor_pump_active.get(key) and
+                        not self._actor_direct_inflight[key]):
                     # Idle actor (the sync-call pattern): skip the
                     # queue+pump layer. The in-flight counter makes a
                     # burst's SECOND call take the batching path —
@@ -2260,7 +2283,8 @@ class CoreWorker:
         # tens of thousands of calls/s (reference capability:
         # ``direct_actor_task_submitter.cc`` pipelining, taken further).
         if all(not borrowed and not s.kwargs_keys and s.num_returns == 1
-               and not s.is_generator for s, borrowed in chunk):
+               and not s.is_generator and not s.concurrency_group
+               for s, borrowed in chunk):
             return await self._send_actor_chunk_packed(actor_id, chunk)
         try:
             reply, bufs = await self._actor_request(
@@ -2675,8 +2699,20 @@ class CoreWorker:
         maxc = meta.get("max_concurrency", 1)
         self._actor_executors[actor_id_b] = concurrent.futures.ThreadPoolExecutor(
             max_workers=maxc, thread_name_prefix="rt-actor")
+        groups = meta.get("concurrency_groups")
+        if groups:
+            # Named concurrency groups (reference:
+            # ``concurrency_group_manager.h`` — one executor per group,
+            # methods bind via @method(concurrency_group=...)): a slow
+            # group saturating its threads can't starve another group.
+            self._actor_group_executors[actor_id_b] = {
+                name: concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max(1, int(n)),
+                    thread_name_prefix=f"rt-actor-{name}")
+                for name, n in groups.items()}
         self._actor_order[actor_id_b] = {
-            "ordered": maxc == 1, "streams": {}}
+            # groups are inherently concurrent: no global FIFO stream
+            "ordered": maxc == 1 and not groups, "streams": {}}
         return {"ok": True}
 
     async def _exec_push_task(self, payload, bufs, conn=None):
@@ -2845,8 +2881,11 @@ class CoreWorker:
         first, last = meta0["seq_no"], specs[-1]["seq_no"]
         owner = meta0["owner_address"]
         if (instance is None or order is None
+                or actor_id_b in self._actor_group_executors
                 or any(m.get("is_generator") for m in specs)
                 or meta0["method_name"] == "__rt_drive__"):
+            # concurrency-group actors take the per-call path, which
+            # routes each call to its group's executor
             return None
         for m in specs:
             method = getattr(instance, m["method_name"], None)
@@ -3063,6 +3102,43 @@ class CoreWorker:
         except Exception:  # noqa: BLE001 - owner died; nothing to stream to
             pass
 
+    def _actor_group_name(self, actor_id_b, meta, instance):
+        """Resolve a call's concurrency group: explicit per-call group >
+        the method's @method(concurrency_group=...) binding > None.
+        Unknown names error — including on actors that declared NO
+        groups, so a typo'd override never passes silently."""
+        groups = self._actor_group_executors.get(actor_id_b)
+        g = meta.get("concurrency_group")
+        if g is None and groups:
+            m = getattr(type(instance), meta.get("method_name", ""), None)
+            g = getattr(m, "__rt_concurrency_group__", None)
+        if g is not None and (not groups or g not in groups):
+            raise rpc.RpcError(
+                f"unknown concurrency group {g!r}; declared: "
+                f"{sorted(groups) if groups else '(none)'}")
+        return g
+
+    def _actor_executor_for(self, actor_id_b, meta, instance):
+        """Thread pool for one sync call (reference:
+        ``concurrency_group_manager.h`` GetExecutor)."""
+        g = self._actor_group_name(actor_id_b, meta, instance)
+        if g is not None:
+            return self._actor_group_executors[actor_id_b][g]
+        return self._actor_executors[actor_id_b]
+
+    def _actor_group_semaphore(self, actor_id_b, g, loop):
+        """Async methods can't run on a thread pool; their group limit
+        is an asyncio semaphore of the same width (reference: async
+        actors bound concurrency per group the same way)."""
+        sems = self._actor_group_sems.setdefault(actor_id_b, {})
+        sem = sems.get(g)
+        if sem is None:
+            width = getattr(
+                self._actor_group_executors[actor_id_b][g],
+                "_max_workers", 1)
+            sem = sems[g] = asyncio.Semaphore(width)
+        return sem
+
     async def _run_actor_task(self, meta, conn=None):
         actor_id_b = meta["actor_id"]
         instance = self._actors_local.get(actor_id_b)
@@ -3103,7 +3179,7 @@ class CoreWorker:
                     return self._traced_gen(
                         meta, lambda: method(*args, **kwargs))
 
-                ex = self._actor_executors[actor_id_b]
+                ex = self._actor_executor_for(actor_id_b, meta, instance)
                 return await loop.run_in_executor(
                     ex, lambda: self._run_generator(meta, conn, produce))
             light = _args_are_light()
@@ -3116,10 +3192,18 @@ class CoreWorker:
                     lambda: self._deserialize_args(meta["args"],
                                                    meta["kwargs_keys"]))
             if asyncio.iscoroutinefunction(method):
-                with tracing.execute_span(meta, meta["method_name"]):
-                    out = await method(*args, **kwargs)
+                g = self._actor_group_name(actor_id_b, meta, instance)
+                if g is not None:
+                    sem = self._actor_group_semaphore(actor_id_b, g, loop)
+                    async with sem:
+                        with tracing.execute_span(meta,
+                                                  meta["method_name"]):
+                            out = await method(*args, **kwargs)
+                else:
+                    with tracing.execute_span(meta, meta["method_name"]):
+                        out = await method(*args, **kwargs)
             else:
-                ex = self._actor_executors[actor_id_b]
+                ex = self._actor_executor_for(actor_id_b, meta, instance)
 
                 def _call_traced():
                     with tracing.execute_span(meta, meta["method_name"]):

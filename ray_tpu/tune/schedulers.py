@@ -323,7 +323,8 @@ class DistributeResources:
     """Default allocation policy for ResourceChangingScheduler
     (reference ``resource_changing_scheduler.py`` DistributeResources):
     split the cluster's CPUs evenly over live trials, never below the
-    experiment's base request."""
+    experiment's per-trial base request. Only the CPU axis is adjusted —
+    TPU and custom resources pass through the trial's shape unchanged."""
 
     def __init__(self, base_cpus: float = 1.0):
         self.base_cpus = base_cpus
@@ -331,13 +332,18 @@ class DistributeResources:
     def __call__(self, controller, trial, result) -> Dict[str, float]:
         import ray_tpu as rt
 
+        shape = dict(trial.resources or controller.resources)
+        floor = max(self.base_cpus,
+                    controller.resources.get("CPU", self.base_cpus))
         try:
-            total = rt.cluster_resources().get("CPU", self.base_cpus)
+            total = rt.cluster_resources().get("CPU", floor)
         except Exception:  # noqa: BLE001 - no cluster: keep base
-            return {"CPU": self.base_cpus}
+            shape["CPU"] = floor
+            return shape
         n = max(1, len([t for t in controller.trials
                         if t.status == "RUNNING"]))
-        return {"CPU": max(self.base_cpus, float(int(total / n)))}
+        shape["CPU"] = max(floor, float(int(total / n)))
+        return shape
 
 
 class ResourceChangingScheduler(TrialScheduler):
