@@ -1,0 +1,120 @@
+"""Pinned microbenchmark protocol: interleaved A/B vs a recorded
+baseline commit, median-of-N with spread.
+
+Single-run numbers on shared/VM hosts are not durable — boot-to-boot
+throughput varies (MICROBENCH_r03.json's end-of-round re-measurement
+moved a row from 1.15x to 0.65x on host variance alone). This driver
+makes claims reproducible:
+
+- checks out the ROUND-START commit into a scratch git worktree,
+- alternates HEAD run, baseline run, HEAD, baseline … (N each), so
+  slow host phases hit both sides equally,
+- reports per-metric MEDIAN and spread (min-max) for both sides plus
+  the median-vs-median ratio — a regression claim requires the ratio,
+  not one lucky run.
+
+Run: ``python benchmarks/micro_ab.py --base <commit> [--runs 5]
+[--quick] [--out MICROBENCH_r04.json]``
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_side(tree: str, quick: bool) -> dict:
+    """One micro_bench run under ``tree``; returns metric -> value."""
+    for seg in glob.glob("/dev/shm/rt_*"):
+        try:
+            os.unlink(seg)
+        except OSError:
+            pass
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("RT_")}
+    env["PYTHONPATH"] = tree
+    cmd = [sys.executable, os.path.join(tree, "benchmarks",
+                                        "micro_bench.py")]
+    if quick:
+        cmd.append("--quick")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=tree, timeout=1800, env=env)
+    out = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+                out[rec["metric"]] = rec["value"]
+            except (ValueError, KeyError):
+                pass
+    if not out:
+        raise RuntimeError(
+            f"no metrics from {tree}: {proc.stderr[-1500:]}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base", required=True,
+                    help="round-start commit for the B side")
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="MICROBENCH_AB.json")
+    args = ap.parse_args()
+
+    base_tree = tempfile.mkdtemp(prefix="rt_ab_base_")
+    subprocess.run(["git", "worktree", "add", "--detach", base_tree,
+                    args.base], cwd=REPO, check=True,
+                   capture_output=True)
+    a_runs, b_runs = [], []
+    try:
+        for i in range(args.runs):
+            print(f"run {i + 1}/{args.runs}: HEAD…", file=sys.stderr)
+            a_runs.append(run_side(REPO, args.quick))
+            print(f"run {i + 1}/{args.runs}: base…", file=sys.stderr)
+            b_runs.append(run_side(base_tree, args.quick))
+    finally:
+        subprocess.run(["git", "worktree", "remove", "--force",
+                        base_tree], cwd=REPO, capture_output=True)
+
+    metrics = sorted(set().union(*a_runs, *b_runs))
+    rows = []
+    for m in metrics:
+        a = sorted(r[m] for r in a_runs if m in r)
+        b = sorted(r[m] for r in b_runs if m in r)
+        if not a or not b:
+            continue
+        med_a, med_b = statistics.median(a), statistics.median(b)
+        rows.append({
+            "metric": m,
+            "head_median": round(med_a, 2),
+            "head_spread": [round(a[0], 2), round(a[-1], 2)],
+            "base_median": round(med_b, 2),
+            "base_spread": [round(b[0], 2), round(b[-1], 2)],
+            "head_vs_base": round(med_a / med_b, 3) if med_b else None,
+        })
+        print(json.dumps(rows[-1]))
+    doc = {
+        "protocol": (f"interleaved A/B x {args.runs} runs; medians + "
+                     "min-max spread; HEAD vs "
+                     f"{args.base}"),
+        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "quick": args.quick,
+        "rows": rows,
+    }
+    with open(os.path.join(REPO, args.out), "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

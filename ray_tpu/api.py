@@ -374,11 +374,14 @@ class ActorMethod:
         self._num_returns = num_returns
         self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1,
-                concurrency_group: Optional[str] = None,
-                method_name: Optional[str] = None):
-        return ActorMethod(self._handle, method_name or self._name,
-                           num_returns, concurrency_group)
+    def options(self, num_returns=None,
+                concurrency_group: Optional[str] = None):
+        # unset fields inherit from THIS instance so chained
+        # .options() calls compose instead of resetting
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group or self._concurrency_group)
 
     def bind(self, *upstreams):
         """Build a compiled-DAG node (see :mod:`ray_tpu.dag`);
@@ -471,7 +474,12 @@ def method(*, concurrency_group: Optional[str] = None):
     ``@remote(concurrency_groups={"io": 2, "compute": 4})``; calls to a
     bound method run on that group's dedicated thread pool, and
     ``handle.m.options(concurrency_group="io")`` overrides per call.
-    (Per-call return counts use ``handle.m.options(num_returns=N)``.)"""
+    (Per-call return counts use ``handle.m.options(num_returns=N)``.)
+
+    NOTE: declaring any concurrency group makes the actor THREADED —
+    per-owner FIFO ordering is no longer guaranteed, for ungrouped
+    methods too (reference semantics: threaded actors drop ordering).
+    Keep strictly order-dependent methods on a separate plain actor."""
 
     def decorate(fn):
         if concurrency_group is not None:

@@ -70,29 +70,43 @@ class Cluster:
     def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 wait: bool = True) -> NodeHandle:
+                 wait: bool = True,
+                 shared_shm: bool = False) -> NodeHandle:
+        """``shared_shm=True`` puts the node on the SESSION's shm domain
+        — co-hosted daemons then exchange objects via shared memory
+        (the real one-daemon-per-host topology's fast path) instead of
+        the synthetic per-node domains that exercise cross-node TCP.
+        Shared-domain leftovers are swept by the head at session stop,
+        not by the node (it doesn't own the domain)."""
         self._node_seq += 1
-        shm_domain = f"testnode-{self._node_seq}-{os.getpid()}"
+        if shared_shm:
+            from ._private.utils import session_shm_domain
+
+            shm_domain = session_shm_domain(self.session_dir)
+        else:
+            shm_domain = f"testnode-{self._node_seq}-{os.getpid()}"
         before = {n["node_id"] for n in self.list_nodes()}
         host, port = self.tcp_address
         log = open(os.path.join(self.session_dir,
                                 f"node-{self._node_seq}.log"), "ab")
+        argv = [sys.executable, "-m", "ray_tpu._private.node_main",
+                "--head", f"{host}:{port}",
+                "--session-dir", self.session_dir,
+                "--num-cpus", str(num_cpus),
+                "--num-tpus", str(num_tpus),
+                "--resources", json.dumps(resources or {}),
+                "--shm-domain", shm_domain,
+                "--labels", json.dumps(labels or {}),
+                # Test nodes die with the test process — a SIGKILL'd run
+                # must not leak daemons (and their workers) machine-wide.
+                "--die-with-parent"]
+        if not shared_shm:
+            # The synthetic domain is exclusively this node's: its
+            # daemon may sweep leftovers at stop. (A SHARED domain is
+            # the session's — the head sweeps it at session stop.)
+            argv.insert(-1, "--private-shm-domain")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.node_main",
-             "--head", f"{host}:{port}",
-             "--session-dir", self.session_dir,
-             "--num-cpus", str(num_cpus),
-             "--num-tpus", str(num_tpus),
-             "--resources", json.dumps(resources or {}),
-             "--shm-domain", shm_domain,
-             "--labels", json.dumps(labels or {}),
-             # The synthetic domain is exclusively this node's: its
-             # daemon may sweep leftovers at stop.
-             "--private-shm-domain",
-             # Test nodes die with the test process — a SIGKILL'd run
-             # must not leak daemons (and their workers) machine-wide.
-             "--die-with-parent"],
-            stdout=log, stderr=subprocess.STDOUT,
+            argv, stdout=log, stderr=subprocess.STDOUT,
             env=self._node_env(),
         )
         node_id = ""

@@ -3126,7 +3126,7 @@ class CoreWorker:
             return self._actor_group_executors[actor_id_b][g]
         return self._actor_executors[actor_id_b]
 
-    def _actor_group_semaphore(self, actor_id_b, g, loop):
+    def _actor_group_semaphore(self, actor_id_b, g):
         """Async methods can't run on a thread pool; their group limit
         is an asyncio semaphore of the same width (reference: async
         actors bound concurrency per group the same way)."""
@@ -3194,7 +3194,7 @@ class CoreWorker:
             if asyncio.iscoroutinefunction(method):
                 g = self._actor_group_name(actor_id_b, meta, instance)
                 if g is not None:
-                    sem = self._actor_group_semaphore(actor_id_b, g, loop)
+                    sem = self._actor_group_semaphore(actor_id_b, g)
                     async with sem:
                         with tracing.execute_span(meta,
                                                   meta["method_name"]):
