@@ -121,8 +121,22 @@ def init_dreamer_params(spec: RLModuleSpec, cfg, seed: int) -> Dict:
 
 
 class SequenceReplay:
-    """Fragment store sampling fixed-length windows (the reference keeps
-    a uniform replay of sequences, ``dreamerv3.py`` ``EpisodeReplayBuffer``)."""
+    """Fragment store over ARRIVAL-aligned rows (the reference keeps a
+    uniform replay of episode sequences, ``EpisodeReplayBuffer``; here
+    the per-slot stream IS the paper's convention already):
+
+    - row t carries ``obs`` = the observation ARRIVED AT, ``a_prev`` =
+      the action that produced it, ``rewards`` = the reward received on
+      arrival, ``terms`` = whether this arrival ends the episode.
+    - episode starts are explicit rows (``is_first``; a_prev/reward
+      zero) and TERMINAL ARRIVAL observations are real rows, so reward
+      and continue heads train on the paper's targets — including
+      p(continue)=0 exactly at terminal arrivals.
+
+    Windows force ``is_first`` at their first row (the posterior scan
+    burns in from the zero state mid-episode, reference-style)."""
+
+    KEYS = ("obs", "a_prev", "rewards", "terms", "is_first")
 
     def __init__(self, capacity_fragments: int, seq_len: int, seed: int = 0):
         self.capacity = capacity_fragments
@@ -140,32 +154,19 @@ class SequenceReplay:
         return len(self._frags)
 
     def sample(self, batch: int) -> Dict[str, np.ndarray]:
-        out = {k: [] for k in ("obs", "actions", "rewards", "terms",
-                               "is_first")}
+        out = {k: [] for k in self.KEYS}
         for _ in range(batch):
             f = self._frags[self._rng.integers(len(self._frags))]
             t0 = self._rng.integers(0, len(f["obs"]) - self.seq_len + 1)
             sl = slice(t0, t0 + self.seq_len)
-            is_first = np.zeros(self.seq_len, bool)
-            is_first[0] = True
-            # Episode CUTS (termination OR truncation) reset the RSSM…
-            is_first[1:] |= f["dones"][sl][:-1].astype(bool)
+            is_first = f["is_first"][sl].copy().astype(bool)
+            is_first[0] = True  # window start burns in from zero state
             out["obs"].append(f["obs"][sl])
-            out["actions"].append(f["actions"][sl])
-            # …but only TERMINATIONS train the continue head: a
-            # time-limit truncation is not an MDP exit, and teaching
-            # p(continue)=0 there poisons imagined returns (reference:
-            # DreamerV3 continue target uses terminations only).
-            # NOTE on alignment: targets here are the OUTCOME of a_t at
-            # feat_t (which has absorbed a_{t-1}); the reference's
-            # arrival convention needs the terminal arrival observation
-            # in the stream, which this runner does not record yet —
-            # shifting without it silently zeroes every termination
-            # target (NOTES_r03).
+            out["a_prev"].append(f["a_prev"][sl])
             out["rewards"].append(f["rewards"][sl])
             out["terms"].append(f["terms"][sl])
             out["is_first"].append(is_first)
-        return {k: np.stack(v).astype(np.float32) if k != "actions"
+        return {k: np.stack(v).astype(np.float32) if k != "a_prev"
                 else np.stack(v) for k, v in out.items()}
 
 
@@ -246,15 +247,18 @@ class DreamerV3Learner:
         sg = jax.lax.stop_gradient
 
         def observe(p, key, batch):
-            """Posterior scan over a [B, L] sequence batch."""
+            """Posterior scan over a [B, L] ARRIVAL-aligned batch:
+            row t's ``a_prev`` is the action that produced ``obs_t``,
+            so the recurrence absorbs (a_prev_t, obs_t) directly — no
+            in-scan shifting."""
             B, L = batch["obs"].shape[:2]
             emb = mlp(p["encoder"], symlog(batch["obs"], jnp),
                       act_last=True)
             if continuous:
-                a_feed = batch["actions"].reshape(B, L, act_n)
+                a_feed = batch["a_prev"].reshape(B, L, act_n)
             else:
                 a_feed = jax.nn.one_hot(
-                    batch["actions"].astype(jnp.int32), act_n)
+                    batch["a_prev"].astype(jnp.int32), act_n)
             keys = jax.random.split(key, L)
 
             def step(carry, t):
@@ -262,9 +266,8 @@ class DreamerV3Learner:
                 reset = batch["is_first"][:, t][:, None]
                 h = h * (1 - reset)
                 z = z * (1 - reset[..., None])
-                a_prev = jnp.where(
-                    t > 0, a_feed[:, jnp.maximum(t - 1, 0)], 0.0)
-                a_prev = a_prev * (1 - reset)
+                a_prev = a_feed[:, t] * (1 - reset)  # no action "into"
+                # an episode start (its a_prev row is a placeholder)
                 h = gru(p["gru"],
                         h, jnp.concatenate([z.reshape(B, S * C),
                                             a_prev], -1))
@@ -291,6 +294,11 @@ class DreamerV3Learner:
             B, L = batch["obs"].shape[:2]
             recon = mlp(p["decoder"], feat)
             l_obs = ((recon - symlog(batch["obs"], jnp)) ** 2).sum(-1)
+            # ARRIVAL convention (paper / reference dreamerv3): feat_t
+            # has absorbed (a_{t-1}, obs_t); its reward target is the
+            # reward RECEIVED on arrival and its continue target is 0
+            # exactly at terminal arrival observations — which are real
+            # rows in this replay stream.
             rew_lg = mlp(p["reward"], feat).reshape(B * L, NUM_BINS)
             rew_t = twohot(symlog(batch["rewards"], jnp).reshape(-1), jnp)
             l_rew = -(rew_t * jax.nn.log_softmax(rew_lg, -1)).sum(-1)
@@ -325,10 +333,12 @@ class DreamerV3Learner:
                 out = mlp(p["actor"], feat)
                 ka, kz = jax.random.split(k)
                 if continuous:
-                    mean, log_std = jnp.split(out, 2, -1)
-                    log_std = jnp.clip(log_std, -5.0, 2.0)
-                    u = mean + jnp.exp(log_std) * jax.random.normal(
-                        ka, mean.shape)
+                    mean, raw_std = jnp.split(out, 2, -1)
+                    # paper's std parameterization: bounded, smooth,
+                    # never collapses below min_std (NOTES_r03 #3)
+                    std = 2.0 * jax.nn.sigmoid(raw_std / 2.0) + 0.1
+                    log_std = jnp.log(std)
+                    u = mean + std * jax.random.normal(ka, mean.shape)
                     a_feed = scale_action(jnp.tanh(u))
                     aux = (u, mean, log_std)
                 else:
@@ -372,13 +382,19 @@ class DreamerV3Learner:
             feat = feat_of(ih, iz)  # [H, N, F] — s_0..s_{H-1}
             H, N = feat.shape[:2]
             r_lo, r_hi, v_cap = r_caps
+            feat_last = feat_of(h_last, z_last)[None]  # s_H
+            # ARRIVAL convention: the reward/continue for action a_t
+            # (taken at s_t) live at the SUCCESSOR state s_{t+1}, the
+            # state that absorbed the action — evaluate the heads on
+            # s_1..s_H (reference dream_trajectory target indexing).
+            feat_next = jnp.concatenate([feat[1:], feat_last], 0)
             # Heads are PARAM-stopped for the return estimate: with a
             # pathwise (continuous) actor, un-stopped params would let
             # the actor loss push reward/cont/critic predictions toward
             # the caps instead of moving the policy. Features stay
             # differentiable — that's the pathwise gradient.
             rew = twohot_mean(mlp(sg_tree(p["reward"]),
-                                  feat).reshape(H * N, -1),
+                                  feat_next).reshape(H * N, -1),
                               jnp).reshape(H, N)
             # Ground imagination in the DATA: off-distribution states
             # (which a pathwise actor actively seeks out) can decode to
@@ -387,16 +403,17 @@ class DreamerV3Learner:
             # the model-exploitation blow-up while leaving everything
             # inside the observed support untouched.
             rew = symexp(jnp.clip(rew, r_lo, r_hi), jnp)
-            cont = jax.nn.sigmoid(mlp(sg_tree(p["cont"]), feat)[..., 0])
+            cont = jax.nn.sigmoid(mlp(sg_tree(p["cont"]),
+                                      feat_next)[..., 0])
             v_lg = mlp(sg_tree(p["critic"]), feat).reshape(H * N, -1)
             values = symexp(jnp.clip(twohot_mean(v_lg, jnp),
                                      -v_cap, v_cap), jnp).reshape(H, N)
             # Bootstrap with V(s_H) from the final scan carry — the
             # state one past the last emitted one — so the last
-            # lambda-return is rew(s_{H-1}) + gamma*cont*V(s_H), not a
+            # lambda-return is rew@s_H + gamma*cont*V(s_H), not a
             # duplicated V(s_{H-1}).
             v_last = symexp(jnp.clip(twohot_mean(
-                mlp(sg_tree(p["critic"]), feat_of(h_last, z_last)), jnp),
+                mlp(sg_tree(p["critic"]), feat_last[0]), jnp),
                 -v_cap, v_cap), jnp)
             vals_ext = jnp.concatenate([values, v_last[None]], 0)
             rets = lambda_returns(rew, cont, vals_ext)  # [H, N]
@@ -604,9 +621,11 @@ class DreamerV3Module:
         if self.spec.continuous:
             from .sac import squash_logp
 
-            mean, log_std = np.split(out, 2, -1)
-            log_std = np.clip(log_std, -5.0, 2.0)
-            u = mean + np.exp(log_std) * rng.standard_normal(mean.shape)
+            mean, raw_std = np.split(out, 2, -1)
+            # mirror the learner's std parameterization
+            std = 2.0 / (1.0 + np.exp(-raw_std / 2.0)) + 0.1
+            log_std = np.log(std)
+            u = mean + std * rng.standard_normal(mean.shape)
             env_a = self._to_env(np.tanh(u)).astype(np.float32)
             for i in range(n):
                 self._state[i] = (h[i], z[i], env_a[i])
@@ -705,6 +724,12 @@ class DreamerV3(Algorithm):
         self._learner = DreamerV3Learner(self.module_spec, cfg,
                                          seed=cfg.seed)
         self._updates = 0
+        from collections import defaultdict
+
+        # per-slot arrival-row accumulation (see training_step)
+        self._slot_rows = defaultdict(
+            lambda: {k: [] for k in SequenceReplay.KEYS})
+        self._need_start = defaultdict(lambda: True)
 
         class _SoloGroup(LearnerGroup):
             def __init__(inner):  # noqa: N805 - tiny adapter
@@ -723,21 +748,51 @@ class DreamerV3(Algorithm):
 
             def env_major(x):
                 # runner batches are TIME-major [t0e0, t0e1, t1e0, ...];
-                # replay wants one contiguous fragment per env slot
+                # replay wants one contiguous stream per env slot
                 return x.reshape((T, N) + x.shape[1:]).swapaxes(0, 1)
 
-            obs, acts = env_major(batch["obs"]), env_major(batch["actions"])
+            obs = env_major(batch["obs"])
+            nxt = env_major(batch["next_obs"])
+            acts = env_major(batch["actions"])
             rews = env_major(batch["rewards"])
-            # cuts (reset the RSSM) vs terminations (continue target)
-            dones = env_major(batch["dones"] | batch["truncateds"])
-            terms = env_major(batch["dones"])
+            dones = env_major(batch["dones"])
+            truncs = env_major(batch["truncateds"])
+            # Convert to the ARRIVAL stream (see SequenceReplay): each
+            # transition contributes the observation it ARRIVED AT
+            # (``next_obs`` — the true successor, INCLUDING terminal
+            # arrivals the obs column never contains), tagged with the
+            # action/reward that produced it; episode starts are
+            # explicit is_first rows. Streams persist across fragments
+            # per slot (the runner's slots are continuous).
+            zero_a = (np.zeros(self.module_spec.num_actions, np.float32)
+                      if self.module_spec.continuous
+                      else np.int64(0))
             for i in range(N):
-                self._replay.add_fragment({
-                    "obs": obs[i], "actions": acts[i],
-                    "rewards": rews[i],
-                    "dones": dones[i].astype(np.float32),
-                    "terms": terms[i].astype(np.float32),
-                })
+                rows = self._slot_rows[i]
+                for t in range(T):
+                    if self._need_start[i]:
+                        rows["obs"].append(obs[i, t])
+                        rows["a_prev"].append(zero_a)
+                        rows["rewards"].append(0.0)
+                        rows["terms"].append(0.0)
+                        rows["is_first"].append(1.0)
+                        self._need_start[i] = False
+                    rows["obs"].append(nxt[i, t])
+                    rows["a_prev"].append(acts[i, t])
+                    rows["rewards"].append(rews[i, t])
+                    # only TERMINATIONS zero the continue target; a
+                    # time-limit truncation is not an MDP exit
+                    rows["terms"].append(float(dones[i, t]))
+                    rows["is_first"].append(0.0)
+                    if dones[i, t] or truncs[i, t]:
+                        self._need_start[i] = True
+                if len(rows["obs"]) >= max(cfg.seq_len, T):
+                    self._replay.add_fragment({
+                        k: np.stack(v) if k == "obs" or k == "a_prev"
+                        else np.asarray(v, np.float32)
+                        for k, v in rows.items()})
+                    self._slot_rows[i] = {k: [] for k in
+                                          SequenceReplay.KEYS}
         metrics: Dict[str, Any] = {}
         if self._timesteps >= cfg.num_steps_before_learning and \
                 len(self._replay):

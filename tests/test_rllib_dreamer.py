@@ -83,23 +83,35 @@ def test_world_model_learns_predictable_env():
     spec = cfg.module_spec()
     learner = d.DreamerV3Learner(spec, cfg, seed=0)
 
-    # Scripted experience from the counter env.
+    # Scripted experience from the counter env, in the replay's
+    # ARRIVAL-row convention: each row is the observation arrived at,
+    # tagged with the action/reward that produced it; episode starts
+    # are explicit is_first rows and terminal arrivals are real rows.
     env, rng = _CounterEnv(), np.random.default_rng(0)
-    frags = {"obs": [], "actions": [], "rewards": [], "dones": [],
-             "is_first": []}
+    seq = {"obs": [], "a_prev": [], "rewards": [], "terms": [],
+           "is_first": []}
+
+    def add(obs, a_prev, r, term, first):
+        seq["obs"].append(obs)
+        seq["a_prev"].append(a_prev)
+        seq["rewards"].append(r)
+        seq["terms"].append(float(term))
+        seq["is_first"].append(float(first))
+
     obs, _ = env.reset()
-    seq = {k: [] for k in frags}
-    first = True
+    need_start = True
     for _ in range(512):
+        if need_start:
+            add(obs, 0, 0.0, 0.0, 1.0)
+            need_start = False
         a = int(rng.integers(2))
         nxt, r, done, _, _ = env.step(a)
-        seq["obs"].append(obs)
-        seq["actions"].append(a)
-        seq["rewards"].append(r)
-        seq["dones"].append(float(done))
-        seq["is_first"].append(float(first))
-        first = done
-        obs = env.reset()[0] if done else nxt
+        add(nxt, a, r, done, 0.0)
+        if done:
+            obs, _ = env.reset()
+            need_start = True
+        else:
+            obs = nxt
     n = (len(seq["obs"]) // cfg.seq_len) * cfg.seq_len
     batchify = lambda k: np.asarray(  # noqa: E731
         seq[k][:n], np.float32).reshape(-1, cfg.seq_len)
@@ -107,10 +119,10 @@ def test_world_model_learns_predictable_env():
     full = {
         "obs": np.asarray(seq["obs"][:n], np.float32).reshape(
             -1, cfg.seq_len, 3),
-        "actions": batchify("actions"),
+        "a_prev": batchify("a_prev"),
         "rewards": batchify("rewards"),
         # counter env only terminates (never truncates): terms == dones
-        "terms": batchify("dones"),
+        "terms": batchify("terms"),
         "is_first": batchify("is_first"),
     }
 
@@ -165,6 +177,88 @@ def test_imagination_trains_the_actor():
         assert ents[-1] < 0.685, ents  # moved off ln(2) = uniform
         assert ents[-1] < ents[0], ents
         assert rets[-1] > 5.0, rets
+    finally:
+        algo.stop()
+
+
+class _Drive1D:
+    """Continuous control (Pendulum-class, XS-budget): steer a point
+    toward a per-episode target with dense negative-distance reward."""
+
+    class _Box:
+        def __init__(self, shape):
+            self.shape = shape
+            self.low = -np.ones(shape, np.float32)
+            self.high = np.ones(shape, np.float32)
+
+    def __init__(self):
+        self.observation_space = self._Box((2,))
+        self.action_space = self._Box((1,))
+        self._rng = np.random.default_rng(0)
+        self.pos = self.target = 0.0
+        self.t = 0
+
+    def _obs(self):
+        return np.array([self.pos, self.target], np.float32)
+
+    def reset(self, seed=None):
+        self.pos = 0.0
+        self.target = float(self._rng.uniform(-0.8, 0.8))
+        self.t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1, 1))
+        self.pos = float(np.clip(self.pos + 0.3 * a, -1.5, 1.5))
+        self.t += 1
+        rew = -abs(self.pos - self.target)
+        return self._obs(), rew, self.t >= 10, False, {}
+
+    def close(self):
+        pass
+
+
+def test_continuous_control_mechanism():
+    """Continuous-action DreamerV3 end-to-end: the arrival-aligned
+    stream, tanh-gaussian actor with the paper's 2σ(raw/2)+0.1 std
+    parameterization, pathwise gradients, and checkpointing all work —
+    actions stay in bounds and the update is finite.
+
+    An XS-budget LEARNING gate remains deferred (NOTES_r04): on tiny
+    models the actor reliably optimizes IMAGINED returns but a
+     4k-step world model's optimistic errors don't transfer — the
+    documented model-exploitation failure mode that wants the
+    full-size model class."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    cfg = DreamerV3Config().environment(env_creator=_Drive1D)
+    cfg.deter_dim = 32
+    cfg.units = 32
+    cfg.stoch_dims = 4
+    cfg.stoch_classes = 4
+    cfg.horizon = 5
+    cfg.seq_len = 8
+    cfg.batch_seqs = 4
+    cfg.rollout_fragment_length = 32
+    cfg.num_steps_before_learning = 32
+    cfg.updates_per_iteration = 4
+    algo = cfg.build()
+    try:
+        for _ in range(3):
+            m = algo.train()
+        assert m["num_updates"] > 0
+        assert np.isfinite(m["loss"])
+        assert np.isfinite(m["ac/entropy"])
+        # acting path: bounded continuous actions from the module
+        mod = algo.env_runner_group.local.module
+        rng = np.random.default_rng(0)
+        obs = np.zeros((2, 2), np.float32)
+        acts, logp, values = mod.forward_exploration(obs, rng)
+        assert acts.shape == (2, 1)
+        assert np.all(acts >= -1.0) and np.all(acts <= 1.0)
+        assert np.isfinite(logp).all() and np.isfinite(values).all()
+        det = mod.forward_inference(obs)
+        assert np.all(det >= -1.0) and np.all(det <= 1.0)
     finally:
         algo.stop()
 
