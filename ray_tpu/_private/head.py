@@ -263,7 +263,7 @@ class HeadService:
             self._tcp_server = rpc.RpcServer(self._handle, host="0.0.0.0")
             await self._tcp_server.start()
         if restored:
-            self._loop.create_task(self._reconcile_after_restart())
+            rpc.spawn(self._reconcile_after_restart(), self._loop)
         self._reaper_task = self._loop.create_task(self._reap_loop())
         if self.config.memory_monitor_refresh_ms > 0:
             self._memmon_task = self._loop.create_task(
@@ -471,7 +471,8 @@ class HeadService:
                 try:
                     await node.conn.call_simple(
                         "kill_worker",
-                        {"worker_id": w.worker_id.hex(), "force": True})
+                        {"worker_id": w.worker_id.hex(), "force": True},
+                        timeout=10.0)
                 except Exception:  # noqa: BLE001 - daemon reap covers it
                     pass
         await self._on_worker_death(w, cause)
@@ -821,7 +822,8 @@ class HeadService:
                 )
             else:
                 await node.conn.call_simple(
-                    "spawn_worker", {"worker_id": worker_id.hex()})
+                    "spawn_worker", {"worker_id": worker_id.hex()},
+                    timeout=self.config.worker_lease_timeout_s)
             info: WorkerInfo = await asyncio.wait_for(
                 fut, timeout=self.config.worker_lease_timeout_s
             )
@@ -937,8 +939,7 @@ class HeadService:
             if found is not None:
                 node, charge = found
                 self._apply_charge(charge)
-                self._loop.create_task(
-                    self._grant_into(node, charge, fut))
+                rpc.spawn(self._grant_into(node, charge, fut), self._loop)
             else:
                 still.append((req, pg_meta, strategy, fut))
         self._pending_leases = still
@@ -1020,8 +1021,8 @@ class HeadService:
         def _closed():
             if prev_close:
                 prev_close()
-            self._loop.create_task(
-                self._on_node_death(node, "node connection lost"))
+            rpc.spawn(self._on_node_death(node, "node connection lost"),
+                      self._loop)
 
         conn.on_close = _closed
         self.publish("nodes", {"event": "ALIVE", "node_id": node.node_id})
@@ -1482,7 +1483,8 @@ class HeadService:
         if node.conn is None:
             raise rpc.RpcError(f"node {node_hex[:12]} has no daemon "
                                "connection")
-        return await node.conn.call_simple("agent_stats", {})
+        return await node.conn.call_simple("agent_stats", {},
+                                           timeout=15.0)
 
     async def _rpc_get_head_tcp_address(self, payload, bufs):
         return {"address": list(self.tcp_address)}
@@ -1575,7 +1577,8 @@ class HeadService:
                     node = self.nodes.get(info.node)
                     if node is not None and not node.is_head \
                             and node.conn is not None:
-                        return await node.conn.call_simple("tail_log", req)
+                        return await node.conn.call_simple("tail_log", req,
+                                                           timeout=15.0)
                     break
         # Head-local worker (alive or dead — its file is in the head's
         # session dir), else a DEAD remote worker: the head no longer
@@ -1589,7 +1592,8 @@ class HeadService:
                     if node.is_head or node.conn is None:
                         continue
                     try:
-                        return await node.conn.call_simple("tail_log", req)
+                        return await node.conn.call_simple("tail_log", req,
+                                                           timeout=15.0)
                     except Exception:  # noqa: BLE001 - not on this node
                         continue
             raise

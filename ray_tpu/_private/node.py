@@ -134,7 +134,7 @@ class NodeService:
             "agent_url": (
                 f"http://{self._agent_adv_host}:{self._agent.port}"
                 if self._agent else None),
-        })
+        }, timeout=30.0)
         self._adopt_head_config(resp)
         self._reap_task = asyncio.get_running_loop().create_task(
             self._reap_loop())
@@ -219,7 +219,7 @@ class NodeService:
                         f"http://{self._agent_adv_host}:"
                         f"{self._agent.port}"
                         if self._agent else None),
-                })
+                }, timeout=30.0)
                 self._adopt_head_config(resp)
                 self._conn = conn
                 return True

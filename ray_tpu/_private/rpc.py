@@ -28,6 +28,30 @@ KIND_PUSH = 3  # one-way, no response
 
 _req_counter = itertools.count(1)
 
+# Strong references for fire-and-forget tasks. asyncio's event loop keeps
+# only WEAK references to tasks (documented in ``loop.create_task``): a
+# task whose coroutine is suspended with no other referent can be garbage
+# collected mid-execution. For a serve task that means the reply is simply
+# never sent — the peer blocks forever with the connection healthy. This
+# was the root cause of the round-4 cold-suite hang (a ``list_nodes``
+# reply vanished while the head kept running). Every fire-and-forget task
+# in the runtime must go through ``spawn``.
+_background_tasks: set = set()
+
+
+def spawn(coro, loop=None) -> asyncio.Task:
+    """``create_task`` with a strong reference held until the task ends."""
+    task = (loop or asyncio.get_running_loop()).create_task(coro)
+    _background_tasks.add(task)
+    task.add_done_callback(_background_tasks.discard)
+    if len(_background_tasks) > 512:
+        # A loop closed with tasks still pending never runs their done
+        # callbacks — prune those so the strong-ref set can't grow
+        # without bound across cluster create/teardown cycles.
+        for t in [t for t in _background_tasks if t.get_loop().is_closed()]:
+            _background_tasks.discard(t)
+    return task
+
 
 class RpcError(Exception):
     pass
@@ -143,13 +167,9 @@ class Connection:
                 kind, req_id, method, payload = pickle.loads(frames[0])
                 bufs = frames[1:]
                 if kind == KIND_REQUEST:
-                    asyncio.get_running_loop().create_task(
-                        self._serve_one(req_id, method, payload, bufs)
-                    )
+                    spawn(self._serve_one(req_id, method, payload, bufs))
                 elif kind == KIND_PUSH:
-                    asyncio.get_running_loop().create_task(
-                        self._serve_push(method, payload, bufs)
-                    )
+                    spawn(self._serve_push(method, payload, bufs))
                 elif kind == KIND_RESPONSE:
                     fut = self._pending.pop(req_id, None)
                     if fut is not None and not fut.done():
@@ -211,18 +231,30 @@ class Connection:
             raise ConnectionLost("connection closed")
         req_id = next(_req_counter)
         fut = asyncio.get_running_loop().create_future()
+        fut.rt_req_id = req_id  # lets a timed-out call drop its entry O(1)
         self._pending[req_id] = fut
         frames = [pickle.dumps((KIND_REQUEST, req_id, method, payload))] + list(bufs)
         self._enqueue(frames)
         return fut
 
-    async def call(self, method: str, payload: Any = None, bufs: List[bytes] = ()):
+    async def call(self, method: str, payload: Any = None,
+                   bufs: List[bytes] = (), timeout: Optional[float] = None):
         fut = self.send_request(method, payload, bufs)
-        payload, out_bufs = await fut
+        if timeout is not None:
+            try:
+                payload, out_bufs = await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                self._pending.pop(getattr(fut, "rt_req_id", None), None)
+                raise RpcError(
+                    f"rpc '{method}' got no reply within {timeout}s "
+                    f"(connection still open — peer lost the request?)")
+        else:
+            payload, out_bufs = await fut
         return (payload, out_bufs) if out_bufs else (payload, [])
 
-    async def call_simple(self, method: str, payload: Any = None):
-        meta, _ = await self.call(method, payload)
+    async def call_simple(self, method: str, payload: Any = None,
+                          timeout: Optional[float] = None):
+        meta, _ = await self.call(method, payload, timeout=timeout)
         return meta
 
     def push(self, method: str, payload: Any = None, bufs: List[bytes] = ()):

@@ -188,13 +188,19 @@ class Cluster:
         return self._head_rpc("list_nodes")
 
     # ------------------------------------------------------------ plumbing
-    def _head_rpc(self, method: str, payload=None):
-        """One-shot RPC to the head without requiring a connected driver."""
+    def _head_rpc(self, method: str, payload=None, timeout: float = 60.0):
+        """One-shot RPC to the head without requiring a connected driver.
+
+        Every call carries a deadline: a lost reply must surface as a
+        loud error with the method name, never as an indefinite hang
+        (round-4 post-mortem: a vanished ``list_nodes`` reply blocked a
+        test fixture for 55 minutes with the head healthy)."""
 
         async def _go():
             conn = await rpc.connect(self.address)
             try:
-                return await conn.call_simple(method, payload or {})
+                return await conn.call_simple(method, payload or {},
+                                              timeout=timeout)
             finally:
                 await conn.close()
 

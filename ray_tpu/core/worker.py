@@ -691,7 +691,7 @@ class CoreWorker:
         if self._shutdown:
             return
         try:
-            self._loop.create_task(self._reconnect_head())
+            rpc.spawn(self._reconnect_head(), self._loop)
         except RuntimeError:
             pass
 
@@ -841,7 +841,7 @@ class CoreWorker:
         # False schedules a (harmless) extra wake instead of stranding.
         self._submit_wake_scheduled = False
         while self._submit_queue:
-            self._loop.create_task(self._submit_queue.popleft())
+            rpc.spawn(self._submit_queue.popleft(), self._loop)
         # Actor wire batches: one pump per announced actor (a whole
         # burst costs one wake + one pump task, not one per call; no
         # scan over every actor ever used).
@@ -852,7 +852,7 @@ class CoreWorker:
             if key in woken or self._actor_pump_active.get(key):
                 continue
             woken.add(key)
-            self._loop.create_task(self._pump_actor_batches(actor_id))
+            rpc.spawn(self._pump_actor_batches(actor_id), self._loop)
         if not self._task_batch_queue:
             return
         # Coalesce: a submitting thread mid-burst appends faster than the
@@ -872,10 +872,9 @@ class CoreWorker:
         for shape, items in by_shape.items():
             if len(items) == 1:
                 spec, borrowed = items[0]
-                self._loop.create_task(
-                    self._submit_normal(spec, borrowed))
+                rpc.spawn(self._submit_normal(spec, borrowed), self._loop)
             else:
-                self._loop.create_task(self._submit_group(shape, items))
+                rpc.spawn(self._submit_group(shape, items), self._loop)
 
     _BATCH_CHUNK = 64
 
@@ -917,7 +916,7 @@ class CoreWorker:
                 lease["dead"] = True
                 await self._drop_lease(shape, lease, kill=True)
             for spec, borrowed in chunk:
-                self._loop.create_task(self._submit_normal(spec, borrowed))
+                rpc.spawn(self._submit_normal(spec, borrowed), self._loop)
 
     # ------------------------------------------------------------- connections
     async def _get_conn(self, address) -> rpc.Connection:
@@ -1573,7 +1572,7 @@ class CoreWorker:
                 self.refs.release_borrow(oid, owner)
 
         try:
-            self._loop.create_task(_later())
+            rpc.spawn(_later(), self._loop)
         except RuntimeError:  # loop gone (shutdown): leak, don't crash
             pass
 
@@ -1669,7 +1668,7 @@ class CoreWorker:
             fut = self._loop.create_future()
             for roid in spec.return_object_ids():
                 self._recoveries[roid.binary()] = fut
-            self._loop.create_task(self._run_recovery(spec, fut))
+            rpc.spawn(self._run_recovery(spec, fut), self._loop)
         try:
             await asyncio.wait_for(asyncio.shield(fut), timeout)
         except asyncio.TimeoutError:
@@ -1862,8 +1861,7 @@ class CoreWorker:
                 # HERE — create_task runs later, and the gate above must
                 # see it immediately or a 500-task burst floods the head.
                 self._lease_requests_inflight[shape] += 1
-                self._loop.create_task(
-                    self._request_lease_quiet(shape, spec))
+                rpc.spawn(self._request_lease_quiet(shape, spec), self._loop)
                 return best
             if best is not None:
                 return best
@@ -2227,7 +2225,7 @@ class CoreWorker:
                     finally:
                         sem.release()
 
-                loop.create_task(ship())
+                rpc.spawn(ship(), loop)
         finally:
             with self._actor_struct_lock:
                 self._actor_pump_active.pop(key, None)

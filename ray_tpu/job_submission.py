@@ -35,11 +35,13 @@ class JobSubmissionClient:
             address = _find_session()["head_sock"]
         self.address = address
 
-    def _call(self, method: str, payload: dict) -> Any:
+    def _call(self, method: str, payload: dict,
+              timeout: float = 120.0) -> Any:
         async def go():
             conn = await rpc.connect(self.address)
             try:
-                return await conn.call_simple(method, payload)
+                return await conn.call_simple(method, payload,
+                                              timeout=timeout)
             finally:
                 await conn.close()
 

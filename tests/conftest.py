@@ -75,26 +75,65 @@ def _dump_asyncio_tasks():
     f.flush()
 
 
+class TestHungError(Exception):
+    """Raised IN the hung test by the watchdog — a hang becomes a FAILURE
+    with stacks on disk, never a silent multi-hour stall (round-4
+    post-mortem: one lost RPC reply hung the cold suite for 55 min)."""
+
+
+_WATCHDOG_S = float(os.environ.get("RT_TEST_WATCHDOG_S", "300"))
+
+
 @pytest.hookimpl(wrapper=True)
 def pytest_runtest_call(item):
+    import signal
     import threading as _threading
 
     done = _threading.Event()
 
     def watch():
-        if not done.wait(120):
+        if not done.wait(_WATCHDOG_S):
             print(f"=== WATCHDOG: {item.nodeid} hung ===",
                   file=_stack_dump_file)
             faulthandler.dump_traceback(file=_stack_dump_file,
                                         all_threads=True)
             _dump_asyncio_tasks()
+            # Fail the test rather than hang the suite. The signal lands
+            # in the MAIN thread (test body); loops on worker threads
+            # keep running so teardown fixtures can still clean up.
+            # Re-check AFTER the (slow) stack dumps: if the test just
+            # finished, the main thread may already have restored the
+            # default SIGALRM action, which would kill the whole process.
+            import signal as _signal
 
+            if done.is_set():
+                return
+            try:
+                _signal.pthread_kill(_threading.main_thread().ident,
+                                     _signal.SIGALRM)
+            except Exception:
+                pass
+
+    def _raise(signum, frame):
+        raise TestHungError(
+            f"{item.nodeid} exceeded {_WATCHDOG_S}s watchdog; stacks in "
+            f"/tmp/rt_stacks_{os.getpid()}.txt")
+
+    prev = signal.signal(signal.SIGALRM, _raise)
     t = _threading.Thread(target=watch, daemon=True)
     t.start()
     try:
         return (yield)
     finally:
         done.set()
+        # Only restore the handler once the watchdog can no longer fire
+        # (it may be mid-stack-dump right at the deadline: a SIGALRM
+        # delivered after restore would hit SIG_DFL and kill pytest).
+        t.join(timeout=10)
+        try:
+            signal.signal(signal.SIGALRM, prev)
+        except Exception:
+            pass
 
 
 @pytest.fixture
