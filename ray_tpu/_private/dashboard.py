@@ -16,14 +16,30 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 
-# Single-file cluster UI (the reference ships a 22k-line React client;
-# this renders the same core views — summary, nodes, actors, workers,
-# placement groups — from /api/state with zero build tooling).
-_INDEX_HTML = """<!doctype html>
+def _load_index_html() -> str:
+    """The SPA ships as a sibling asset (dashboard_index.html): tabbed
+    cluster/jobs/actors/workers/data/events views over /api/state,
+    /api/node (per-node agent stats), /api/logs (worker log tail),
+    /api/jobs + /api/job_logs, and the timeline export — the reference
+    dashboard's core views (dashboard/client/src, ~22k-line React)
+    rebuilt as one dependency-free page. Falls back to the embedded
+    minimal page if the asset is missing from a stripped install."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dashboard_index.html")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return _FALLBACK_HTML
+
+
+# Minimal fallback UI (the full SPA lives in dashboard_index.html).
+_FALLBACK_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
 <style>
  body{font-family:system-ui,sans-serif;margin:1.5rem;color:#222}
@@ -120,6 +136,7 @@ class DashboardServer:
                  metrics_fn: Callable[[], str],
                  timeline_fn: Callable[[], list],
                  log_fn=None, node_fn=None,
+                 jobs_fn=None, job_logs_fn=None,
                  host: str = "127.0.0.1", port: int = 0):
         self._state_fn = state_fn
         self._metrics_fn = metrics_fn
@@ -131,6 +148,11 @@ class DashboardServer:
         # /api/node — the head proxying every node's agent (reference:
         # dashboard head aggregating per-node agents).
         self._node_fn = node_fn
+        # async () -> [job records] and async (query) -> {"logs": str};
+        # serve /api/jobs + /api/job_logs (reference: dashboard job
+        # module routes).
+        self._jobs_fn = jobs_fn
+        self._job_logs_fn = job_logs_fn
         self._host = host
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -183,6 +205,19 @@ class DashboardServer:
                     await self._respond(writer, 404, "application/json",
                                         json.dumps(
                                             {"error": str(e)}).encode())
+            elif path == "/api/jobs" and self._jobs_fn is not None:
+                data = await self._jobs_fn()
+                await self._respond(writer, 200, "application/json",
+                                    json.dumps(data).encode())
+            elif path == "/api/job_logs" and self._job_logs_fn is not None:
+                try:
+                    data = await self._job_logs_fn(q)
+                    await self._respond(writer, 200, "application/json",
+                                        json.dumps(data).encode())
+                except Exception as e:  # noqa: BLE001 - unknown job
+                    await self._respond(writer, 404, "application/json",
+                                        json.dumps(
+                                            {"error": str(e)}).encode())
             elif path == "/api/logs" and self._log_fn is not None:
                 try:
                     data = await self._log_fn(q)
@@ -194,7 +229,7 @@ class DashboardServer:
                                             {"error": str(e)}).encode())
             elif path == "/":
                 await self._respond(writer, 200, "text/html",
-                                    _INDEX_HTML.encode())
+                                    _load_index_html().encode())
             else:
                 await self._respond(writer, 404, "text/plain",
                                     b"not found")

@@ -275,6 +275,8 @@ class HeadService:
                 self.state_listing, self.metrics_text, self.chrome_trace,
                 log_fn=lambda q: self._rpc_worker_log(q, []),
                 node_fn=lambda q: self._rpc_node_stats(q, []),
+                jobs_fn=lambda: self._rpc_list_jobs({}, []),
+                job_logs_fn=lambda q: self._rpc_job_logs(q, []),
                 port=getattr(self.config, "dashboard_port", 0))
             await self.dashboard.start()
         # Discovery file for the CLI (`python -m ray_tpu status`).
@@ -814,6 +816,7 @@ class HeadService:
                     [sys.executable, "-m", "ray_tpu._private.worker_main",
                      "--session-dir", self.session_dir,
                      "--worker-id", worker_id.hex(),
+                     "--node-id", self.node_id.hex(),
                      "--head-sock", self.sock_path],
                     stdout=log, stderr=subprocess.STDOUT,
                     env={**self._spawn_env,

@@ -103,11 +103,13 @@ class NodeService:
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         # Per-node dashboard agent (reference ``dashboard/agent.py:28``):
         # node-local stats/logs over HTTP, also proxied by the head.
-        # Binds the node's cluster IP — not the wildcard — so only the
-        # cluster network reaches it; RT_AGENT_BIND overrides
-        # (127.0.0.1 for loopback-only, "off" to disable; the head
-        # proxy path still serves stats/logs either way).
-        bind = os.environ.get("RT_AGENT_BIND", self.node_ip)
+        # Default bind is LOOPBACK: the agent serves worker logs and
+        # process stats unauthenticated, and the head-proxy path
+        # (/api/node, node RPC) already gives cluster-wide access — so
+        # nothing on the cluster network gets a direct unauthenticated
+        # door by default. Set RT_AGENT_BIND to the node IP (or a
+        # wildcard) to expose it deliberately; "off" disables.
+        bind = os.environ.get("RT_AGENT_BIND", "127.0.0.1")
         if bind and bind.lower() not in ("off", "disabled", "none"):
             from .node_agent import NodeAgentServer
 
@@ -119,11 +121,16 @@ class NodeService:
                 host=bind)
             await self._agent.start()
             # Advertise the address the agent actually LISTENS on
-            # (wildcard → the routable node IP); a loopback bind must
-            # not publish a cluster-wide URL nobody can reach.
-            self._agent_adv_host = (self.node_ip
-                                    if bind in ("0.0.0.0", "::")
-                                    else bind)
+            # (wildcard → the routable node IP). A loopback bind
+            # advertises NOTHING cluster-wide — a 127.0.0.1 URL would
+            # resolve to the VIEWER's machine; the head-proxy path
+            # (/api/node over the node RPC) serves those consumers.
+            if bind in ("0.0.0.0", "::"):
+                self._agent_adv_host = self.node_ip
+            elif bind.startswith("127.") or bind in ("localhost", "::1"):
+                self._agent_adv_host = None
+            else:
+                self._agent_adv_host = bind
         self._conn = await rpc.connect(self.head_address, self._handle)
         resp = await self._conn.call_simple("register_node", {
             "node_id": self.node_id.hex(),
@@ -133,7 +140,7 @@ class NodeService:
             "labels": self.labels,
             "agent_url": (
                 f"http://{self._agent_adv_host}:{self._agent.port}"
-                if self._agent else None),
+                if self._agent and self._agent_adv_host else None),
         }, timeout=30.0)
         self._adopt_head_config(resp)
         self._reap_task = asyncio.get_running_loop().create_task(
@@ -218,7 +225,8 @@ class NodeService:
                     "agent_url": (
                         f"http://{self._agent_adv_host}:"
                         f"{self._agent.port}"
-                        if self._agent else None),
+                        if self._agent and self._agent_adv_host
+                        else None),
                 }, timeout=30.0)
                 self._adopt_head_config(resp)
                 self._conn = conn

@@ -39,45 +39,20 @@ MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 
 
 def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
-    allowed = {"env_vars", "working_dir", "py_modules", "pip"}
-    unknown = set(runtime_env) - allowed
+    """Per-key validation, dispatched to the plugin registry (built-ins
+    plus anything registered — reference: plugin.py validate hooks)."""
+    from . import runtime_env_plugins as rep
+
+    known = {p.name: p for p in rep.plugins()}
+    unknown = set(runtime_env) - set(known)
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; "
-            f"supported: {sorted(allowed)}")
-    env_vars = runtime_env.get("env_vars") or {}
-    if not all(isinstance(k, str) and isinstance(v, str)
-               for k, v in env_vars.items()):
-        raise ValueError("runtime_env env_vars must be str->str")
-    pip = runtime_env.get("pip")
-    if pip is not None:
-        if isinstance(pip, dict):
-            if set(pip) - {"packages", "wheelhouse"}:
-                raise ValueError(
-                    "runtime_env pip dict accepts only "
-                    "'packages' and 'wheelhouse'")
-            pkgs = pip.get("packages")
-            wh = pip.get("wheelhouse")
-            if pkgs is not None and (
-                    not isinstance(pkgs, (list, tuple))
-                    or not all(isinstance(p, str) for p in pkgs)):
-                raise ValueError(
-                    "runtime_env pip packages must be a LIST of "
-                    "requirement strings (a bare string would be "
-                    "split into characters)")
-            if wh is not None and not isinstance(wh, str):
-                raise ValueError("runtime_env pip wheelhouse must be "
-                                 "a directory path string")
-        elif isinstance(pip, (list, tuple)):
-            if not all(isinstance(p, str) for p in pip):
-                raise ValueError(
-                    "runtime_env pip must be a list of requirement "
-                    "strings")
-        else:
-            raise ValueError(
-                "runtime_env pip must be a list of requirements or "
-                "{'packages': [...], 'wheelhouse': <dir>}")
-    return runtime_env
+            f"supported: {sorted(known)}")
+    out = dict(runtime_env)
+    for key, value in out.items():
+        out[key] = known[key].validate(value)
+    return out
 
 
 def zip_directory(path: str) -> bytes:
@@ -119,81 +94,32 @@ def env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
 
 
 def prepare(runtime_env: Dict[str, Any], kv_put) -> Dict[str, Any]:
-    """Driver side: validate, upload packages, return the wire form."""
+    """Driver side: validate, upload packages, return the wire form.
+    Each key's work is its plugin's ``prepare`` (built-ins keep their
+    legacy flat wire keys; third-party plugins nest under
+    ``plugin:<name>``)."""
+    from . import runtime_env_plugins as rep
+
     runtime_env = validate(dict(runtime_env))
+    ctx = rep.PrepareContext(kv_put=kv_put)
     out: Dict[str, Any] = {}
-    if runtime_env.get("env_vars"):
-        out["env_vars"] = dict(runtime_env["env_vars"])
-    if runtime_env.get("working_dir"):
-        blob = zip_directory(runtime_env["working_dir"])
-        key = package_key(blob, "working_dir")
-        kv_put(key, blob)
-        out["working_dir_key"] = key
-    mods = []
-    for mod_path in runtime_env.get("py_modules") or []:
-        blob = zip_directory(mod_path)
-        key = package_key(blob, "py_module")
-        kv_put(key, blob)
-        mods.append((os.path.basename(mod_path.rstrip("/")), key))
-    if mods:
-        out["py_module_keys"] = mods
-    pip = runtime_env.get("pip")
-    if pip:
-        if isinstance(pip, dict):
-            wh = pip.get("wheelhouse")
-            out["pip"] = {
-                "packages": list(pip.get("packages") or []),
-                "wheelhouse": os.path.abspath(wh) if wh else None,
-            }
-        else:
-            out["pip"] = {"packages": list(pip), "wheelhouse": None}
+    for plugin in rep.plugins():
+        value = runtime_env.get(plugin.name)
+        if value:
+            plugin._prepare_into(value, out, ctx)
     return out
 
 
 def apply(wire_env: Dict[str, Any], kv_get, scratch_dir: str) -> None:
     """Worker side: materialize the env in THIS process (the worker is
-    dedicated to this env via the lease shape key)."""
-    pip = wire_env.get("pip")
-    if pip:
-        if isinstance(pip, dict):
-            packages = pip.get("packages") or []
-            wheelhouse = pip.get("wheelhouse") or \
-                os.environ.get("RT_PIP_WHEELHOUSE")
-        else:  # legacy wire form: bare list
-            packages, wheelhouse = list(pip), \
-                os.environ.get("RT_PIP_WHEELHOUSE")
-        if wheelhouse and packages:
-            env_dir = ensure_pip_env(packages, wheelhouse)
-            if env_dir not in sys.path:
-                sys.path.insert(0, env_dir)
-            importlib.invalidate_caches()
-        else:
-            for name in packages:
-                base = name.split("==")[0].split(">=")[0].split("[")[0]
-                base = base.replace("-", "_")
-                if importlib.util.find_spec(base) is None:
-                    raise RuntimeError(
-                        f"runtime_env pip package {name!r} is not "
-                        "available and this deployment is zero-egress; "
-                        "bake it into the image or provide a "
-                        "'wheelhouse' (RT_PIP_WHEELHOUSE)")
-    for k, v in (wire_env.get("env_vars") or {}).items():
-        os.environ[k] = v
-    wd_key = wire_env.get("working_dir_key")
-    if wd_key:
-        target = _extract(wd_key, kv_get, scratch_dir)
-        os.chdir(target)
-        if target not in sys.path:
-            sys.path.insert(0, target)
-    for mod_name, key in wire_env.get("py_module_keys") or []:
-        target = _extract(key, kv_get, scratch_dir)
-        # a py_module zip IS the module dir: expose its parent
-        parent = os.path.dirname(target)
-        link = os.path.join(parent, mod_name)
-        if not os.path.exists(link):
-            os.symlink(target, link)
-        if parent not in sys.path:
-            sys.path.insert(0, parent)
+    dedicated to this env via the lease shape key). Plugins apply in
+    priority order — interpreter-level (conda, pip) before path-level
+    (working_dir, py_modules), so user code shadows packed packages."""
+    from . import runtime_env_plugins as rep
+
+    ctx = rep.ApplyContext(kv_get=kv_get, scratch_dir=scratch_dir)
+    for plugin in rep.plugins():
+        plugin._apply_from(wire_env, ctx)
 
 
 def _pip_cache_root() -> str:
@@ -212,8 +138,18 @@ def ensure_pip_env(packages, wheelhouse: str) -> str:
 
     root = _pip_cache_root()
     os.makedirs(root, exist_ok=True)
+    # The cache key covers the wheelhouse CONTENTS (filename+size+mtime),
+    # not just its path: with unpinned requirements, dropping a newer
+    # wheel into the same wheelhouse must invalidate the cached env
+    # instead of silently serving the stale install forever.
+    try:
+        wheels = sorted(
+            (e.name, e.stat().st_size, int(e.stat().st_mtime))
+            for e in os.scandir(wheelhouse) if e.is_file())
+    except OSError:
+        wheels = []
     h = hashlib.sha256(json.dumps(
-        [sorted(packages), os.path.abspath(wheelhouse)]).encode()
+        [sorted(packages), os.path.abspath(wheelhouse), wheels]).encode()
     ).hexdigest()[:16]
     env_dir = os.path.join(root, h)
     marker = env_dir + ".ok"
@@ -318,3 +254,269 @@ def _extract(key: str, kv_get, scratch_dir: str) -> str:
             zf.extractall(target)
         open(marker, "w").close()
     return target
+
+
+# --------------------------------------------------------------- conda
+def _conda_cache_root() -> str:
+    return os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu",
+                        "conda_envs")
+
+
+def ensure_extracted_env(tarball: str) -> str:
+    """Extract a conda-pack-style tarball into a per-hash cached dir
+    (reference: ``conda.py``'s env-per-hash, re-designed egress-free for
+    packed envs). Same staged+atomic+flock+LRU-marker discipline as
+    :func:`ensure_pip_env`."""
+    import fcntl
+    import shutil
+    import tarfile
+
+    tarball = os.path.abspath(tarball)
+    st = os.stat(tarball)
+    root = _conda_cache_root()
+    os.makedirs(root, exist_ok=True)
+    h = hashlib.sha256(json.dumps(
+        [tarball, st.st_size, int(st.st_mtime)]).encode()).hexdigest()[:16]
+    env_dir = os.path.join(root, h)
+    marker = env_dir + ".ok"
+    with open(os.path.join(root, h + ".lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                os.utime(marker)  # LRU touch
+                return env_dir
+            stage = env_dir + ".staging"
+            shutil.rmtree(stage, ignore_errors=True)
+            os.makedirs(stage)
+            with tarfile.open(tarball) as tf:
+                # "data" filter: refuse absolute paths / traversal /
+                # device nodes from untrusted archives
+                tf.extractall(stage, filter="data")
+            shutil.rmtree(env_dir, ignore_errors=True)
+            os.replace(stage, env_dir)
+            open(marker, "w").close()
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    return env_dir
+
+
+def _activate_env_prefix(prefix: str) -> None:
+    """Put an env prefix's site-packages on sys.path and its bin on
+    PATH — the packed-env equivalent of conda activate."""
+    import glob as _glob
+
+    sites = _glob.glob(os.path.join(prefix, "lib", "python*",
+                                    "site-packages"))
+    for site in sites:
+        if site not in sys.path:
+            sys.path.insert(0, site)
+    bin_dir = os.path.join(prefix, "bin")
+    if os.path.isdir(bin_dir):
+        parts = os.environ.get("PATH", "").split(os.pathsep)
+        if bin_dir not in parts:
+            os.environ["PATH"] = bin_dir + os.pathsep + \
+                os.environ.get("PATH", "")
+    importlib.invalidate_caches()
+
+
+# ------------------------------------------------- built-in plugins
+from . import runtime_env_plugins as _rep  # noqa: E402
+
+
+class _EnvVarsPlugin(_rep.RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 8
+
+    def validate(self, value):
+        if not isinstance(value, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in value.items()):
+            raise ValueError("runtime_env env_vars must be str->str")
+        return value
+
+    def _prepare_into(self, value, out, ctx):
+        out["env_vars"] = dict(value)
+
+    def _apply_from(self, wire, ctx):
+        for k, v in (wire.get("env_vars") or {}).items():
+            os.environ[k] = v
+
+
+class _WorkingDirPlugin(_rep.RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 10
+
+    def _prepare_into(self, value, out, ctx):
+        blob = zip_directory(value)
+        key = package_key(blob, "working_dir")
+        ctx.kv_put(key, blob)
+        out["working_dir_key"] = key
+
+    def _apply_from(self, wire, ctx):
+        wd_key = wire.get("working_dir_key")
+        if not wd_key:
+            return
+        target = _extract(wd_key, ctx.kv_get, ctx.scratch_dir)
+        os.chdir(target)
+        if target not in sys.path:
+            sys.path.insert(0, target)
+
+    def uris(self, wire):
+        return [wire["working_dir_key"]] if wire.get(
+            "working_dir_key") else []
+
+
+class _PyModulesPlugin(_rep.RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 11
+
+    def validate(self, value):
+        if not isinstance(value, (list, tuple)) or not all(
+                isinstance(p, str) for p in value):
+            raise ValueError(
+                "runtime_env py_modules must be a list of paths")
+        return list(value)
+
+    def _prepare_into(self, value, out, ctx):
+        mods = []
+        for mod_path in value:
+            blob = zip_directory(mod_path)
+            key = package_key(blob, "py_module")
+            ctx.kv_put(key, blob)
+            mods.append((os.path.basename(mod_path.rstrip("/")), key))
+        if mods:
+            out["py_module_keys"] = mods
+
+    def _apply_from(self, wire, ctx):
+        for mod_name, key in wire.get("py_module_keys") or []:
+            target = _extract(key, ctx.kv_get, ctx.scratch_dir)
+            # a py_module zip IS the module dir: expose its parent
+            parent = os.path.dirname(target)
+            link = os.path.join(parent, mod_name)
+            if not os.path.exists(link):
+                os.symlink(target, link)
+            if parent not in sys.path:
+                sys.path.insert(0, parent)
+
+    def uris(self, wire):
+        return [k for _, k in wire.get("py_module_keys") or []]
+
+
+class _PipPlugin(_rep.RuntimeEnvPlugin):
+    name = "pip"
+    priority = 6
+
+    def validate(self, value):
+        if isinstance(value, dict):
+            if set(value) - {"packages", "wheelhouse"}:
+                raise ValueError(
+                    "runtime_env pip dict accepts only "
+                    "'packages' and 'wheelhouse'")
+            pkgs = value.get("packages")
+            wh = value.get("wheelhouse")
+            if pkgs is not None and (
+                    not isinstance(pkgs, (list, tuple))
+                    or not all(isinstance(p, str) for p in pkgs)):
+                raise ValueError(
+                    "runtime_env pip packages must be a LIST of "
+                    "requirement strings (a bare string would be "
+                    "split into characters)")
+            if wh is not None and not isinstance(wh, str):
+                raise ValueError("runtime_env pip wheelhouse must be "
+                                 "a directory path string")
+        elif isinstance(value, (list, tuple)):
+            if not all(isinstance(p, str) for p in value):
+                raise ValueError(
+                    "runtime_env pip must be a list of requirement "
+                    "strings")
+        else:
+            raise ValueError(
+                "runtime_env pip must be a list of requirements or "
+                "{'packages': [...], 'wheelhouse': <dir>}")
+        return value
+
+    def _prepare_into(self, value, out, ctx):
+        if isinstance(value, dict):
+            wh = value.get("wheelhouse")
+            out["pip"] = {
+                "packages": list(value.get("packages") or []),
+                "wheelhouse": os.path.abspath(wh) if wh else None,
+            }
+        else:
+            out["pip"] = {"packages": list(value), "wheelhouse": None}
+
+    def _apply_from(self, wire, ctx):
+        pip = wire.get("pip")
+        if not pip:
+            return
+        if isinstance(pip, dict):
+            packages = pip.get("packages") or []
+            wheelhouse = pip.get("wheelhouse") or \
+                os.environ.get("RT_PIP_WHEELHOUSE")
+        else:  # legacy wire form: bare list
+            packages, wheelhouse = list(pip), \
+                os.environ.get("RT_PIP_WHEELHOUSE")
+        if wheelhouse and packages:
+            env_dir = ensure_pip_env(packages, wheelhouse)
+            if env_dir not in sys.path:
+                sys.path.insert(0, env_dir)
+            importlib.invalidate_caches()
+        else:
+            for name in packages:
+                base = name.split("==")[0].split(">=")[0].split("[")[0]
+                base = base.replace("-", "_")
+                if importlib.util.find_spec(base) is None:
+                    raise RuntimeError(
+                        f"runtime_env pip package {name!r} is not "
+                        "available and this deployment is zero-egress; "
+                        "bake it into the image or provide a "
+                        "'wheelhouse' (RT_PIP_WHEELHOUSE)")
+
+
+class _CondaPlugin(_rep.RuntimeEnvPlugin):
+    """Packed-env conda (reference: ``runtime_env/conda.py``,
+    re-designed egress-free): ``{"packed": <conda-pack tarball>}``
+    extracts into a per-hash cache, ``{"prefix": <env dir>}`` uses an
+    existing env in place. Interpreter-level, so it applies before the
+    path-level plugins."""
+
+    name = "conda"
+    priority = 5
+
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError(
+                "runtime_env conda must be {'packed': <tarball>} or "
+                "{'prefix': <env dir>}")
+        keys = set(value)
+        if keys - {"packed", "prefix"} or len(keys) != 1:
+            raise ValueError(
+                "runtime_env conda takes exactly one of 'packed' or "
+                "'prefix'")
+        (v,) = value.values()
+        if not isinstance(v, str):
+            raise ValueError("runtime_env conda paths must be strings")
+        return value
+
+    def _prepare_into(self, value, out, ctx):
+        out["conda"] = {k: os.path.abspath(v) for k, v in value.items()}
+
+    def _apply_from(self, wire, ctx):
+        conda = wire.get("conda")
+        if not conda:
+            return
+        if conda.get("packed"):
+            prefix = ensure_extracted_env(conda["packed"])
+        else:
+            prefix = conda["prefix"]
+            if not os.path.isdir(prefix):
+                raise RuntimeError(
+                    f"runtime_env conda prefix {prefix!r} does not "
+                    "exist on this node")
+        _activate_env_prefix(prefix)
+
+
+for _p in (_CondaPlugin(), _PipPlugin(), _EnvVarsPlugin(),
+           _WorkingDirPlugin(), _PyModulesPlugin()):
+    _rep.register_plugin(_p, allow_override=True)
+del _p

@@ -381,7 +381,8 @@ class ActorMethod:
         return ActorMethod(
             self._handle, self._name,
             self._num_returns if num_returns is None else num_returns,
-            concurrency_group or self._concurrency_group)
+            self._concurrency_group if concurrency_group is None
+            else concurrency_group)
 
     def bind(self, *upstreams):
         """Build a compiled-DAG node (see :mod:`ray_tpu.dag`);

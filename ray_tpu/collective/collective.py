@@ -197,9 +197,31 @@ class StoreGroup(BaseGroup):
     Lifecycle: a group name is single-incarnation — call
     :func:`destroy_collective_group` (which deletes the group's KV prefix)
     before re-creating a same-named group, exactly as the reference requires
-    unique named groups (``collective.py:151``). Old generation slots are
-    GC'd two generations behind, so KV usage is bounded.
+    unique named groups (``collective.py:151``). Old generation slots and
+    published objects are GC'd ``GC_LAG`` generations behind (skew bounded
+    by the ``SYNC_EVERY`` rendezvous), so KV/store usage is bounded.
+
+    The group instance must OUTLIVE in-flight consumption (NCCL
+    communicator semantics): a publisher's store objects stay alive via
+    refs the group holds, so dropping the instance right after an op can
+    free a payload a slow peer has not pulled yet. Create groups through
+    :func:`init_collective_group` — the process-global registry then owns
+    the instance until :func:`destroy_collective_group`.
+
+    Results fetched through the object store are zero-copy READ-ONLY shm
+    views; copy before mutating in place.
     """
+
+    #: inline-in-KV threshold; larger payloads ride the object store's
+    #: chunked multi-source transfer path (direct-to-shm pulls)
+    INLINE_MAX = 4096
+    #: full rendezvous every N generations — bounds cross-rank skew so
+    #: deferred GC (below) can run without per-op acks
+    SYNC_EVERY = 8
+    #: publications are retained this many generations; with SYNC_EVERY
+    #: bounding skew to < SYNC_EVERY gens, every rank has consumed a
+    #: gen-(GC_LAG) slot long before its owner deletes it
+    GC_LAG = 16
 
     def __init__(self, name: str, world_size: int, rank: int):
         super().__init__(name, world_size, rank)
@@ -208,6 +230,11 @@ class StoreGroup(BaseGroup):
         self._core = CoreWorker.current()
         self._gen = 0
         self._p2p_seq: Dict[tuple, int] = {}
+        self._own_slots: Dict[int, list] = {}   # gen -> [kv keys]
+        self._held: Dict[int, list] = {}        # gen -> [ObjectRefs]
+        # telemetry for scaling tests: kv bytes / store transfer counts
+        self.stats = {"kv_bytes_out": 0, "kv_bytes_in": 0,
+                      "store_puts": 0, "store_gets": 0}
 
     # -- KV helpers -------------------------------------------------------
     def _kv_put(self, key: str, value: bytes):
@@ -225,45 +252,125 @@ class StoreGroup(BaseGroup):
     def _slot(self, gen: int, what: str, rank: int, tag: int = 0) -> str:
         return (f"__coll__/{self.name}/{gen}/{what}/{tag}/{rank}")
 
-    def _gc(self, gen: int):
-        # Every op routes through _gather_to_all, so starting gen g means
-        # this rank finished gen g-1, which required ALL ranks to have
-        # written gen g-1 — hence all ranks read every gen g-2 slot.
-        # Safe to delete our own g-2 slot.
-        if gen >= 2:
-            try:
-                self._core.kv_del(self._slot(gen - 2, "ag", self.rank),
-                                  ns="collective")
-            except Exception:
-                pass
-
-    # -- collectives ------------------------------------------------------
-    def _gather_to_all(self, x) -> List[Any]:
+    # -- generation / GC --------------------------------------------------
+    def _next_gen(self) -> int:
+        """Claim the next generation; every SYNC_EVERY gens all ranks
+        rendezvous (tiny symmetric token gather), which bounds skew to
+        < SYNC_EVERY generations and lets deferred GC delete old
+        publications WITHOUT per-op acks."""
         gen = self._gen
         self._gen += 1
-        self._gc(gen)
-        self._kv_put(self._slot(gen, "ag", self.rank), _encode(x))
-        vals = []
-        for r in range(self.world_size):
-            vals.append(_decode(self._kv_get(self._slot(gen, "ag", r))))
-        return vals
+        if gen and gen % self.SYNC_EVERY == 0:
+            key = self._slot(gen, "sy", self.rank)
+            self._kv_put(key, b"1")
+            self._own_slots.setdefault(gen, []).append(key)
+            for r in range(self.world_size):
+                self._kv_get(self._slot(gen, "sy", r))
+            self._gc(gen)
+        return gen
 
+    def _gc(self, gen: int):
+        """Delete THIS rank's publications older than GC_LAG gens. The
+        rendezvous in _next_gen guarantees every rank is past
+        gen - SYNC_EVERY, so gen - GC_LAG slots were consumed long ago.
+        Dropping the held ObjectRefs lets the owner free the store
+        entries (receivers' borrows are already paid back)."""
+        horizon = gen - self.GC_LAG
+        for g in [g for g in self._own_slots if g <= horizon]:
+            for key in self._own_slots.pop(g):
+                try:
+                    self._core.kv_del(key, ns="collective")
+                except Exception:  # noqa: BLE001 - hygiene only
+                    pass
+            self._held.pop(g, None)
+
+    # -- payload transport ------------------------------------------------
+    def _publish(self, gen: int, what: str, x, tag: int = 0):
+        """Publish this rank's payload for (gen, what): tiny values ride
+        the KV inline; big ones go into the OBJECT STORE once and only
+        the (object_id, owner) pair crosses the KV — receivers then pull
+        via the chunked multi-source transfer path (direct-to-shm, the
+        same machinery as the 1 GiB broadcast bench)."""
+        import pickle
+
+        raw = _encode(x)
+        if len(raw) <= self.INLINE_MAX:
+            payload = pickle.dumps(("inline", raw))
+        else:
+            ref = self._core.put(x)
+            self._held.setdefault(gen, []).append(ref)
+            self.stats["store_puts"] += 1
+            payload = pickle.dumps(
+                ("ref", ref.object_id.binary(), ref.owner_address))
+        key = self._slot(gen, what, self.rank, tag)
+        self._kv_put(key, payload)
+        self.stats["kv_bytes_out"] += len(payload)
+        self._own_slots.setdefault(gen, []).append(key)
+
+    def _fetch(self, gen: int, what: str, rank: int, tag: int = 0,
+               timeout: float = 120.0):
+        import pickle
+
+        blob = self._kv_get(self._slot(gen, what, rank, tag), timeout)
+        if isinstance(blob, str):
+            blob = blob.encode("latin1")
+        self.stats["kv_bytes_in"] += len(blob)
+        rec = pickle.loads(blob)
+        if rec[0] == "inline":
+            return _decode(rec[1])
+        _, oid_bytes, owner = rec
+        from ray_tpu.core.worker import ObjectRef
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(oid_bytes)
+        # The deserialize-hook protocol by hand for REMOTE-owned refs:
+        # acquire the borrow BEFORE materializing the counted ref, whose
+        # death pays it back. The publisher's held ref keeps the object
+        # alive until GC_LAG generations later, by which time every
+        # borrow landed. Own objects skip the borrow — owner-side ref
+        # deaths never send a paying dec, so charging one would pin the
+        # object forever.
+        if owner != self._core.address:
+            self._core.refs.acquire_borrow(oid, owner)
+        ref = ObjectRef(oid, owner)
+        self.stats["store_gets"] += 1
+        return self._core.get(ref)
+
+    # -- collectives ------------------------------------------------------
     def allreduce(self, x, op="sum"):
+        """Binomial-tree reduce to rank 0, then object-store broadcast
+        down (reference surface: ``collective.py:258``; the O(world²)
+        KV gather this replaces was r4's scaling bottleneck). Per-rank
+        traffic: ≤ log2(W)+1 payload transfers instead of W."""
         import numpy as np
 
-        vals = [np.asarray(v) for v in self._gather_to_all(x)]
-        if op == "sum":
-            return sum(vals[1:], vals[0].copy())
-        if op == "max":
-            return np.maximum.reduce(vals)
-        if op == "min":
-            return np.minimum.reduce(vals)
-        raise ValueError(op)
+        gen = self._next_gen()
+        part = np.asarray(x)
+        mask = 1
+        while mask < self.world_size:
+            if self.rank & mask:
+                # Lowest set bit reached: hand the partial to the peer
+                # with that bit clear, then await the result broadcast.
+                self._publish(gen, "rd", part)
+                break
+            peer = self.rank | mask
+            if peer < self.world_size:
+                part = _combine(part, np.asarray(self._fetch(gen, "rd",
+                                                             peer)), op)
+            mask <<= 1
+        if self.rank == 0:
+            self._publish(gen, "bc", part)
+            return part
+        return np.asarray(self._fetch(gen, "bc", 0))
 
     def allgather(self, x):
         import numpy as np
 
-        return np.concatenate([np.asarray(v) for v in self._gather_to_all(x)])
+        gen = self._next_gen()
+        self._publish(gen, "ag", x)
+        return np.concatenate([
+            np.asarray(self._fetch(gen, "ag", r))
+            for r in range(self.world_size)])
 
     def reducescatter(self, x, op="sum"):
         import numpy as np
@@ -272,15 +379,21 @@ class StoreGroup(BaseGroup):
         return np.split(full, self.world_size)[self.rank]
 
     def broadcast(self, x, src_rank=0):
-        # Symmetric gather (everyone publishes, src's value wins) so the
-        # _gc generation invariant holds for broadcast too — an
-        # asymmetric fast path would let the src delete slots receivers
-        # haven't read yet.
-        vals = self._gather_to_all(x if self.rank == src_rank else None)
-        return vals[src_rank]
+        """src puts the payload ONCE; every receiver pulls the object
+        through the store's multi-source chunked path — per-rank KV
+        traffic is one tiny ref record, not the payload."""
+        import numpy as np
+
+        gen = self._next_gen()
+        if self.rank == src_rank:
+            self._publish(gen, "bc", x)
+            return x
+        return self._fetch(gen, "bc", src_rank)
 
     def barrier(self):
-        self._gather_to_all(0)
+        # Rides the reduce tree with a scalar token: O(log W) tiny
+        # messages per rank instead of the old all-to-all gather.
+        self.allreduce(0.0)
 
     def _p2p_key(self, src: int, dst: int, tag: int, seq: int) -> str:
         return f"__coll__/{self.name}/p2p/{src}>{dst}/{tag}/{seq}"
@@ -305,8 +418,12 @@ class StoreGroup(BaseGroup):
                                       ns="collective"):
             try:
                 self._core.kv_del(key, ns="collective")
-            except Exception:
+            except Exception:  # noqa: BLE001
                 pass
+        # Unpin published payloads: dropping the held refs lets the
+        # owner free the store entries once peers' borrows are paid.
+        self._own_slots.clear()
+        self._held.clear()
 
 
 def _encode(x) -> bytes:
@@ -325,6 +442,18 @@ def _decode(b) -> Any:
     if isinstance(b, str):
         b = b.encode("latin1")
     return pickle.loads(b)
+
+
+def _combine(a, b, op: str):
+    import numpy as np
+
+    if op == "sum":
+        return a + b
+    if op == "max":
+        return np.maximum(a, b)
+    if op == "min":
+        return np.minimum(a, b)
+    raise ValueError(op)
 
 
 # ---------------------------------------------------------------- module API

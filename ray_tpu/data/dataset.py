@@ -443,11 +443,16 @@ def _repartition(it: Iterator[B.Block], n: int) -> Iterator[B.Block]:
         for p in range(P):
             lo = max(cuts[p] - base, 0)
             hi = min(cuts[p + 1] - base, ln)
-            out.append(B.slice_block(blk, lo, hi) if lo < hi else [])
+            # Empty partitions keep the INPUT block's type (a zero-row
+            # slice), so a stream never mixes dict and list blocks when
+            # n exceeds the row count.
+            out.append(B.slice_block(blk, lo, hi) if lo < hi
+                       else B.slice_block(blk, 0, 0))
         return out
 
     def reduce(parts, pidx):
-        return B.concat_blocks([p for p in parts if B.block_len(p)])
+        live = [p for p in parts if B.block_len(p)]
+        return B.concat_blocks(live) if live else parts[0]
 
     yield from _resolve(refs_exchange(in_refs, split, reduce,
                                       num_partitions=n))

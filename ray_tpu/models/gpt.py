@@ -294,6 +294,29 @@ def _block_pp_tp(x, p, cfg: GPTConfig, tp_axis: str, tp_size: int):
     return x + y
 
 
+def _block_pp_sp(x, p, cfg: GPTConfig, sp_axis: str, sp_size: int):
+    """Transformer block for a pipeline stage with sequence parallelism:
+    activations are [B, S/sp, d] per device and attention is a ring
+    collective over ``sp_axis``. Runs per-device inside pipeline_apply's
+    shard_map (GSPMD does not reach under it), so the ring ppermutes are
+    written by hand exactly like the sp-only path's
+    ``ops/ring_attention``."""
+    from ray_tpu.ops import ring_attention as ra
+
+    B, S_loc, d = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    h = _rmsnorm(x, p["ln1_scale"])
+    q = _mm(h, p["wq"]["kernel"], cfg.dtype).reshape(B, S_loc, H, hd)
+    k = _mm(h, p["wk"]["kernel"], cfg.dtype).reshape(B, S_loc, H, hd)
+    v = _mm(h, p["wv"]["kernel"], cfg.dtype).reshape(B, S_loc, H, hd)
+    att = ra.ring_attention(q, k, v, axis_name=sp_axis, causal=True,
+                            axis_size=sp_size).reshape(B, S_loc, d)
+    x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+    h = _rmsnorm(x, p["ln2_scale"])
+    h = jax.nn.gelu(_mm(h, p["w1"]["kernel"], cfg.dtype))
+    return x + _mm(h, p["w2"]["kernel"], cfg.dtype)
+
+
 def _pp_tp_param_specs(block_params, pp_axis: str, tp_axis: str):
     """PartitionSpecs for a pipeline stage's stacked params under pp x
     tp: layer dim over pp; column weights (wq/wk/wv/w1) shard their
@@ -356,6 +379,11 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
         from ray_tpu.parallel.pipeline import pipeline_apply
 
         tp_ax = "tp" if "tp" in mesh.axis_names else None
+        sp_ax = cfg.sp_axis if (cfg.sp_axis
+                                and cfg.sp_axis in mesh.axis_names) else None
+        if tp_ax is not None and sp_ax is not None:
+            raise NotImplementedError(
+                "pp x tp x sp on one mesh is not supported; pick two")
         if tp_ax is not None:
             tp_size = mesh.shape[tp_ax]
             if cfg.n_head % tp_size or cfg.d_ff % tp_size:
@@ -368,6 +396,16 @@ def forward(params: Params, tokens: jax.Array, cfg: GPTConfig,
                 num_microbatches=cfg.num_microbatches, tp_axis=tp_ax,
                 param_specs=_pp_tp_param_specs(params["block"],
                                                cfg.pp_axis, tp_ax))
+        elif sp_ax is not None:
+            sp_size = mesh.shape[sp_ax]
+            if tokens.shape[1] % sp_size:
+                raise ValueError(
+                    f"seq {tokens.shape[1]} not divisible by "
+                    f"sp={sp_size}")
+            x = pipeline_apply(
+                lambda act, lp: _block_pp_sp(act, lp, cfg, sp_ax, sp_size),
+                params["block"], x, mesh=mesh, pp_axis=cfg.pp_axis,
+                num_microbatches=cfg.num_microbatches, sp_axis=sp_ax)
         else:
             # Inside the pipeline body each stage runs single-device math
             # (mesh=None): GSPMD does not reach under the shard_map.

@@ -39,8 +39,10 @@ def dcn_allreduce_tree(tree: Any, group) -> Any:
     leaves, treedef = jax.tree.flatten(tree)
     flat = np.concatenate([np.asarray(l, np.float32).ravel()
                            for l in leaves]) if leaves else np.zeros(0)
-    summed = np.asarray(group.allreduce(flat, "sum"), np.float32)
-    summed /= group.world_size
+    # The tree allreduce may hand back a zero-copy READ-ONLY shm view
+    # (object-store fast path) — divide out-of-place.
+    summed = np.asarray(group.allreduce(flat, "sum"),
+                        np.float32) / group.world_size
     out, off = [], 0
     for l in leaves:
         n = int(np.prod(np.shape(l))) or 1

@@ -16,9 +16,12 @@ Schedule (GPipe):
     schedule.
 
 Composition: ``pp`` composes with ``dp``/``fsdp`` batch axes (batch is
-sharded outside, every pp stage sees its dp shard). ``tp``/``sp`` inside a
-pipeline stage would need manual per-matmul collectives in the block body
-and are rejected for now.
+sharded outside, every pp stage sees its dp shard), with ``tp`` inside a
+stage (Megatron-style hand collectives in the block body), and with
+``sp`` (activations sequence-sharded inside each stage; the block body
+runs ring attention over the sp sub-axis — every (pp, sp) device passes
+its local sequence chunk to the same-sp-coordinate device of the next
+stage, so the ppermute rides neighboring ICI links unchanged).
 """
 from __future__ import annotations
 
@@ -39,6 +42,7 @@ def _stage_spec(leaf, pp_axis: str):
 def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
                    *, mesh, pp_axis: str = "pp",
                    num_microbatches: int = 0, tp_axis: str = None,
+                   sp_axis: str = None,
                    param_specs: Any = None) -> jax.Array:
     """Run ``x`` through L stacked layers pipelined over the pp axis.
 
@@ -47,6 +51,11 @@ def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
     L % pp == 0 (stage s owns layers [s*L/P, (s+1)*L/P)).
     ``x`` is [B, S, d] with the batch dim (optionally) sharded over
     dp/fsdp; it must NOT be sharded over pp.
+
+    Sequence parallelism inside a stage (pp x sp): pass ``sp_axis`` and
+    a ``block_fn`` whose attention is a ring/Ulysses collective over
+    ``sp_axis`` (see gpt's ``_block_pp_sp``) — activations arrive
+    [mb, S/sp, d] per device and stay sequence-sharded end to end.
 
     Tensor parallelism inside a stage (pp x tp): pass ``tp_axis`` plus
     ``param_specs`` (a pytree of PartitionSpecs sharding each leaf over
@@ -61,10 +70,11 @@ def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
     names = set(mesh.axis_names)
     if pp_axis not in names:
         raise ValueError(f"mesh has no {pp_axis!r} axis: {mesh.axis_names}")
-    if "sp" in names:
+    if "sp" in names and sp_axis is None:
         raise ValueError(
-            "pipeline_apply does not compose with 'sp' yet; use a "
-            "{dp, fsdp, tp, pp} mesh")
+            "mesh has an sp axis: pass sp_axis= with an sp-aware "
+            "block_fn (ring attention over sp — see gpt's pp x sp "
+            "branch)")
     if "tp" in names and tp_axis is None:
         raise ValueError(
             "mesh has a tp axis: pass tp_axis= and param_specs= with a "
@@ -73,7 +83,7 @@ def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
     num_mb = num_microbatches or 2 * pp_size
 
     bt = tuple(a for a in ("dp", "fsdp") if a in names) or None
-    x_spec = P(bt, None, None)
+    x_spec = P(bt, sp_axis, None)
     if param_specs is None:
         param_specs = jax.tree.map(lambda l: _stage_spec(l, pp_axis),
                                    stacked_params)

@@ -454,7 +454,17 @@ class DreamerV3Learner:
                 # here than the exact pathwise gradient.
                 from .sac import squash_logp
 
-                ent = -squash_logp(sg(u), log_std, mean, jnp)
+                # Pathwise entropy: -log p(tanh(u)) with gradients
+                # THROUGH the reparameterized sample u = mean + std*eps.
+                # Stopping u here (the r4 bug) zeroes the expected
+                # gradient of the Gaussian part (E[d(-logp(sg(u)))/
+                # d log_std] = E[1 - eps^2] = 0) and kills the
+                # tanh-correction term entirely — nothing then stops
+                # |mean| from blowing up, and the probe showed exactly
+                # that collapse (entropy 0.65 -> -10.4). Unstopped, the
+                # -log|1-tanh(u)^2| term pulls u away from saturation
+                # and the log_std term holds the std open.
+                ent = -squash_logp(u, log_std, mean, jnp)
                 actor_loss = -(sg(disc) * rets / scale).mean() \
                     - cfg.entropy_coeff * ent.mean()
                 metrics = {"ac/critic": critic_loss,

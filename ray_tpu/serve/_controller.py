@@ -31,6 +31,11 @@ class ServeController:
         self._apps: Dict[str, dict] = {}
         self._http_info: Optional[dict] = None
         self._replica_counter = 0
+        # Proxy fleet (reference: proxy_state_manager — one proxy per
+        # node): node_id -> {"handle", "info"}. Populated once
+        # ensure_proxies() records the bind options.
+        self._proxies: Dict[str, dict] = {}
+        self._proxy_opts: Optional[dict] = None
         self._stop = threading.Event()
         self._loop_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="rt-serve-ctrl")
@@ -140,7 +145,11 @@ class ServeController:
             return {"version": d["version"],
                     "max_ongoing_requests": d["config"].max_ongoing_requests,
                     "replicas": {rid: r["handle"]
-                                 for rid, r in d["replicas"].items()}}
+                                 for rid, r in d["replicas"].items()},
+                    # rid -> node_id, for locality-preferring routing
+                    # (reference: pow_2_scheduler prefer_local_node).
+                    "replica_nodes": {rid: r.get("node_id")
+                                      for rid, r in d["replicas"].items()}}
 
     def get_routes(self) -> Dict[str, dict]:
         with self._lock:
@@ -191,9 +200,19 @@ class ServeController:
         return True
 
     def shutdown_serve(self):
+        from .. import api as rt
+
         self._stop.set()
         for name in list(self._apps):
             self.delete_app(name)
+        with self._lock:
+            proxies, self._proxies = dict(self._proxies), {}
+            self._proxy_opts = None
+        for p in proxies.values():
+            try:
+                rt.kill(p["handle"])
+            except Exception:  # noqa: BLE001
+                pass
         return True
 
     def ping(self) -> bool:
@@ -217,6 +236,10 @@ class ServeController:
 
     def _reconcile_once(self):
         with self._reconcile_lock:
+            try:
+                self._reconcile_proxies()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
             with self._lock:
                 work = [(app_name, dname, d)
                         for app_name, app in self._apps.items()
@@ -310,13 +333,19 @@ class ServeController:
             for rid, handle in new:
                 try:
                     handle._wait_ready(timeout=60)
-                    ok.append((rid, handle))
+                    try:
+                        node_id = rt.get(handle.get_node_id.remote(),
+                                         timeout=10)
+                    except Exception:  # noqa: BLE001 - routing hint only
+                        node_id = None
+                    ok.append((rid, handle, node_id))
                 except Exception:  # noqa: BLE001
                     traceback.print_exc()
             if ok:
                 with self._lock:
-                    for rid, handle in ok:
+                    for rid, handle, node_id in ok:
                         d["replicas"][rid] = {"handle": handle,
+                                              "node_id": node_id,
                                               "created": time.time()}
                     d["version"] += 1
         elif have > target:
@@ -344,11 +373,108 @@ class ServeController:
         rid = f"{dname}#{self._replica_counter}"
         opts = dict(cfg.ray_actor_options)
         opts.setdefault("num_cpus", 1)
+        # Replicas spread across nodes by default so one node's death
+        # never takes a whole deployment down (reference:
+        # deployment_scheduler.py spread policy).
+        opts.setdefault("scheduling_strategy", "SPREAD")
         actor_cls = rt.remote(Replica).options(
             max_concurrency=cfg.max_ongoing_requests + 4, **opts)
         handle = actor_cls.remote(app_name, dname, rid, d["payload"],
                                   cfg.user_config)
         return rid, handle
+
+    # ------------------------------------------------------------- proxies
+    def ensure_proxies(self, http_options: dict) -> Optional[dict]:
+        """Record the proxy bind options and start one proxy per alive
+        node (reference: ``proxy.py:1116`` — a proxy on every node, any
+        of them serves external traffic). Returns the primary proxy's
+        bind info. The reconcile loop keeps the fleet in sync as nodes
+        join and leave."""
+        with self._reconcile_lock:
+            self._proxy_opts = dict(http_options)
+            self._reconcile_proxies()
+            return self._http_info
+
+    def get_proxies(self) -> Dict[str, dict]:
+        """node_id -> {"name", "info"} for every live proxy."""
+        with self._lock:
+            return {nid: {"name": p["name"], "info": p["info"]}
+                    for nid, p in self._proxies.items()}
+
+    _PROXY_HEALTH_PERIOD_S = 5.0
+
+    def _reconcile_proxies(self):
+        if self._proxy_opts is None:
+            return
+        from .. import api as rt
+        from ..util.state import list_nodes
+        from ._proxy import ProxyActor
+
+        alive = {n["node_id"]: n for n in list_nodes()
+                 if n.get("state") == "ALIVE"}
+        with self._lock:
+            have = set(self._proxies)
+        # Reap proxies whose node died (the actor died with it).
+        for nid in have - set(alive):
+            with self._lock:
+                p = self._proxies.pop(nid, None)
+            if p is not None:
+                try:
+                    rt.kill(p["handle"])
+                except Exception:  # noqa: BLE001 - already dead
+                    pass
+        # A proxy can also die on a LIVE node (crash/OOM): probe each
+        # one periodically and drop dead entries so the create loop
+        # below resurrects them — replicas get health checks, proxies
+        # must too (reference: proxy_state_manager health states).
+        now = time.time()
+        if now - getattr(self, "_proxies_checked_at", 0.0) \
+                >= self._PROXY_HEALTH_PERIOD_S:
+            self._proxies_checked_at = now
+            with self._lock:
+                probes = [(nid, p["handle"], p["name"])
+                          for nid, p in self._proxies.items()]
+            for nid, handle, name in probes:
+                try:
+                    rt.get(handle.get_port.remote(), timeout=5)
+                except Exception:  # noqa: BLE001 - proxy dead
+                    with self._lock:
+                        self._proxies.pop(nid, None)
+                    try:
+                        rt.kill(handle)
+                    except Exception:  # noqa: BLE001
+                        pass
+        opts = self._proxy_opts
+        primary_missing = not any(p["name"] == "SERVE_PROXY"
+                                  for p in self._proxies.values())
+        for nid, node in alive.items():
+            if nid in self._proxies:
+                continue
+            # The first proxy keeps the legacy cluster-wide name (and
+            # the configured port); secondaries are per-node actors on
+            # an ephemeral port — co-hosted test nodes must not fight
+            # over one port, and real deployments address each node's
+            # proxy by its own host anyway.
+            name = "SERVE_PROXY" if primary_missing \
+                else f"SERVE_PROXY:{nid[:12]}"
+            port = opts.get("port", 0) if primary_missing else 0
+            try:
+                handle = rt.remote(ProxyActor).options(
+                    name=name, max_concurrency=8, num_cpus=0,
+                    scheduling_strategy=rt.NodeAffinitySchedulingStrategy(
+                        nid, soft=True)).remote()
+                info = rt.get(handle.start.remote(
+                    opts.get("host", "127.0.0.1"), port,
+                    opts.get("request_timeout_s", 60.0)), timeout=30)
+            except Exception:  # noqa: BLE001 - node raced away; retry
+                traceback.print_exc()
+                continue
+            with self._lock:
+                self._proxies[nid] = {"handle": handle, "name": name,
+                                      "info": info}
+                if primary_missing:
+                    self._http_info = dict(info)
+                    primary_missing = False
 
     @staticmethod
     def _call_quietly(method, *args):

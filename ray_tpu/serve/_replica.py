@@ -136,6 +136,13 @@ class Replica:
             return {"replica_id": self.replica_id, "ongoing": self._ongoing,
                     "total": self._total, "uptime": time.time() - self._start_time}
 
+    def get_node_id(self):
+        """The node hosting this replica (locality routing hint)."""
+        from ..core.worker import CoreWorker
+
+        core = CoreWorker._current
+        return getattr(core, "node_id", None) if core is not None else None
+
     def check_health(self) -> bool:
         fn = getattr(self._user, "check_health", None)
         if fn is not None:

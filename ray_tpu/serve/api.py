@@ -147,24 +147,18 @@ def start(http_options: Union[None, dict, HTTPOptions] = None,
         if _client["controller"] is None:
             _client["controller"] = _get_or_create_controller()
         if proxy and _client["proxy"] is None:
-            # Get-or-create: another process (a driver, a previous CLI
-            # invocation) may already run the named proxy — adopt it,
-            # with its recorded bind info, instead of crashing on the
-            # duplicate actor name.
-            try:
-                p = rt.get_actor("SERVE_PROXY", timeout=0.5)
-                info = dict(rt.get(
-                    _client["controller"].get_http_info.remote(),
-                    timeout=10) or {})
-            except Exception:  # noqa: BLE001 - no proxy yet: create one
-                from ._proxy import ProxyActor
-
-                p = rt.remote(ProxyActor).options(
-                    name="SERVE_PROXY", max_concurrency=8).remote()
-                info = rt.get(p.start.remote(
-                    http_options.host, http_options.port,
-                    http_options.request_timeout_s), timeout=30)
-            _client["proxy"] = p
+            # The CONTROLLER owns the proxy fleet — one per alive node
+            # (reference: proxy_state_manager / proxy.py:1116) — and
+            # keeps it reconciled as nodes join/leave. ensure_proxies is
+            # get-or-create: an already-running fleet (a previous driver
+            # or CLI invocation) is adopted, with its recorded bind info.
+            info = dict(rt.get(
+                _client["controller"].ensure_proxies.remote({
+                    "host": http_options.host,
+                    "port": http_options.port,
+                    "request_timeout_s": http_options.request_timeout_s,
+                }), timeout=60) or {})
+            _client["proxy"] = rt.get_actor("SERVE_PROXY", timeout=10)
             _client["http"] = info
         if grpc_options is not None and _client["proxy"] is not None \
                 and "grpc_port" not in (_client["http"] or {}):

@@ -210,8 +210,19 @@ class Router:
         self.closed = False
         self._cond = threading.Condition()
         self._replicas: Dict[str, Any] = {}   # rid -> ActorHandle
+        self._replica_nodes: Dict[str, Any] = {}  # rid -> node_id
         self._ongoing: Dict[str, int] = {}
         self._version = -1
+        # This process's node, for locality-preferring choice
+        # (reference: pow_2_scheduler prefer-local-node ranking).
+        try:
+            from ..core.worker import CoreWorker
+
+            core = CoreWorker._current
+            self._local_node = getattr(core, "node_id", None) \
+                if core is not None else None
+        except Exception:  # noqa: BLE001
+            self._local_node = None
         self._max_ongoing = 16
         self._last_refresh = 0.0
         self._outstanding: Dict[Any, str] = {}  # ObjectRef -> rid
@@ -254,6 +265,7 @@ class Router:
             self._max_ongoing = info["max_ongoing_requests"]
             new = dict(info["replicas"])  # rid -> ActorHandle
             self._replicas = new
+            self._replica_nodes = dict(info.get("replica_nodes") or {})
             self._ongoing = {rid: self._ongoing.get(rid, 0) for rid in new}
             # Membership changed: drop affinity entries for dead replicas.
             for mid in list(self._model_affinity):
@@ -363,6 +375,14 @@ class Router:
                     if r in self._model_affinity.get(model_id, ())]
             if warm:
                 rids = warm
+        elif self._local_node is not None:
+            # Locality: prefer same-node replicas (the response bytes
+            # then ride shared memory, not TCP). Saturated locals fall
+            # back to remote ones — rids is already capacity-filtered.
+            local = [r for r in rids
+                     if self._replica_nodes.get(r) == self._local_node]
+            if local:
+                rids = local
         if len(rids) <= 2:
             return min(rids, key=lambda r: self._ongoing[r])
         a, b = random.sample(rids, 2)

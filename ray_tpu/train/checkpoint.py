@@ -113,14 +113,31 @@ class Checkpoint:
         ``_internal/storage.py``; redesigned for sharded device arrays).
 
         Multi-controller saves (``jax.distributed``) are collective:
-        every process MUST pass the same ``path`` (a directory on a
-        shared filesystem, typically derived from the step number) —
-        per-process ``mkdtemp`` naming would scatter one checkpoint's
-        shards across directories. Single-process callers may omit
-        ``path`` and get a fresh temp dir.
+        every process must write into the SAME directory. Inside a Train
+        session no ``path`` is needed — it derives deterministically
+        from the session's storage_dir + incarnation + per-process save
+        counter (every SPMD rank calls save in lockstep, so the counters
+        agree), which is what makes gang-restart fault tolerance
+        automatic rather than convention-dependent (reference:
+        ``_internal/storage.py:289`` derives checkpoint dirs the same
+        way). Outside a session, multi-process callers must still pass
+        an agreed ``path``; single-process callers may omit it and get a
+        fresh temp dir.
         """
         import orbax.checkpoint as ocp
 
+        if path is None:
+            from . import session as _session
+            from .storage import is_uri
+
+            s = _session.get_session()
+            # Only LOCAL/shared-fs storage dirs derive a direct orbax
+            # target — orbax writes through the OS path layer, so a
+            # URI storage_dir (mock://, s3-style) must not be mangled
+            # into a bogus local path by abspath below.
+            if s is not None and s.storage_dir \
+                    and not is_uri(s.storage_dir):
+                path = s.next_sharded_checkpoint_path()
         if path is not None:
             d = os.path.abspath(path)
             os.makedirs(d, exist_ok=True)
@@ -129,9 +146,9 @@ class Checkpoint:
 
             if jax.process_count() > 1:
                 raise ValueError(
-                    "multi-process sharded save needs an explicit "
-                    "`path` every process agrees on (mkdtemp would "
-                    "scatter shards across directories)")
+                    "multi-process sharded save outside a Train session "
+                    "needs an explicit `path` every process agrees on "
+                    "(mkdtemp would scatter shards across directories)")
             d = tempfile.mkdtemp(prefix="ckpt_", dir=base_dir)
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(os.path.join(d, name), state,

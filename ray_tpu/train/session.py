@@ -41,6 +41,7 @@ class _TrainSession:
         self.error: Optional[BaseException] = None
         self._report_idx = 0
         self._own_ckpts: list = []
+        self._sharded_idx = 0
         self.incarnation = incarnation
 
     def report(self, metrics: Dict[str, Any],
@@ -55,7 +56,25 @@ class _TrainSession:
             # worker itself — the driver only tracks paths/URIs, never
             # relays checkpoint bytes (reference storage.py flow), so
             # get_checkpoint() stays valid for the whole run.
-            if self.storage_dir:
+            from .storage import is_uri as _is_uri_path
+
+            in_place = False
+            if self.storage_dir and not _is_uri_path(self.storage_dir) \
+                    and not _is_uri_path(checkpoint.path):
+                try:
+                    in_place = os.path.commonpath(
+                        [os.path.abspath(checkpoint.path),
+                         os.path.abspath(self.storage_dir)]
+                    ) == os.path.abspath(self.storage_dir)
+                except ValueError:  # different drives
+                    in_place = False
+            if in_place:
+                # Already at its final location inside storage_dir —
+                # e.g. a COLLECTIVE sharded (orbax) dir that every rank
+                # wrote into; moving it to a rank-suffixed name would
+                # split one checkpoint's shards.
+                pass
+            elif self.storage_dir:
                 from .storage import get_filesystem, is_uri
 
                 # incarnation in the name: a restarted group's indices
@@ -80,8 +99,11 @@ class _TrainSession:
             self.latest_checkpoint = checkpoint
             # Non-lead ranks own their GC (the driver tracks only rank 0's
             # checkpoints): keep the two most recent so a concurrent
-            # get_checkpoint() never races a deletion.
-            if self.world_rank != 0 and self.storage_dir:
+            # get_checkpoint() never races a deletion. In-place dirs are
+            # exempt — a collective sharded dir is ONE checkpoint that
+            # every rank reported; any rank GC'ing it would delete the
+            # gang's latest restore point.
+            if self.world_rank != 0 and self.storage_dir and not in_place:
                 self._own_ckpts.append(checkpoint.path)
                 while len(self._own_ckpts) > 2:
                     self._drop_own(self._own_ckpts.pop(0))
@@ -100,6 +122,27 @@ class _TrainSession:
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.latest_checkpoint
+
+    def next_sharded_checkpoint_path(self) -> str:
+        """Deterministic directory for the next orbax save, derived from
+        the session so user code never hand-agrees a path (reference:
+        storage.py:289 derived checkpoint dirs).
+
+        Multi-controller (``jax.distributed``, ``process_count() > 1``):
+        every SPMD rank calls save in lockstep, so the rank-INDEPENDENT
+        name agrees across processes — one collective checkpoint, many
+        shard writers. Single-controller gangs (each worker its own jax
+        world): ranks are independent writers of FULL checkpoints, so
+        the name carries the rank to keep them apart."""
+        import jax
+
+        collective = jax.process_count() > 1
+        rank = "" if collective else f"rank{self.world_rank}_"
+        path = os.path.join(
+            self.storage_dir,
+            f"sharded_{rank}i{self.incarnation}_{self._sharded_idx:06d}")
+        self._sharded_idx += 1
+        return path
 
     def get_dataset_shard(self, name: str = "train"):
         shard = self.dataset_shards.get(name)

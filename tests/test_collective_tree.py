@@ -1,0 +1,99 @@
+"""Tree/object-store StoreGroup collectives: per-rank payload traffic
+scales O(log W), not O(W) (VERDICT r4 #5 — the old symmetric KV gather
+was O(world²) cluster-wide). Reference surface: util/collective."""
+import numpy as np
+
+
+def _spawn_group(rt, world, fn_name, payload_kb, name):
+    @rt.remote
+    class Ranker:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def run(self, fn_name, payload_kb, name):
+            import numpy as np
+
+            from ray_tpu.collective import collective as C
+
+            g = C.init_collective_group(self.world, self.rank,
+                                        backend="store", group_name=name)
+            x = np.full((payload_kb * 128,), float(self.rank + 1),
+                        np.float64)  # payload_kb KiB
+            if fn_name == "allreduce":
+                out = g.allreduce(x)
+                expect = sum(range(1, self.world + 1))
+                assert np.allclose(out, expect), out[:4]
+            elif fn_name == "broadcast":
+                out = g.broadcast(x if self.rank == 0 else None,
+                                  src_rank=0)
+                assert np.allclose(out, 1.0), out[:4]
+            return dict(g.stats)
+
+    actors = [Ranker.remote(r, world) for r in range(world)]
+    return rt.get([a.run.remote(fn_name, payload_kb, name)
+                   for a in actors], timeout=120)
+
+
+def test_allreduce_per_rank_transfers_logarithmic(rt_cluster):
+    """8-rank allreduce of 64 KiB payloads: every rank moves at most
+    log2(8)+1 = 4 payloads through the store (the old design moved W=8
+    per rank), and the KV carries only tiny ref records."""
+    stats = _spawn_group(rt_cluster, 8, "allreduce", 64, "tree_ar8")
+    total_puts = sum(s["store_puts"] for s in stats)
+    assert total_puts <= 8, stats  # W-1 reduce edges + 1 broadcast
+    for s in stats:
+        assert s["store_gets"] <= 4, s       # <= log2(W) + 1
+        assert s["kv_bytes_in"] < 16 * 1024, s   # refs, not payloads
+        assert s["kv_bytes_out"] < 4 * 1024, s
+
+
+def test_broadcast_src_puts_once(rt_cluster):
+    """Broadcast: the source puts ONE object; receivers each pull it
+    via the store (multi-source chunked path), no payload in the KV."""
+    stats = _spawn_group(rt_cluster, 8, "broadcast", 64, "tree_bc8")
+    assert sum(s["store_puts"] for s in stats) == 1, stats
+    for i, s in enumerate(stats):
+        assert s["store_gets"] == (0 if i == 0 else 1), stats
+        assert s["kv_bytes_in"] < 4 * 1024, s
+
+
+def test_small_payloads_stay_inline(rt_cluster):
+    """Sub-threshold payloads skip the object store entirely — the KV
+    round-trip is cheaper than put+get for tiny rendezvous values."""
+    stats = _spawn_group(rt_cluster, 4, "allreduce", 1, "tree_inl4")  # 1 KiB < 4 KiB
+    assert all(s["store_puts"] == 0 and s["store_gets"] == 0
+               for s in stats), stats
+
+
+def test_many_generations_gc_bounded(rt_cluster):
+    """Back-to-back ops cross several sync generations; held refs and
+    own-slot records stay bounded by GC_LAG."""
+    rt = rt_cluster
+
+    @rt.remote
+    class Looper:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def run(self, iters):
+            import numpy as np
+
+            from ray_tpu.collective import collective as C
+
+            g = C.init_collective_group(self.world, self.rank,
+                                        backend="store", group_name="gcgrp")
+            for i in range(iters):
+                out = g.allreduce(np.full((4096,), 1.0))  # > INLINE_MAX
+                assert np.allclose(out, self.world)
+            return {"slots_gens": len(g._own_slots),
+                    "held_gens": len(g._held)}
+
+    world, iters = 2, 40
+    actors = [Looper.remote(r, world) for r in range(world)]
+    outs = rt.get([a.run.remote(iters) for a in actors], timeout=180)
+    from ray_tpu.collective.collective import StoreGroup
+
+    cap = StoreGroup.GC_LAG + StoreGroup.SYNC_EVERY
+    for o in outs:
+        assert o["slots_gens"] <= cap, o
+        assert o["held_gens"] <= cap, o

@@ -42,9 +42,9 @@ def test_dashboard_html_ui(rt_fresh):
     with urllib.request.urlopen(url + "/", timeout=10) as resp:
         body = resp.read().decode()
     assert resp.status == 200
-    # real UI, not just a link list: tables + auto-refresh script
-    for marker in ("<table id=\"nodes\">", "<table id=\"actors\">",
-                   "fetchState", "setInterval(refresh"):
+    # real UI, not just a link list: the SPA shell + auto-refresh
+    # (full per-view coverage lives in tests/test_dashboard_ui.py)
+    for marker in ("id=\"nav\"", "/api/state", "setInterval(refresh"):
         assert marker in body, marker
     with urllib.request.urlopen(url + "/api/state?kind=nodes",
                                 timeout=10) as resp:

@@ -26,11 +26,15 @@ def test_collect_node_stats_shape():
     assert w["rss_bytes"] > 0
 
 
-def test_agents_through_head_and_direct():
+def test_agents_through_head_and_direct(monkeypatch):
     import ray_tpu as rt
 
     if rt.is_initialized():
         rt.shutdown()
+    # Direct agent access is OPT-IN (the default loopback bind
+    # advertises no cluster-wide URL; the head proxy covers that path).
+    # Deliberate exposure = bind the routable interface.
+    monkeypatch.setenv("RT_AGENT_BIND", "0.0.0.0")
     c = Cluster(head_resources={"CPU": 0})
     c.add_node(num_cpus=2)
     rt = c.connect()

@@ -32,6 +32,11 @@ def test_zip_roundtrip(tmp_path):
 
 def test_validate_rejects_unknown():
     with pytest.raises(ValueError, match="unsupported"):
+        renv.validate({"container": {"image": "x"}})
+    # conda is a supported PLUGIN now (packed/prefix forms); the
+    # reference's yaml-file form needs a conda binary and stays invalid
+    # in this zero-egress runtime.
+    with pytest.raises(ValueError, match="conda"):
         renv.validate({"conda": "env.yml"})
 
 

@@ -1,0 +1,176 @@
+"""Runtime-env plugin protocol conformance (reference: plugin.py ABC +
+RAY_RUNTIME_ENV_PLUGINS third-party loading, re-designed — see
+ray_tpu/_private/runtime_env_plugins.py)."""
+import os
+import sys
+import tarfile
+import textwrap
+
+import pytest
+
+from ray_tpu._private import runtime_env as renv
+from ray_tpu._private import runtime_env_plugins as rep
+
+
+def _mem_kv():
+    store = {}
+    return store, store.__setitem__, store.get
+
+
+def test_builtins_are_registered_plugins():
+    names = {p.name for p in rep.plugins()}
+    assert {"env_vars", "working_dir", "py_modules", "pip",
+            "conda"} <= names
+    # conda (interpreter-level) applies before path-level plugins
+    order = [p.name for p in rep.plugins()]
+    assert order.index("conda") < order.index("working_dir")
+
+
+def test_third_party_plugin_full_lifecycle(tmp_path, monkeypatch):
+    """A plugin registered via register_plugin validates, prepares
+    (uploading a blob through the driver KV), and applies (reading it
+    back worker-side) — the full reference plugin lifecycle."""
+    calls = []
+
+    class StampPlugin(rep.RuntimeEnvPlugin):
+        name = "stamp"
+        priority = 50
+
+        def validate(self, value):
+            if not isinstance(value, str):
+                raise ValueError("stamp must be a string")
+            calls.append("validate")
+            return value
+
+        def prepare(self, value, ctx):
+            ctx.kv_put("stamp/blob", value.encode())
+            calls.append("prepare")
+            return {"key": "stamp/blob"}
+
+        def apply(self, wire, ctx):
+            data = ctx.kv_get(wire["key"])
+            calls.append("apply")
+            os.environ["RT_TEST_STAMP"] = data.decode()
+
+        def uris(self, wire):
+            return [wire["key"]]
+
+    rep.register_plugin(StampPlugin())
+    try:
+        store, kv_put, kv_get = _mem_kv()
+        env = renv.validate({"stamp": "hello-plugin"})
+        wire = renv.prepare(env, kv_put)
+        assert store["stamp/blob"] == b"hello-plugin"
+        assert renv.env_hash({"stamp": "hello-plugin"})  # hashable
+        renv.apply(wire, kv_get, str(tmp_path))
+        assert os.environ.pop("RT_TEST_STAMP") == "hello-plugin"
+        # prepare() re-validates (defense in depth) → two validate calls
+        assert calls == ["validate", "validate", "prepare", "apply"]
+        with pytest.raises(ValueError, match="stamp must be"):
+            renv.validate({"stamp": 42})
+    finally:
+        rep.unregister_plugin("stamp")
+    # once unregistered the key is rejected again
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        renv.validate({"stamp": "x"})
+
+
+def test_env_var_plugin_loading(tmp_path, monkeypatch):
+    """RT_RUNTIME_ENV_PLUGINS=module:Class loads third-party plugins,
+    mirroring the reference's RAY_RUNTIME_ENV_PLUGINS mechanism."""
+    mod = tmp_path / "my_rt_plugin.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu._private.runtime_env_plugins import RuntimeEnvPlugin
+
+        class MarkerPlugin(RuntimeEnvPlugin):
+            name = "marker"
+            def apply(self, wire, ctx):
+                import os
+                os.environ["RT_TEST_MARKER"] = str(wire)
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("RT_RUNTIME_ENV_PLUGINS", "my_rt_plugin:MarkerPlugin")
+    monkeypatch.setattr(rep, "_env_loaded", False)
+    try:
+        assert rep.get_plugin("marker") is not None
+        env = renv.validate({"marker": "on"})
+        store, kv_put, kv_get = _mem_kv()
+        wire = renv.prepare(env, kv_put)
+        renv.apply(wire, kv_get, str(tmp_path))
+        assert os.environ.pop("RT_TEST_MARKER") == "on"
+    finally:
+        rep.unregister_plugin("marker")
+        monkeypatch.setattr(rep, "_env_loaded", True)
+
+
+def _make_packed_env(tmp_path):
+    """Build a conda-pack-style tarball: bin/ + lib/pythonX.Y/
+    site-packages with an importable module."""
+    root = tmp_path / "envroot"
+    sp = root / "lib" / f"python{sys.version_info[0]}.{sys.version_info[1]}" \
+        / "site-packages"
+    sp.mkdir(parents=True)
+    (sp / "packedpkg.py").write_text("VALUE = 'from-packed-env'\n")
+    (root / "bin").mkdir()
+    (root / "bin" / "packedtool").write_text("#!/bin/sh\necho ok\n")
+    tar = tmp_path / "env.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(root, arcname=".")
+    return str(tar)
+
+
+def test_conda_packed_env_apply(tmp_path, monkeypatch):
+    """The conda plugin extracts a conda-pack tarball into a per-hash
+    cache and exposes its site-packages + bin (reference: conda.py's
+    env-per-hash, re-designed egress-free for packed envs)."""
+    monkeypatch.setenv("TMPDIR", str(tmp_path / "cache"))
+    tar = _make_packed_env(tmp_path)
+    env = renv.validate({"conda": {"packed": tar}})
+    store, kv_put, kv_get = _mem_kv()
+    wire = renv.prepare(env, kv_put)
+    old_path, old_env = list(sys.path), os.environ.get("PATH")
+    try:
+        renv.apply(wire, kv_get, str(tmp_path / "scratch"))
+        import importlib
+        importlib.invalidate_caches()
+        import packedpkg  # noqa: F401 - provided by the packed env
+
+        assert packedpkg.VALUE == "from-packed-env"
+        assert any("bin" in (p or "") for p in
+                   os.environ["PATH"].split(os.pathsep))
+        # second apply hits the cache (marker mtime refreshed, same dir)
+        renv.apply(wire, kv_get, str(tmp_path / "scratch2"))
+    finally:
+        sys.modules.pop("packedpkg", None)
+        sys.path[:] = old_path
+        if old_env is not None:
+            os.environ["PATH"] = old_env
+
+
+def test_conda_prefix_env_apply(tmp_path):
+    """conda={'prefix': dir} uses an existing env in place."""
+    sp = tmp_path / "pfx" / "lib" / \
+        f"python{sys.version_info[0]}.{sys.version_info[1]}" / "site-packages"
+    sp.mkdir(parents=True)
+    (sp / "pfxpkg.py").write_text("VALUE = 'from-prefix'\n")
+    env = renv.validate({"conda": {"prefix": str(tmp_path / "pfx")}})
+    store, kv_put, kv_get = _mem_kv()
+    wire = renv.prepare(env, kv_put)
+    old_path = list(sys.path)
+    try:
+        renv.apply(wire, kv_get, str(tmp_path / "scratch"))
+        import importlib
+        importlib.invalidate_caches()
+        import pfxpkg  # noqa: F401
+
+        assert pfxpkg.VALUE == "from-prefix"
+    finally:
+        sys.modules.pop("pfxpkg", None)
+        sys.path[:] = old_path
+
+
+def test_conda_validate_rejects_bad_config():
+    with pytest.raises(ValueError):
+        renv.validate({"conda": {"packed": "/nope", "prefix": "/nope"}})
+    with pytest.raises(ValueError):
+        renv.validate({"conda": 42})

@@ -1,0 +1,106 @@
+"""Per-node serve proxies + locality-preferring replica routing
+(reference: serve/_private/proxy.py:1116 — a proxy on every node;
+pow_2_scheduler's prefer-local-node replica choice)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture
+def two_node_cluster():
+    import ray_tpu as _rt
+
+    if _rt.is_initialized():
+        _rt.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_resources={"CPU": 4})
+    rt = c.connect()
+    c.add_node(num_cpus=4, shared_shm=True)
+    c.wait_for_nodes(2)
+    yield c, rt
+    try:
+        from ray_tpu import serve
+
+        serve.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    c.shutdown()
+
+
+def _http_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def test_proxy_per_node_and_locality(two_node_cluster):
+    c, rt = two_node_cluster
+    from ray_tpu import serve
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 1})
+    class Echo:
+        def __call__(self, req):
+            return {"msg": "hi"}
+
+    serve.run(Echo.bind(), name="app", route_prefix="/echo")
+
+    ctrl = serve.api._controller()
+    # One proxy per alive node, reconciled by the controller.
+    deadline = time.time() + 30
+    proxies = {}
+    while time.time() < deadline:
+        proxies = rt.get(ctrl.get_proxies.remote(), timeout=10)
+        if len(proxies) >= 2:
+            break
+        time.sleep(0.2)
+    assert len(proxies) == 2, proxies
+    names = {p["name"] for p in proxies.values()}
+    assert "SERVE_PROXY" in names  # legacy primary name retained
+    # Every proxy serves traffic (external traffic can hit any node).
+    for p in proxies.values():
+        info = p["info"]
+        out = _http_json(
+            f"http://{info['host']}:{info['port']}/echo")
+        assert out == {"msg": "hi"}
+
+    # Replicas spread across nodes (SPREAD default) and the controller
+    # records each replica's node for locality routing.
+    info = rt.get(ctrl.get_replicas.remote("app", "Echo"), timeout=10)
+    nodes = set(info["replica_nodes"].values())
+    assert len(info["replica_nodes"]) == 2
+    assert None not in nodes
+    assert len(nodes) == 2, f"replicas not spread: {info['replica_nodes']}"
+
+    # Locality: a driver-side handle prefers the replica on its own node
+    # when it has capacity.
+    from ray_tpu.core.worker import CoreWorker
+    from ray_tpu.serve.handle import get_router
+
+    router = get_router("app", "Echo")
+    router.refresh(force=True)
+    local_node = CoreWorker._current.node_id
+    picked = {router._pick_locked() for _ in range(16)}
+    local_rids = {rid for rid, nid in router._replica_nodes.items()
+                  if nid == local_node}
+    if local_rids:  # driver node hosts a replica -> always chosen
+        assert picked <= local_rids, (picked, router._replica_nodes)
+
+    # Node death: its proxy leaves the fleet, the other keeps serving.
+    victim = next(n for n in c._nodes)
+    dead_node = victim.node_id
+    c.remove_node(victim, graceful=False)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        proxies = rt.get(ctrl.get_proxies.remote(), timeout=10)
+        if dead_node not in proxies and len(proxies) == 1:
+            break
+        time.sleep(0.2)
+    assert dead_node not in proxies, proxies
+    survivor = next(iter(proxies.values()))["info"]
+    out = _http_json(
+        f"http://{survivor['host']}:{survivor['port']}/echo")
+    assert out == {"msg": "hi"}
