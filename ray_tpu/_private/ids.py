@@ -42,6 +42,10 @@ import itertools as _itertools
 _seq_iter = _itertools.count(1)
 
 
+_ts_cache_ms = 0
+_ts_cache_bytes = b"\x00" * 6
+
+
 def _rand_bytes(n: int) -> bytes:
     if n == 10:
         s = next(_seq_iter) & 0xFFFFFFFF
@@ -70,9 +74,16 @@ class BaseID:
     def from_random(cls) -> "BaseID":
         # 6-byte coarse timestamp prefix keeps IDs roughly creation-ordered,
         # which makes store scans and debugging nicer; the remaining bytes are
-        # cryptographically random.
-        ts = int(time.time() * 1000).to_bytes(6, "big", signed=False)[-6:]
-        return cls(bytes([cls._type_tag]) + ts + _rand_bytes(_ID_LEN - 6))
+        # cryptographically random. The prefix is CACHED per millisecond:
+        # submission bursts mint thousands of IDs per ms and the
+        # int->to_bytes pair showed up in the submit-path profile.
+        global _ts_cache_ms, _ts_cache_bytes
+        now = int(time.time() * 1000)
+        if now != _ts_cache_ms:
+            _ts_cache_ms = now
+            _ts_cache_bytes = now.to_bytes(6, "big", signed=False)[-6:]
+        return cls(bytes([cls._type_tag]) + _ts_cache_bytes
+                   + _rand_bytes(_ID_LEN - 6))
 
     @classmethod
     def from_hex(cls, h: str) -> "BaseID":

@@ -104,9 +104,17 @@ def prepare(runtime_env: Dict[str, Any], kv_put) -> Dict[str, Any]:
     ctx = rep.PrepareContext(kv_put=kv_put)
     out: Dict[str, Any] = {}
     for plugin in rep.plugins():
-        value = runtime_env.get(plugin.name)
-        if value:
-            plugin._prepare_into(value, out, ctx)
+        if plugin.name not in runtime_env:
+            continue
+        value = runtime_env[plugin.name]
+        # Built-ins keep the legacy falsy-skip ({} env_vars, empty
+        # py_modules list are no-ops); third-party plugins get their
+        # prepare for ANY present value — {} or 0 may be a valid
+        # all-defaults config, and silently dropping it would make the
+        # env never materialize with no error.
+        if plugin.skip_empty and not value:
+            continue
+        plugin._prepare_into(value, out, ctx)
     return out
 
 
@@ -127,17 +135,51 @@ def _pip_cache_root() -> str:
                         "pip_envs")
 
 
+def _cached_build(root: str, key: str, build_fn) -> str:
+    """Shared per-hash cache discipline (flock + staging dir + atomic
+    replace + .ok LRU marker) for materialized envs — one copy of the
+    locking/eviction rules for pip installs AND packed-env extraction,
+    so fixes cannot drift between them. ``build_fn(stage_dir)``
+    populates the staging dir; any exception cleans the stage and
+    propagates."""
+    import fcntl
+    import shutil
+
+    os.makedirs(root, exist_ok=True)
+    env_dir = os.path.join(root, key)
+    marker = env_dir + ".ok"
+    with open(os.path.join(root, key + ".lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                os.utime(marker)  # LRU touch
+                return env_dir
+            # Build into staging and rename: a crash mid-build must not
+            # leave a partial env that a retry would adopt and marker.
+            stage = env_dir + ".staging"
+            shutil.rmtree(stage, ignore_errors=True)
+            try:
+                build_fn(stage)
+            except BaseException:
+                shutil.rmtree(stage, ignore_errors=True)
+                raise
+            shutil.rmtree(env_dir, ignore_errors=True)
+            os.replace(stage, env_dir)
+            open(marker, "w").close()
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    return env_dir
+
+
 def ensure_pip_env(packages, wheelhouse: str) -> str:
     """Install ``packages`` from the local wheelhouse into a cached
     per-hash package dir; return it (reference: ``pip.py``'s
     virtualenv-per-hash + ``uri_cache.py``'s eviction). Concurrent
     workers serialize on a file lock; a hit only touches the marker
     (its mtime is the LRU clock)."""
-    import fcntl
     import subprocess
 
     root = _pip_cache_root()
-    os.makedirs(root, exist_ok=True)
     # The cache key covers the wheelhouse CONTENTS (filename+size+mtime),
     # not just its path: with unpinned requirements, dropping a newer
     # wheel into the same wheelhouse must invalidate the cached env
@@ -151,36 +193,19 @@ def ensure_pip_env(packages, wheelhouse: str) -> str:
     h = hashlib.sha256(json.dumps(
         [sorted(packages), os.path.abspath(wheelhouse), wheels]).encode()
     ).hexdigest()[:16]
-    env_dir = os.path.join(root, h)
-    marker = env_dir + ".ok"
-    with open(os.path.join(root, h + ".lock"), "w") as lockf:
-        fcntl.flock(lockf, fcntl.LOCK_EX)
-        try:
-            if os.path.exists(marker):
-                os.utime(marker)  # LRU touch
-                return env_dir
-            # Install into a staging dir and rename: a crash mid-install
-            # must not leave a partial env that a retrying pip would
-            # "Target directory already exists"-skip yet get markered.
-            import shutil
 
-            stage = env_dir + ".staging"
-            shutil.rmtree(stage, ignore_errors=True)
-            proc = subprocess.run(
-                [sys.executable, "-m", "pip", "install", "--quiet",
-                 "--no-index", "--find-links", wheelhouse,
-                 "--target", stage, *packages],
-                capture_output=True, text=True, timeout=600)
-            if proc.returncode != 0:
-                shutil.rmtree(stage, ignore_errors=True)
-                raise RuntimeError(
-                    f"pip install from wheelhouse {wheelhouse!r} failed "
-                    f"for {list(packages)}: {proc.stderr[-2000:]}")
-            shutil.rmtree(env_dir, ignore_errors=True)
-            os.replace(stage, env_dir)
-            open(marker, "w").close()
-        finally:
-            fcntl.flock(lockf, fcntl.LOCK_UN)
+    def build(stage):
+        proc = subprocess.run(
+            [sys.executable, "-m", "pip", "install", "--quiet",
+             "--no-index", "--find-links", wheelhouse,
+             "--target", stage, *packages],
+            capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip install from wheelhouse {wheelhouse!r} failed "
+                f"for {list(packages)}: {proc.stderr[-2000:]}")
+
+    env_dir = _cached_build(root, h, build)
     _evict_pip_envs(keep=env_dir)
     return env_dir
 
@@ -265,39 +290,23 @@ def _conda_cache_root() -> str:
 def ensure_extracted_env(tarball: str) -> str:
     """Extract a conda-pack-style tarball into a per-hash cached dir
     (reference: ``conda.py``'s env-per-hash, re-designed egress-free for
-    packed envs). Same staged+atomic+flock+LRU-marker discipline as
-    :func:`ensure_pip_env`."""
-    import fcntl
-    import shutil
+    packed envs). Cache discipline shared with pip via
+    :func:`_cached_build`."""
     import tarfile
 
     tarball = os.path.abspath(tarball)
     st = os.stat(tarball)
-    root = _conda_cache_root()
-    os.makedirs(root, exist_ok=True)
     h = hashlib.sha256(json.dumps(
         [tarball, st.st_size, int(st.st_mtime)]).encode()).hexdigest()[:16]
-    env_dir = os.path.join(root, h)
-    marker = env_dir + ".ok"
-    with open(os.path.join(root, h + ".lock"), "w") as lockf:
-        fcntl.flock(lockf, fcntl.LOCK_EX)
-        try:
-            if os.path.exists(marker):
-                os.utime(marker)  # LRU touch
-                return env_dir
-            stage = env_dir + ".staging"
-            shutil.rmtree(stage, ignore_errors=True)
-            os.makedirs(stage)
-            with tarfile.open(tarball) as tf:
-                # "data" filter: refuse absolute paths / traversal /
-                # device nodes from untrusted archives
-                tf.extractall(stage, filter="data")
-            shutil.rmtree(env_dir, ignore_errors=True)
-            os.replace(stage, env_dir)
-            open(marker, "w").close()
-        finally:
-            fcntl.flock(lockf, fcntl.LOCK_UN)
-    return env_dir
+
+    def build(stage):
+        os.makedirs(stage)
+        with tarfile.open(tarball) as tf:
+            # "data" filter: refuse absolute paths / traversal /
+            # device nodes from untrusted archives
+            tf.extractall(stage, filter="data")
+
+    return _cached_build(_conda_cache_root(), h, build)
 
 
 def _activate_env_prefix(prefix: str) -> None:
@@ -324,6 +333,7 @@ from . import runtime_env_plugins as _rep  # noqa: E402
 
 
 class _EnvVarsPlugin(_rep.RuntimeEnvPlugin):
+    skip_empty = True
     name = "env_vars"
     priority = 8
 
@@ -343,6 +353,7 @@ class _EnvVarsPlugin(_rep.RuntimeEnvPlugin):
 
 
 class _WorkingDirPlugin(_rep.RuntimeEnvPlugin):
+    skip_empty = True
     name = "working_dir"
     priority = 10
 
@@ -367,6 +378,7 @@ class _WorkingDirPlugin(_rep.RuntimeEnvPlugin):
 
 
 class _PyModulesPlugin(_rep.RuntimeEnvPlugin):
+    skip_empty = True
     name = "py_modules"
     priority = 11
 
@@ -403,6 +415,7 @@ class _PyModulesPlugin(_rep.RuntimeEnvPlugin):
 
 
 class _PipPlugin(_rep.RuntimeEnvPlugin):
+    skip_empty = True
     name = "pip"
     priority = 6
 
@@ -482,6 +495,7 @@ class _CondaPlugin(_rep.RuntimeEnvPlugin):
 
     name = "conda"
     priority = 5
+    skip_empty = True
 
     def validate(self, value):
         if not isinstance(value, dict):

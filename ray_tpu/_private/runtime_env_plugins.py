@@ -51,6 +51,9 @@ class RuntimeEnvPlugin:
     name: str = ""
     #: apply order, lower first (reference: ``priority``, default 10)
     priority: int = 10
+    #: skip prepare() for falsy values ({} env_vars is a no-op). Leave
+    #: False for third-party plugins: {} / 0 may be valid configs.
+    skip_empty: bool = False
 
     def validate(self, value: Any) -> Any:
         """Raise ValueError on malformed config; return (possibly

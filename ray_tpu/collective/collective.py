@@ -293,8 +293,18 @@ class StoreGroup(BaseGroup):
         same machinery as the 1 GiB broadcast bench)."""
         import pickle
 
-        raw = _encode(x)
-        if len(raw) <= self.INLINE_MAX:
+        import numpy as np
+
+        # Cheap size estimate FIRST: pickling a 1 GiB gradient just to
+        # learn it is over the inline threshold would double the
+        # serialization cost of every big publish (core.put serializes
+        # again). Only genuinely small candidates pay the try-encode.
+        nbytes = getattr(x, "nbytes", None)
+        if nbytes is None and isinstance(x, (bytes, bytearray)):
+            nbytes = len(x)
+        raw = _encode(x) if nbytes is None or nbytes <= self.INLINE_MAX \
+            else None
+        if raw is not None and len(raw) <= self.INLINE_MAX:
             payload = pickle.dumps(("inline", raw))
         else:
             ref = self._core.put(x)

@@ -205,14 +205,18 @@ class ServeController:
         self._stop.set()
         for name in list(self._apps):
             self.delete_app(name)
-        with self._lock:
-            proxies, self._proxies = dict(self._proxies), {}
-            self._proxy_opts = None
-        for p in proxies.values():
-            try:
-                rt.kill(p["handle"])
-            except Exception:  # noqa: BLE001
-                pass
+        # Under _reconcile_lock: an in-flight _reconcile_proxies could
+        # otherwise finish creating a proxy AFTER this teardown and
+        # leak it (still holding the SERVE_PROXY name) past shutdown.
+        with self._reconcile_lock:
+            with self._lock:
+                proxies, self._proxies = dict(self._proxies), {}
+                self._proxy_opts = None
+            for p in proxies.values():
+                try:
+                    rt.kill(p["handle"])
+                except Exception:  # noqa: BLE001
+                    pass
         return True
 
     def ping(self) -> bool:

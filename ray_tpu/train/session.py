@@ -58,8 +58,16 @@ class _TrainSession:
             # get_checkpoint() stays valid for the whole run.
             from .storage import is_uri as _is_uri_path
 
+            # Only a genuinely COLLECTIVE dir (multi-controller orbax:
+            # many shard writers, one checkpoint) is exempt from the
+            # move + non-lead GC below. Single-controller ranks write
+            # rank-suffixed FULL checkpoints that must keep their
+            # bounded keep-last-2 GC or storage grows without limit.
+            import jax
+
             in_place = False
-            if self.storage_dir and not _is_uri_path(self.storage_dir) \
+            if jax.process_count() > 1 and self.storage_dir \
+                    and not _is_uri_path(self.storage_dir) \
                     and not _is_uri_path(checkpoint.path):
                 try:
                     in_place = os.path.commonpath(

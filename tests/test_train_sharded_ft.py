@@ -37,8 +37,13 @@ def test_session_derives_deterministic_sharded_path(tmp_path):
 
 def test_sharded_save_without_path_inside_session(tmp_path):
     """from_sharded_state() with NO path lands in the session-derived
-    dir; report() keeps it in place (no rank-suffixed move that would
-    split a collective dir) and get_checkpoint() restores it."""
+    dir and restores through get_checkpoint(). Single-controller ranks
+    keep the normal move + bounded GC (their dirs are full per-rank
+    checkpoints); a genuinely COLLECTIVE dir (multi-controller) stays
+    in place — moving it to a rank-suffixed name would split one
+    checkpoint's shards across names."""
+    from unittest import mock
+
     import jax
 
     from ray_tpu.train import session as sess
@@ -51,11 +56,21 @@ def test_sharded_save_without_path_inside_session(tmp_path):
         ckpt = Checkpoint.from_sharded_state(state)
         assert ckpt.path.startswith(str(tmp_path)), ckpt.path
         s.report({"loss": 1.0}, checkpoint=ckpt)
-        assert s.get_checkpoint().path == ckpt.path  # not moved
         like = {"w": jax.numpy.zeros(8), "step": jax.numpy.int32(0)}
         out = s.get_checkpoint().load_sharded_state(like)
         np.testing.assert_allclose(np.asarray(out["w"]), np.arange(8.0))
         assert int(out["step"]) == 3
+
+        # Collective save (multi-controller): the shared dir is NOT
+        # moved or GC'd by any rank.
+        ckpt2 = Checkpoint.from_sharded_state(
+            {"w": jax.numpy.arange(4.0), "step": jax.numpy.int32(9)})
+        with mock.patch("jax.process_count", return_value=2):
+            s.report({"loss": 0.5}, checkpoint=ckpt2)
+        assert s.get_checkpoint().path == ckpt2.path  # left in place
+        out2 = s.get_checkpoint().load_sharded_state(
+            {"w": jax.numpy.zeros(4), "step": jax.numpy.int32(0)})
+        assert int(out2["step"]) == 9
     finally:
         sess.shutdown_session()
 
