@@ -46,6 +46,11 @@ from ..exceptions import (
 from ..util import tracing
 
 
+# Structured token embedded in the "actor not hosted here" RpcError so
+# callers key on a stable contract, not diagnostic prose.
+ACTOR_NOT_ON_WORKER = "[actor-not-on-worker]"
+
+
 class ObjectRef:
     """A reference to a (possibly pending) remote object.
 
@@ -484,6 +489,10 @@ class CoreWorker:
         self._actor_state: Dict[bytes, dict] = {}
         # worker-mode execution state
         self._actors_local: Dict[bytes, Any] = {}  # actor_id -> instance
+        # Tombstones: actors that USED to live here (restarted away /
+        # reaped) — routing misses for them fail fast instead of
+        # waiting out the registration-grace window.
+        self._actors_gone: set = set()
         self._actor_executors: Dict[bytes, Any] = {}
         # actor -> {group name -> dedicated ThreadPoolExecutor}
         self._actor_group_executors: Dict[bytes, Dict[str, Any]] = {}
@@ -722,8 +731,9 @@ class CoreWorker:
                         # zombie — exit rather than run duplicates.
                         os._exit(0)
                     for h in stale:
-                        self._actors_local.pop(
-                            ActorID.from_hex(h).binary(), None)
+                        key = ActorID.from_hex(h).binary()
+                        self._actors_local.pop(key, None)
+                        self._actors_gone.add(key)
                 for topic in list(self._subscribed_topics):
                     await self._head.call_simple(
                         "subscribe", {"topic": topic})
@@ -2259,9 +2269,29 @@ class CoreWorker:
             else:
                 addr = await asyncio.get_running_loop().run_in_executor(
                     None, lambda: self.actor_address(actor_id))
-            conn = await self._get_conn(addr)
-            fut = conn.send_request(method, payload)
-        return await fut
+            try:
+                conn = await self._get_conn(addr)
+                fut = conn.send_request(method, payload)
+            except (OSError, rpc.ConnectionLost) as e:
+                # Dead cached route (worker gone): invalidate so the
+                # NEXT call re-resolves through the head, then fail this
+                # one — a transparent in-place resend here could write
+                # behind newer seq numbers on the replacement worker and
+                # break the actor's FIFO ordering.
+                if st is not None and st.get("address") == addr:
+                    st["address"] = None
+                raise
+        try:
+            return await fut
+        except rpc.RpcError as e:
+            if ACTOR_NOT_ON_WORKER in str(e):
+                # Stale route (actor restarted elsewhere / not yet
+                # registered beyond the server-side grace): invalidate
+                # the cache; retries belong to the caller's layer (task
+                # retries, serve router) for the same FIFO reason.
+                if st is not None and st.get("address") == addr:
+                    st["address"] = None
+            raise
 
     def _store_actor_failure(self, actor_id: ActorID, specs, e):
         """Map a transport/execution failure onto every spec's result
@@ -2693,6 +2723,7 @@ class CoreWorker:
             return real_cls(*args, **kwargs)
 
         instance = await loop.run_in_executor(self._exec_pool, _make)
+        self._actors_gone.discard(actor_id_b)
         self._actors_local[actor_id_b] = instance
         maxc = meta.get("max_concurrency", 1)
         self._actor_executors[actor_id_b] = concurrent.futures.ThreadPoolExecutor(
@@ -3140,10 +3171,23 @@ class CoreWorker:
     async def _run_actor_task(self, meta, conn=None):
         actor_id_b = meta["actor_id"]
         instance = self._actors_local.get(actor_id_b)
+        if instance is None and actor_id_b not in self._actors_gone:
+            # The head routes tasks here the moment it ASSIGNS the
+            # actor; the instance lands in _actors_local only when the
+            # constructor finishes on another thread. Waiting briefly
+            # turns that registration race into a short stall instead
+            # of a spurious routing failure. Actors KNOWN to have left
+            # (tombstoned) fail fast below instead of stalling 5s.
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while instance is None and \
+                    asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+                instance = self._actors_local.get(actor_id_b)
         if instance is None:
             local = [ActorID(a).hex()[:12] for a in self._actors_local]
             raise rpc.RpcError(
-                f"actor {ActorID(actor_id_b).hex()[:12]} not on worker "
+                f"{ACTOR_NOT_ON_WORKER} actor "
+                f"{ActorID(actor_id_b).hex()[:12]} not on worker "
                 f"{self.sock_path} (hosts: {local})")
         order = self._actor_order[actor_id_b]
         seq = meta["seq_no"]

@@ -22,7 +22,10 @@ from ..exceptions import (ActorDiedError, ActorUnavailableError, RayTpuError,
                           TaskError, WorkerCrashedError)
 
 _RETRYABLE_CAUSES = ("ActorDiedError", "ActorUnavailableError",
-                     "WorkerCrashedError", "ConnectionLost")
+                     "WorkerCrashedError", "ConnectionLost",
+                     # a killed replica's worker socket refuses dials
+                     # in the window before the head reaps it
+                     "ConnectionRefusedError", "ConnectionResetError")
 
 
 def _is_replica_failure(e: Exception) -> bool:
