@@ -415,7 +415,10 @@ class ActorHandle:
             core.on_actor_handle_created(actor_id)
 
     def __del__(self):
-        core = CoreWorker._current
+        try:
+            core = CoreWorker._current
+        except Exception:  # interpreter teardown: module globals gone
+            return
         if core is not None and not core._shutdown:
             try:
                 core.on_actor_handle_deleted(self._actor_id)

@@ -2722,8 +2722,12 @@ class CoreWorker:
             real_cls = getattr(cls, "__rt_actor_class__", cls)
             return real_cls(*args, **kwargs)
 
-        instance = await loop.run_in_executor(self._exec_pool, _make)
+        # Clear the tombstone BEFORE construction: the head has
+        # re-assigned this actor here, so tasks that race the (possibly
+        # slow) constructor must take the registration grace wait, not
+        # the tombstone fast-fail.
         self._actors_gone.discard(actor_id_b)
+        instance = await loop.run_in_executor(self._exec_pool, _make)
         self._actors_local[actor_id_b] = instance
         maxc = meta.get("max_concurrency", 1)
         self._actor_executors[actor_id_b] = concurrent.futures.ThreadPoolExecutor(
@@ -3182,6 +3186,8 @@ class CoreWorker:
             while instance is None and \
                     asyncio.get_running_loop().time() < deadline:
                 await asyncio.sleep(0.02)
+                if actor_id_b in self._actors_gone:
+                    break  # tombstoned mid-wait: fail fast below
                 instance = self._actors_local.get(actor_id_b)
         if instance is None:
             local = [ActorID(a).hex()[:12] for a in self._actors_local]

@@ -32,8 +32,16 @@ def _is_replica_failure(e: Exception) -> bool:
     if isinstance(e, (ActorDiedError, ActorUnavailableError,
                       WorkerCrashedError)):
         return True
-    return (isinstance(e, TaskError)
-            and getattr(e, "cause_type", "") in _RETRYABLE_CAUSES)
+    if not isinstance(e, TaskError):
+        return False
+    if getattr(e, "cause_type", "") in _RETRYABLE_CAUSES:
+        return True
+    # Stale-route rejection: the worker invalidates its route cache and
+    # explicitly delegates the retry to this layer (core/worker.py
+    # ACTOR_NOT_ON_WORKER handling) — the replica moved, it didn't fail.
+    from ..core.worker import ACTOR_NOT_ON_WORKER
+
+    return ACTOR_NOT_ON_WORKER in str(e)
 from .config import SERVE_CONTROLLER_NAME
 
 _routers: Dict[Tuple[str, str], "Router"] = {}

@@ -27,6 +27,11 @@ from .schedulers import (  # noqa: F401
     ResourceChangingScheduler,
     TrialScheduler,
 )
+from .external import (  # noqa: F401
+    ExternalSearcher,
+    OptunaSearch,
+    SimpleOptSearch,
+)
 from .search import (  # noqa: F401
     BayesOptSearch,
     BasicVariantGenerator,

@@ -1,0 +1,246 @@
+"""External-searcher adapter surface.
+
+Reference: ``python/ray/tune/search/optuna/optuna_search.py:1`` (and its
+siblings ``hyperopt/``, ``ax/``, ``bohb/``) — each wraps a third-party
+ask/tell optimizer behind the Tune ``Searcher`` protocol by
+
+  1. converting the Tune search-space DSL into the library's own
+     distribution objects (``convert_search_space``),
+  2. asking the library for the next point per trial (``suggest``),
+  3. telling it the observed objective on completion
+     (``on_trial_complete``), and
+  4. snapshotting the library's internal state (``save``/``restore``).
+
+This module rebuilds that surface for ray_tpu: :class:`ExternalSearcher`
+is the adapter ABC; :class:`SimpleOptSearch` is a concrete adapter over
+the vendored :mod:`ray_tpu.tune.simpleopt` optimizer (the environment is
+zero-egress, so a small in-tree library stands in for optuna — the point
+is the extension seam, not the optimizer); :class:`OptunaSearch` shows
+the import-gated pattern a real third-party adapter uses and raises a
+actionable error when the library is absent.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+from .search import (Categorical, Domain, Float, Function, GridSearch,
+                     Integer, Searcher)
+
+
+def flatten_space(param_space: Dict[str, Any],
+                  sep: str = "/") -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Split a nested param space into flat ``{joined_key: Domain}`` plus
+    flat constants (reference ``tune/utils/util.py`` flatten_dict)."""
+    domains: Dict[str, Any] = {}
+    consts: Dict[str, Any] = {}
+
+    def walk(prefix: str, node: Dict[str, Any]):
+        for k, v in node.items():
+            key = f"{prefix}{sep}{k}" if prefix else str(k)
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    "external searchers do not support grid_search axes; "
+                    "use BasicVariantGenerator for grids")
+            if isinstance(v, dict):
+                walk(key, v)
+            elif isinstance(v, Domain):
+                domains[key] = v
+            else:
+                consts[key] = v
+
+    walk("", param_space or {})
+    return domains, consts
+
+
+def unflatten_config(flat: Dict[str, Any], sep: str = "/") -> Dict[str, Any]:
+    cfg: Dict[str, Any] = {}
+    for key, val in flat.items():
+        node = cfg
+        parts = key.split(sep)
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return cfg
+
+
+class ExternalSearcher(Searcher):
+    """Adapter ABC wrapping a third-party ask/tell optimizer.
+
+    Subclasses implement the three library-facing hooks; the base class
+    owns the Tune-facing protocol (space conversion, per-trial pending
+    bookkeeping, metric orientation, warm start, save/restore):
+
+    - :meth:`_setup` — receive the converted (flat) domain dict and
+      construct the library's study/optimizer object.
+    - :meth:`_ask` — return the next flat ``{key: value}`` point.
+    - :meth:`_tell` — report one observation ``(flat_point, value)``
+      where ``value`` is already oriented so larger is better.
+
+    Mirrors the reference adapter contract
+    (``optuna_search.py:477,525`` suggest/on_trial_complete shape).
+    """
+
+    def __init__(self, metric: str, mode: str = "max"):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self._domains: Dict[str, Domain] = {}
+        self._consts: Dict[str, Any] = {}
+        self._pending: Dict[str, Dict[str, Any]] = {}  # trial_id -> flat point
+
+    # -- library-facing hooks -------------------------------------------
+    def _setup(self, domains: Dict[str, Domain]) -> None:
+        raise NotImplementedError
+
+    def _ask(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _tell(self, point: Dict[str, Any], value: float,
+              error: bool = False) -> None:
+        raise NotImplementedError
+
+    # -- optional state hooks (default: pickle everything) --------------
+    def _get_state(self) -> Any:
+        return self.__dict__.copy()
+
+    def _set_state(self, state: Any) -> None:
+        self.__dict__.update(state)
+
+    # -- Tune-facing protocol -------------------------------------------
+    def set_search_space(self, param_space):
+        super().set_search_space(param_space)
+        self._domains, self._consts = flatten_space(param_space)
+        if not self._domains:
+            raise ValueError(
+                f"{type(self).__name__} needs at least one Domain axis")
+        self._setup(self._domains)
+
+    def suggest(self, trial_id):
+        point = self._ask()
+        self._pending[trial_id] = point
+        flat = dict(self._consts)
+        flat.update(point)
+        return unflatten_config(flat)
+
+    def register_trial(self, trial_id: str, config: Dict[str, Any]):
+        """Adopt a restored trial: re-derive its flat point so the
+        eventual on_trial_complete tells the library a truthful pair."""
+        flat, _ = {}, None
+
+        def walk(prefix, node):
+            for k, v in node.items():
+                key = f"{prefix}/{k}" if prefix else str(k)
+                if isinstance(v, dict):
+                    walk(key, v)
+                else:
+                    flat[key] = v
+
+        walk("", config or {})
+        self._pending[trial_id] = {
+            k: flat[k] for k in self._domains if k in flat}
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        point = self._pending.pop(trial_id, None)
+        if point is None:
+            return
+        if error or result is None or result.get(self.metric) is None:
+            self._tell(point, float("nan"), error=True)
+            return
+        val = float(result[self.metric])
+        self._tell(point, val if self.mode == "max" else -val)
+
+    def add_evaluated_point(self, config: Dict[str, Any], value: float):
+        """Warm start from a prior observation (reference
+        ``optuna_search.py:557`` add_evaluated_point)."""
+        self.register_trial("__warm__", config)
+        point = self._pending.pop("__warm__", None)
+        if point:
+            self._tell(point, value if self.mode == "max" else -value)
+
+    def save(self, checkpoint_path: str):
+        with open(checkpoint_path, "wb") as f:
+            pickle.dump(self._get_state(), f)
+
+    def restore(self, checkpoint_path: str):
+        with open(checkpoint_path, "rb") as f:
+            self._set_state(pickle.load(f))
+
+
+class SimpleOptSearch(ExternalSearcher):
+    """Concrete adapter over the vendored :mod:`simpleopt` optimizer.
+
+    Plays the role OptunaSearch plays in the reference: translate the
+    Tune DSL into simpleopt distributions, drive its ask/tell Study, and
+    round-trip its state through save/restore.
+    """
+
+    def __init__(self, metric: str, mode: str = "max", *,
+                 seed: Optional[int] = None, exploit_prob: float = 0.5):
+        super().__init__(metric, mode)
+        self.seed = seed
+        self.exploit_prob = exploit_prob
+        self._study = None
+
+    def _setup(self, domains):
+        from . import simpleopt as so
+
+        dists: Dict[str, so.Distribution] = {}
+        for key, dom in domains.items():
+            if isinstance(dom, Float):
+                dists[key] = so.FloatDist(dom.low, dom.high, log=dom.log)
+            elif isinstance(dom, Integer):
+                dists[key] = so.IntDist(dom.low, dom.high)
+            elif isinstance(dom, Categorical):
+                dists[key] = so.CatDist(dom.categories)
+            elif isinstance(dom, Function):
+                raise ValueError(
+                    "SimpleOptSearch cannot model sample_from axes")
+            else:
+                raise ValueError(f"unsupported domain {type(dom).__name__}")
+        self._study = so.Study(dists, seed=self.seed,
+                               exploit_prob=self.exploit_prob)
+
+    def _ask(self):
+        return self._study.ask()
+
+    def _tell(self, point, value, error=False):
+        if not error:
+            self._study.tell(point, value)
+
+    @property
+    def best(self) -> Optional[Tuple[Dict[str, Any], float]]:
+        """Best observed (config, value) in the USER's metric
+        orientation (the study maximizes an internally-negated value
+        under mode='min')."""
+        if not self._study or self._study.best is None:
+            return None
+        cfg, val = self._study.best
+        return (cfg, val if self.mode == "max" else -val)
+
+
+class OptunaSearch(ExternalSearcher):
+    """Import-gated adapter skeleton for optuna (reference
+    ``optuna_search.py:30-41`` try-import pattern). The environment is
+    zero-egress, so optuna is absent; constructing this class raises the
+    same actionable error the reference raises, and the conversion table
+    documents the mapping a wired adapter uses."""
+
+    #: Tune DSL -> optuna distribution constructor names.
+    CONVERSION = {
+        "Float": "FloatDistribution",
+        "Integer": "IntDistribution",
+        "Categorical": "CategoricalDistribution",
+    }
+
+    def __init__(self, metric: str, mode: str = "max", **kwargs):
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires `optuna` (pip install optuna). "
+                "In zero-egress environments use SimpleOptSearch, which "
+                "implements the same adapter surface over the vendored "
+                "simpleopt optimizer.") from e
+        super().__init__(metric, mode)  # pragma: no cover

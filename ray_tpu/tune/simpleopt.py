@@ -1,0 +1,125 @@
+"""simpleopt — a tiny standalone ask/tell optimizer.
+
+This is a VENDORED third-party-style library: it knows nothing about
+ray_tpu (no imports from the package), has its own distribution types
+and ask/tell Study API, and exists so :class:`ray_tpu.tune.external.
+SimpleOptSearch` can demonstrate the external-searcher adapter seam in
+a zero-egress environment (the role optuna plays for the reference's
+``python/ray/tune/search/optuna/optuna_search.py:1``).
+
+Algorithm: seeded random search with best-point exploitation — after a
+handful of observations, with probability ``exploit_prob`` a new ask
+perturbs the best seen point (Gaussian in the unit interval per axis,
+shrinking with observation count) instead of sampling uniformly. Not a
+serious optimizer; a serious *API*.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Distribution:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    def perturb(self, value: Any, scale: float, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class FloatDist(Distribution):
+    def __init__(self, low: float, high: float, log: bool = False):
+        if not low < high:
+            raise ValueError("low must be < high")
+        if log and low <= 0:
+            raise ValueError("log distribution needs low > 0")
+        self.low, self.high, self.log = float(low), float(high), log
+
+    def _to_unit(self, v: float) -> float:
+        if self.log:
+            return ((math.log(v) - math.log(self.low))
+                    / (math.log(self.high) - math.log(self.low)))
+        return (v - self.low) / (self.high - self.low)
+
+    def _from_unit(self, u: float) -> float:
+        u = min(1.0, max(0.0, u))
+        if self.log:
+            return math.exp(math.log(self.low) +
+                            u * (math.log(self.high) - math.log(self.low)))
+        return self.low + u * (self.high - self.low)
+
+    def sample(self, rng):
+        return self._from_unit(rng.random())
+
+    def perturb(self, value, scale, rng):
+        return self._from_unit(self._to_unit(value) + rng.gauss(0, scale))
+
+
+class IntDist(Distribution):
+    """Integer range, high exclusive (python range convention)."""
+
+    def __init__(self, low: int, high: int):
+        if not low < high:
+            raise ValueError("low must be < high")
+        self.low, self.high = int(low), int(high)
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+    def perturb(self, value, scale, rng):
+        span = max(1.0, (self.high - self.low) * scale)
+        v = int(round(value + rng.gauss(0, span)))
+        return min(self.high - 1, max(self.low, v))
+
+
+class CatDist(Distribution):
+    def __init__(self, choices: List[Any]):
+        if not choices:
+            raise ValueError("choices must be non-empty")
+        self.choices = list(choices)
+
+    def sample(self, rng):
+        return rng.choice(self.choices)
+
+    def perturb(self, value, scale, rng):
+        # With prob ~scale jump to a different category, else keep.
+        if rng.random() < max(0.1, scale) and len(self.choices) > 1:
+            others = [c for c in self.choices if c != value]
+            return rng.choice(others)
+        return value
+
+
+class Study:
+    """Ask/tell optimization session over a dict of named distributions."""
+
+    MIN_OBS_TO_EXPLOIT = 4
+
+    def __init__(self, distributions: Dict[str, Distribution], *,
+                 seed: Optional[int] = None, exploit_prob: float = 0.5):
+        self.distributions = dict(distributions)
+        self.exploit_prob = exploit_prob
+        self._rng = random.Random(seed)
+        self.trials: List[Tuple[Dict[str, Any], float]] = []
+        self.best: Optional[Tuple[Dict[str, Any], float]] = None
+
+    def ask(self) -> Dict[str, Any]:
+        if (self.best is not None
+                and len(self.trials) >= self.MIN_OBS_TO_EXPLOIT
+                and self._rng.random() < self.exploit_prob):
+            scale = 0.3 / math.sqrt(len(self.trials))
+            return {k: d.perturb(self.best[0][k], scale, self._rng)
+                    for k, d in self.distributions.items()}
+        return {k: d.sample(self._rng)
+                for k, d in self.distributions.items()}
+
+    def tell(self, point: Dict[str, Any], value: float) -> None:
+        missing = set(self.distributions) - set(point)
+        if missing:
+            raise ValueError(f"point missing axes: {sorted(missing)}")
+        value = float(value)
+        if value != value:  # NaN observations are discarded
+            return
+        self.trials.append((dict(point), value))
+        if self.best is None or value > self.best[1]:
+            self.best = (dict(point), value)
