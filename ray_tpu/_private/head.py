@@ -163,6 +163,9 @@ class HeadService:
         self._pull_claims: Dict[tuple, tuple] = {}
         self._pending_leases: deque = deque()  # (req, pg_meta, strategy, fut)
         self._registration_waiters: Dict[WorkerID, asyncio.Future] = {}
+        # Workers killed after a registration timeout whose in-flight
+        # register RPC may still arrive; insertion-ordered for pruning.
+        self._doomed_workers: Dict[WorkerID, None] = {}
         self._subs: Dict[str, List[rpc.Connection]] = defaultdict(list)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._reaper_task = None
@@ -803,6 +806,19 @@ class HeadService:
 
     # ------------------------------------------------------------- workers
     async def _spawn_worker(self, node: NodeInfo) -> WorkerInfo:
+        """Spawn with one retry on registration timeout: under heavy
+        host load a fresh interpreter can miss the lease window while
+        importing — a transient condition that must not fail the user's
+        task when a second attempt would land (the stuck first process
+        is killed before the retry)."""
+        try:
+            return await self._spawn_worker_once(node)
+        except RuntimeError as e:
+            if "failed to register" not in str(e):
+                raise
+            return await self._spawn_worker_once(node)
+
+    async def _spawn_worker_once(self, node: NodeInfo) -> WorkerInfo:
         worker_id = WorkerID.from_random()
         fut = self._loop.create_future()
         self._registration_waiters[worker_id] = fut
@@ -812,17 +828,21 @@ class HeadService:
                 log = open(os.path.join(self.session_dir, "logs",
                                         f"worker-{worker_id.hex()[:12]}.log"),
                            "ab")
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu._private.worker_main",
-                     "--session-dir", self.session_dir,
-                     "--worker-id", worker_id.hex(),
-                     "--node-id", self.node_id.hex(),
-                     "--head-sock", self.sock_path],
-                    stdout=log, stderr=subprocess.STDOUT,
-                    env={**self._spawn_env,
-                         reaper.EXPECTED_PPID_ENV: str(os.getpid())},
-                    cwd=os.getcwd(),
-                )
+                try:
+                    proc = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "ray_tpu._private.worker_main",
+                         "--session-dir", self.session_dir,
+                         "--worker-id", worker_id.hex(),
+                         "--node-id", self.node_id.hex(),
+                         "--head-sock", self.sock_path],
+                        stdout=log, stderr=subprocess.STDOUT,
+                        env={**self._spawn_env,
+                             reaper.EXPECTED_PPID_ENV: str(os.getpid())},
+                        cwd=os.getcwd(),
+                    )
+                finally:
+                    log.close()  # the child holds its own dup of the fd
             else:
                 await node.conn.call_simple(
                     "spawn_worker", {"worker_id": worker_id.hex()},
@@ -831,8 +851,21 @@ class HeadService:
                 fut, timeout=self.config.worker_lease_timeout_s
             )
         except asyncio.TimeoutError:
+            # A late register RPC from this (now killed) worker must not
+            # be adopted into the idle pool as a corpse.
+            self._doomed_workers[worker_id] = None
+            while len(self._doomed_workers) > 1024:
+                self._doomed_workers.pop(
+                    next(iter(self._doomed_workers)), None)
             if proc is not None:
                 proc.kill()
+                try:
+                    # SIGKILL'd child reaps near-instantly; waiting here
+                    # avoids accumulating zombies for the head's life.
+                    await self._loop.run_in_executor(
+                        None, lambda: proc.wait(timeout=5))
+                except Exception:  # noqa: BLE001
+                    pass
             elif node.conn is not None:
                 # Remote spawn: tell the node daemon to reap the stuck
                 # process so it doesn't linger unregistered.
@@ -1035,6 +1068,14 @@ class HeadService:
 
     async def _rpc_register_worker(self, payload, bufs):
         worker_id = WorkerID.from_hex(payload["worker_id"])
+        if worker_id in self._doomed_workers:
+            # Registered after its spawn timed out and it was killed:
+            # the process is (about to be) dead — adopting it into the
+            # idle pool would hand tasks to a corpse.
+            del self._doomed_workers[worker_id]
+            raise rpc.RpcError(
+                f"worker {worker_id.hex()[:12]} was reaped after a "
+                f"registration timeout; not adopting")
         address = payload["address"]
         if isinstance(address, list):
             address = tuple(address)

@@ -21,7 +21,12 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-_groups: Dict[str, "BaseGroup"] = {}
+# Keyed by (group_name, rank): rank identity belongs to the CALLER
+# (usually an actor), not the process — the head may co-locate several
+# actors of one gang in a single worker process, and each must hold its
+# own group object (store-backed groups talk through the object plane,
+# so same-process ranks work fine).
+_groups: Dict[tuple, "BaseGroup"] = {}
 _lock = threading.Lock()
 
 
@@ -52,7 +57,7 @@ class BaseGroup:
     def recv(self, shape=None, dtype=None, src_rank: int = 0, tag: int = 0):
         raise NotImplementedError
 
-    def destroy(self):
+    def destroy(self, local_only: bool = False):
         pass
 
 
@@ -423,9 +428,23 @@ class StoreGroup(BaseGroup):
         self._core.kv_del(key, ns="collective")  # consume
         return val
 
-    def destroy(self):
+    def _is_own_key(self, key: str) -> bool:
+        """True when this rank published the key: slot keys end in
+        ``/{rank}``; p2p keys carry ``{src}>{dst}``."""
+        parts = key.split("/")
+        if len(parts) > 2 and parts[2] == "p2p":
+            src, _, dst = parts[3].partition(">")
+            return str(self.rank) in (src, dst)
+        return parts[-1] == str(self.rank)
+
+    def destroy(self, local_only: bool = False):
+        """Tear down group state. ``local_only`` removes just THIS
+        rank's published keys — a single rank leaving must not wipe
+        slots other (possibly co-located) ranks still serve."""
         for key in self._core.kv_keys(f"__coll__/{self.name}/",
                                       ns="collective"):
+            if local_only and not self._is_own_key(key):
+                continue
             try:
                 self._core.kv_del(key, ns="collective")
             except Exception:  # noqa: BLE001
@@ -473,13 +492,14 @@ def init_collective_group(world_size: int, rank: int, *,
                           mesh=None, axis: str = "dp") -> BaseGroup:
     """Join/declare a collective group (reference ``collective.py:151``)."""
     with _lock:
-        if group_name in _groups:
-            g = _groups[group_name]
-            if (g.world_size, g.rank) != (world_size, rank):
+        key = (group_name, rank)
+        if key in _groups:
+            g = _groups[key]
+            if g.world_size != world_size:
                 raise ValueError(
-                    f"group {group_name!r} already exists with "
-                    f"world_size={g.world_size} rank={g.rank}; destroy it "
-                    f"before re-creating with different membership")
+                    f"group {group_name!r} rank {rank} already exists "
+                    f"with world_size={g.world_size}; destroy it before "
+                    f"re-creating with different membership")
             return g
         if backend == "xla":
             if mesh is None:
@@ -491,48 +511,79 @@ def init_collective_group(world_size: int, rank: int, *,
             g = StoreGroup(group_name, world_size, rank)
         else:
             raise ValueError(f"unknown backend {backend!r}")
-        _groups[group_name] = g
+        _groups[key] = g
         return g
 
 
-def get_group(group_name: str = "default") -> BaseGroup:
-    g = _groups.get(group_name)
-    if g is None:
-        raise KeyError(f"collective group {group_name!r} not initialized")
-    return g
-
-
-def destroy_collective_group(group_name: str = "default"):
+def get_group(group_name: str = "default",
+              rank: Optional[int] = None) -> BaseGroup:
+    """Look up a joined group. ``rank`` disambiguates when a process
+    hosts several ranks of the same group (co-located gang actors)."""
     with _lock:
-        g = _groups.pop(group_name, None)
-        if g:
-            g.destroy()
+        if rank is not None:
+            g = _groups.get((group_name, rank))
+            if g is None:
+                raise KeyError(f"collective group {group_name!r} rank "
+                               f"{rank} not initialized")
+            return g
+        local = [g for (n, _r), g in _groups.items() if n == group_name]
+    if not local:
+        raise KeyError(f"collective group {group_name!r} not initialized")
+    if len(local) > 1:
+        raise KeyError(
+            f"collective group {group_name!r} has {len(local)} ranks in "
+            f"this process; pass rank= to disambiguate")
+    return local[0]
 
 
-def allreduce(x, op: str = "sum", group_name: str = "default"):
-    return get_group(group_name).allreduce(x, op)
+def destroy_collective_group(group_name: str = "default",
+                             rank: Optional[int] = None):
+    """Tear down group membership. With no ``rank`` this is the full
+    collective destructor (reference semantics — every local rank drops
+    and shared state is wiped); ``rank=N`` means ONE rank leaves, which
+    must only remove that rank's own published state so other (possibly
+    co-located) ranks keep working."""
+    with _lock:
+        keys = [k for k in _groups
+                if k[0] == group_name and (rank is None or k[1] == rank)]
+        dropped = [_groups.pop(k) for k in keys]
+    for g in dropped:
+        g.destroy(local_only=rank is not None)
 
 
-def allgather(x, group_name: str = "default"):
-    return get_group(group_name).allgather(x)
+# ``rank=`` on every wrapper disambiguates when a process hosts several
+# ranks of the group (co-located gang actors); single-rank processes —
+# the common case — omit it.
+def allreduce(x, op: str = "sum", group_name: str = "default",
+              rank: Optional[int] = None):
+    return get_group(group_name, rank).allreduce(x, op)
 
 
-def reducescatter(x, op: str = "sum", group_name: str = "default"):
-    return get_group(group_name).reducescatter(x, op)
+def allgather(x, group_name: str = "default",
+              rank: Optional[int] = None):
+    return get_group(group_name, rank).allgather(x)
 
 
-def broadcast(x, src_rank: int = 0, group_name: str = "default"):
-    return get_group(group_name).broadcast(x, src_rank)
+def reducescatter(x, op: str = "sum", group_name: str = "default",
+                  rank: Optional[int] = None):
+    return get_group(group_name, rank).reducescatter(x, op)
 
 
-def barrier(group_name: str = "default"):
-    return get_group(group_name).barrier()
+def broadcast(x, src_rank: int = 0, group_name: str = "default",
+              rank: Optional[int] = None):
+    return get_group(group_name, rank).broadcast(x, src_rank)
 
 
-def send(x, dst_rank: int, group_name: str = "default", tag: int = 0):
-    return get_group(group_name).send(x, dst_rank, tag)
+def barrier(group_name: str = "default", rank: Optional[int] = None):
+    return get_group(group_name, rank).barrier()
+
+
+def send(x, dst_rank: int, group_name: str = "default", tag: int = 0,
+         rank: Optional[int] = None):
+    return get_group(group_name, rank).send(x, dst_rank, tag)
 
 
 def recv(shape=None, dtype=None, src_rank: int = 0,
-         group_name: str = "default", tag: int = 0):
-    return get_group(group_name).recv(shape, dtype, src_rank, tag)
+         group_name: str = "default", tag: int = 0,
+         rank: Optional[int] = None):
+    return get_group(group_name, rank).recv(shape, dtype, src_rank, tag)

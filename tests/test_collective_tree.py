@@ -97,3 +97,57 @@ def test_many_generations_gc_bounded(rt_cluster):
     for o in outs:
         assert o["slots_gens"] <= cap, o
         assert o["held_gens"] <= cap, o
+
+
+def test_colocated_ranks_share_a_process(rt_cluster):
+    """The head may pack two gang actors into ONE worker process; each
+    rank must then hold its own group object (regression: the registry
+    was keyed by name alone and the second rank's init exploded with
+    'already exists'). Reference semantics: rank identity belongs to
+    the caller, not the process."""
+    import pytest
+
+    from ray_tpu.collective import collective as C
+
+    g0 = C.init_collective_group(2, 0, backend="store", group_name="colo")
+    g1 = C.init_collective_group(2, 1, backend="store", group_name="colo")
+    assert g0 is not g1 and (g0.rank, g1.rank) == (0, 1)
+    # re-join is idempotent per (name, rank)
+    assert C.init_collective_group(2, 0, backend="store",
+                                   group_name="colo") is g0
+    # same rank, different world: still rejected
+    with pytest.raises(ValueError, match="already exists"):
+        C.init_collective_group(8, 0, backend="store", group_name="colo")
+    # ambiguous bare lookup names the problem; rank= disambiguates
+    with pytest.raises(KeyError, match="pass rank="):
+        C.get_group("colo")
+    assert C.get_group("colo", rank=1) is g1
+
+    # the two co-located ranks can actually COMMUNICATE (store-backed
+    # groups talk through the object plane, not process state); payload
+    # > INLINE_MAX so real slots are published
+    import threading
+
+    out = {}
+
+    def run(g):
+        out[g.rank] = g.allreduce(
+            np.full((4096,), float(g.rank + 1)))
+
+    ts = [threading.Thread(target=run, args=(g,)) for g in (g0, g1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert np.allclose(out[0], 3.0) and np.allclose(out[1], 3.0)
+
+    # ONE rank leaving must not wipe the other's published state
+    C.destroy_collective_group("colo", rank=0)
+    assert C.get_group("colo") is g1  # one rank left: bare lookup works
+    survivors = g1._core.kv_keys("__coll__/colo/", ns="collective")
+    assert survivors, "rank-0 destroy wiped rank-1's keys"
+    assert all(g1._is_own_key(k) for k in survivors), survivors
+    C.destroy_collective_group("colo")  # full destructor wipes the rest
+    with pytest.raises(KeyError, match="not initialized"):
+        C.get_group("colo")
+    assert not g1._core.kv_keys("__coll__/colo/", ns="collective")
