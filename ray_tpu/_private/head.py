@@ -1085,6 +1085,17 @@ class HeadService:
         # The registering connection is the one this call arrived on; we
         # instead open a dedicated control connection to the worker.
         info.conn = await rpc.connect(address, self._handle)
+        if worker_id in self._doomed_workers:
+            # The spawn timed out (and the process was killed) WHILE we
+            # were connecting — same corpse, later window.
+            del self._doomed_workers[worker_id]
+            try:
+                await info.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            raise rpc.RpcError(
+                f"worker {worker_id.hex()[:12]} was reaped after a "
+                f"registration timeout; not adopting")
         self.workers[worker_id] = info
         # Reattach after a head restart: the worker announces the actors
         # it still hosts; RESTARTING records flip back to ALIVE. An

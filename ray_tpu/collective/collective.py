@@ -429,12 +429,15 @@ class StoreGroup(BaseGroup):
         return val
 
     def _is_own_key(self, key: str) -> bool:
-        """True when this rank published the key: slot keys end in
-        ``/{rank}``; p2p keys carry ``{src}>{dst}``."""
+        """True when the key belongs to THIS rank's lifecycle: slot
+        keys it published (ending in ``/{rank}``) and p2p messages it
+        CONSUMES (``{src}>{rank}``). A p2p message this rank SENT to a
+        survivor (``{rank}>{dst}``) is the receiver's property — a
+        completed send must stay deliverable after the sender leaves."""
         parts = key.split("/")
         if len(parts) > 2 and parts[2] == "p2p":
-            src, _, dst = parts[3].partition(">")
-            return str(self.rank) in (src, dst)
+            _src, _, dst = parts[3].partition(">")
+            return dst == str(self.rank)
         return parts[-1] == str(self.rank)
 
     def destroy(self, local_only: bool = False):
@@ -547,8 +550,11 @@ def destroy_collective_group(group_name: str = "default",
         keys = [k for k in _groups
                 if k[0] == group_name and (rank is None or k[1] == rank)]
         dropped = [_groups.pop(k) for k in keys]
-    for g in dropped:
-        g.destroy(local_only=rank is not None)
+    for i, g in enumerate(dropped):
+        # Full destroy: the first group wipes the shared prefix; the
+        # rest only drop their local refs (their scan finds nothing —
+        # no point issuing N identical delete rounds).
+        g.destroy(local_only=rank is not None or i > 0)
 
 
 # ``rank=`` on every wrapper disambiguates when a process hosts several

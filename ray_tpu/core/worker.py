@@ -3175,19 +3175,30 @@ class CoreWorker:
     async def _run_actor_task(self, meta, conn=None):
         actor_id_b = meta["actor_id"]
         instance = self._actors_local.get(actor_id_b)
-        if instance is None and actor_id_b not in self._actors_gone:
+        if instance is None:
             # The head routes tasks here the moment it ASSIGNS the
             # actor; the instance lands in _actors_local only when the
             # constructor finishes on another thread. Waiting briefly
             # turns that registration race into a short stall instead
-            # of a spurious routing failure. Actors KNOWN to have left
-            # (tombstoned) fail fast below instead of stalling 5s.
-            deadline = asyncio.get_running_loop().time() + 5.0
-            while instance is None and \
-                    asyncio.get_running_loop().time() < deadline:
+            # of a spurious routing failure. A TOMBSTONED actor
+            # (known to have left) usually means a stale route — but
+            # the head may also be restarting the actor on THIS worker
+            # and its create can land after the task (observed in
+            # suite runs: the error's host list contained the very
+            # actor it rejected). So tombstoned actors get a short
+            # grace instead of none, extended to the full grace the
+            # moment the create clears the tombstone.
+            now = asyncio.get_running_loop().time
+            tombstoned = actor_id_b in self._actors_gone
+            deadline = now() + (1.0 if tombstoned else 5.0)
+            while instance is None and now() < deadline:
                 await asyncio.sleep(0.02)
-                if actor_id_b in self._actors_gone:
-                    break  # tombstoned mid-wait: fail fast below
+                gone = actor_id_b in self._actors_gone
+                if tombstoned and not gone:
+                    tombstoned = False   # create arrived: full grace
+                    deadline = now() + 5.0
+                elif gone and not tombstoned:
+                    break                # died mid-wait: fail fast
                 instance = self._actors_local.get(actor_id_b)
         if instance is None:
             local = [ActorID(a).hex()[:12] for a in self._actors_local]

@@ -141,12 +141,15 @@ def test_colocated_ranks_share_a_process(rt_cluster):
         t.join(timeout=60)
     assert np.allclose(out[0], 3.0) and np.allclose(out[1], 3.0)
 
-    # ONE rank leaving must not wipe the other's published state
+    # ONE rank leaving must not wipe the other's published state, and
+    # a completed send must stay deliverable after the sender leaves
+    g0.send(np.arange(4.0), dst_rank=1)
     C.destroy_collective_group("colo", rank=0)
     assert C.get_group("colo") is g1  # one rank left: bare lookup works
     survivors = g1._core.kv_keys("__coll__/colo/", ns="collective")
     assert survivors, "rank-0 destroy wiped rank-1's keys"
     assert all(g1._is_own_key(k) for k in survivors), survivors
+    assert np.allclose(g1.recv(src_rank=0), np.arange(4.0))
     C.destroy_collective_group("colo")  # full destructor wipes the rest
     with pytest.raises(KeyError, match="not initialized"):
         C.get_group("colo")
