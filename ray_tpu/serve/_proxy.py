@@ -32,6 +32,7 @@ class ProxyActor:
     def __init__(self):
         self._routes: Dict[str, dict] = {}
         self._routes_at = 0.0
+        self._miss_refresh_at = 0.0
         self._routes_lock = threading.Lock()
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="rt-serve-proxy")
@@ -85,10 +86,10 @@ class ProxyActor:
         return self._port
 
     # ------------------------------------------------------------- routing
-    def _get_routes(self) -> Dict[str, dict]:
+    def _get_routes(self, force: bool = False) -> Dict[str, dict]:
         now = time.monotonic()
         with self._routes_lock:
-            if now - self._routes_at < self.ROUTES_TTL_S:
+            if not force and now - self._routes_at < self.ROUTES_TTL_S:
                 return self._routes
         from .. import api as rt
 
@@ -101,6 +102,22 @@ class ProxyActor:
         except Exception:  # noqa: BLE001 - keep stale routes
             pass
         return self._routes
+
+    def _refresh_on_miss(self) -> bool:
+        """A just-deployed app can miss the (≤TTL-old) cached table —
+        ``serve.run`` returns when the CONTROLLER is ready, and proxies
+        learn asynchronously. One forced refresh before answering 404
+        makes fresh routes visible immediately; rate-limited so a 404
+        flood cannot hammer the controller. Returns whether a refresh
+        actually ran (False = rate-limited, a re-match is pointless).
+        Blocking — callers on the accept loop must run it in the pool."""
+        now = time.monotonic()
+        with self._routes_lock:
+            if now - self._miss_refresh_at < 0.05:
+                return False
+            self._miss_refresh_at = now
+        self._get_routes(force=True)
+        return True
 
     def _match(self, path: str) -> Optional[dict]:
         routes = self._get_routes()
@@ -202,10 +219,16 @@ class ProxyActor:
                  for p, t in self._get_routes().items()}).encode()
         if req.path == "/-/healthz":
             return 200, "text/plain", b"ok"
+        loop = asyncio.get_running_loop()
         target = self._match(req.path)
         if target is None:
+            # Off-loop: the forced refresh blocks on a controller RPC
+            # and must not stall the accept loop (or /-/healthz).
+            if await loop.run_in_executor(self._pool,
+                                          self._refresh_on_miss):
+                target = self._match(req.path)
+        if target is None:
             return 404, "text/plain", b"no application at this route"
-        loop = asyncio.get_running_loop()
         if target.get("stream"):
             try:
                 gen, span = await asyncio.wait_for(
@@ -326,16 +349,21 @@ class ProxyActor:
 
     def _grpc_target(self, app_name: Optional[str],
                      method: str) -> Optional[dict]:
-        routes = self._get_routes()
-        if app_name:
-            for prefix, t in routes.items():
-                if t["app"] == app_name:
-                    return {**t, "prefix": prefix}
-            return None
-        seg = method.strip("/").split("/", 1)[0].split(".")[0]
-        for prefix, t in routes.items():
-            if t["app"] == seg or prefix.strip("/") == seg:
-                return {**t, "prefix": prefix}
+        for attempt in range(2):
+            routes = self._get_routes()
+            if app_name:
+                for prefix, t in routes.items():
+                    if t["app"] == app_name:
+                        return {**t, "prefix": prefix}
+            else:
+                seg = method.strip("/").split("/", 1)[0].split(".")[0]
+                for prefix, t in routes.items():
+                    if t["app"] == seg or prefix.strip("/") == seg:
+                        return {**t, "prefix": prefix}
+            # gRPC handlers run on worker threads, so the blocking
+            # refresh is safe here; skip the re-scan if rate-limited.
+            if attempt == 0 and not self._refresh_on_miss():
+                break
         return None
 
     def _grpc_request(self, method: str, data: bytes, context) -> Request:
