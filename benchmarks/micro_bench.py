@@ -53,6 +53,56 @@ def report(metric: str, value: float):
                       "vs_baseline": round(value / base, 3)}), flush=True)
 
 
+def bench_calibration(scale: int = 1):
+    """Fixed CPU-bound calibration rows (VERDICT r5 weak #4): a pure
+    single-core busyloop score and a same-host IPC ping-pong RTT rate,
+    neither touching the runtime. Recorded in EVERY microbench artifact
+    so cross-boot comparisons of runtime rows (``head_vs_reference``)
+    become arithmetic — divide by the calibration ratio instead of
+    asserting 'the boot was slower'."""
+    import socket
+    import multiprocessing as mp
+
+    n = 2_000_000 // scale
+    x = 0
+    t0 = time.perf_counter()
+    for i in range(n):
+        x += i & 7  # fixed integer work; immune to dict/alloc noise
+    busy = n / (time.perf_counter() - t0)
+    print(json.dumps({"metric": "calibration_busyloop",
+                      "value": round(busy, 1), "unit": "iters/s",
+                      "calibration": True}), flush=True)
+
+    a, b = socket.socketpair()
+
+    def _echo(sock):
+        while True:
+            d = sock.recv(16)
+            if not d or d == b"q":
+                return
+            sock.sendall(d)
+
+    proc = mp.get_context("fork").Process(target=_echo, args=(b,),
+                                          daemon=True)
+    proc.start()
+    b.close()
+    for _ in range(50):  # warm the scheduler handoff
+        a.sendall(b"p")
+        a.recv(16)
+    rounds = 2000 // scale
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        a.sendall(b"p")
+        a.recv(16)
+    pingpong = rounds / (time.perf_counter() - t0)
+    a.sendall(b"q")
+    a.close()
+    proc.join(timeout=5)
+    print(json.dumps({"metric": "calibration_ipc_pingpong",
+                      "value": round(pingpong, 1), "unit": "rtt/s",
+                      "calibration": True}), flush=True)
+
+
 def bench_actor_calls(rt, n_async: int, n_sync: int):
     @rt.remote
     class Echo:
@@ -260,6 +310,8 @@ def main():
 
     import ray_tpu as rt
 
+    # Calibration first, before the runtime exists — pure host numbers.
+    bench_calibration(scale)
     rt.init(num_cpus=16, num_tpus=0, ignore_reinit_error=True)
     bench_tasks(rt, n_async=5000 // scale, n_sync=1000 // scale)
     bench_actor_calls(rt, n_async=5000 // scale, n_sync=2000 // scale)

@@ -1,12 +1,24 @@
-"""Streaming GPT serving benchmark (VERDICT round 2, item 4): a decode-
-loop replica with bucketed prefill and per-token streaming through
-Serve's streaming path (replica generator → handle → chunked HTTP).
+"""Streaming GPT serving benchmark (VERDICT round 2 item 4; round 5
+weak #5): a decode-loop replica with bucketed prefill streaming through
+Serve (replica generator → handle → chunked HTTP), now with an A/B
+chunked-decode mode.
 
-Reports per-stream TTFT (time to first token), per-token latency, and
-aggregate decoded tokens/s as JSON lines.
+``--chunk`` takes a comma-separated list of decode chunk sizes and runs
+the full client load once per size, side by side in one artifact:
 
-Run: ``python benchmarks/serve_gpt.py [--clients 4] [--tokens 32]``
-(CPU fallback shrinks the model so the benchmark completes).
+- ``1``  — the legacy path: one jitted ``decode_step`` dispatch (and
+  one device→host scalar read) per generated token.
+- ``k>1`` — the fused path: ``decode_chunk`` runs k steps in a single
+  jitted ``lax.scan`` dispatch and the replica streams one per-chunk
+  token slice per dispatch.
+
+Per mode, reports per-stream TTFT, amortized per-token latency
+(p50/p95/p99), aggregate decoded tokens/s, and — the dispatch
+amortization itself — jitted dispatches per generated token counted on
+the replica. JSON lines; chunk 1 keeps the legacy metric names.
+
+Run: ``python benchmarks/serve_gpt.py [--clients 4] [--tokens 32]
+[--chunk 1,8]`` (CPU fallback shrinks the model).
 """
 from __future__ import annotations
 
@@ -27,7 +39,11 @@ def main():
     parser.add_argument("--streams", type=int, default=8,
                         help="total streams per client")
     parser.add_argument("--config", default="")
+    parser.add_argument("--chunk", default="1,8",
+                        help="comma-separated decode chunk sizes to A/B "
+                             "(1 = per-token decode_step loop)")
     args = parser.parse_args()
+    chunks = [int(c) for c in args.chunk.split(",") if c.strip()]
 
     import numpy as np
 
@@ -45,10 +61,11 @@ def main():
 
     @serve.deployment(max_ongoing_requests=8)
     class GPTStream:
-        """Decode-loop replica: bucketed prefill (one compile per prompt
-        bucket), then one jitted decode step per streamed token."""
+        """Decode-loop replica. chunk=1: one jitted decode step per
+        streamed token. chunk=k: one fused k-step scan per streamed
+        per-chunk token slice."""
 
-        def __init__(self, cfg_name: str, max_len: int):
+        def __init__(self, cfg_name: str, max_len: int, chunk_sizes):
             from ray_tpu.models import gpt, gpt_decode
 
             self.cfg = gpt.CONFIGS[cfg_name]
@@ -57,6 +74,21 @@ def main():
             self.max_len = max_len
             self._prefill = jax.jit(gpt_decode.prefill, static_argnums=(2,))
             self._step = jax.jit(gpt_decode.decode_step, static_argnums=(3,))
+            self._chunk_steps = {
+                k: gpt_decode.jit_decode_chunk(self.cfg, k)
+                for k in chunk_sizes if k > 1}
+            # Jitted-dispatch accounting for the A/B artifact; locked —
+            # up to max_ongoing_requests threads decode concurrently.
+            import threading as _threading
+
+            self._stats_lock = _threading.Lock()
+            self._dispatches = 0
+            self._tokens = 0
+
+        def _count(self, dispatches: int, tokens: int):
+            with self._stats_lock:
+                self._dispatches += dispatches
+                self._tokens += tokens
 
         def warm(self, prompt_bucket: int, _=None):
             import jax.numpy as jnp
@@ -67,89 +99,171 @@ def main():
                 self.cfg, cache)
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             self._step(self.params, cache, tok, self.cfg)
+            rng = jax.random.PRNGKey(0)
+            for step in self._chunk_steps.values():
+                step(self.params, cache, tok, rng)
             return "warm"
 
+        def reset_stats(self):
+            with self._stats_lock:
+                self._dispatches = 0
+                self._tokens = 0
+            return "reset"
+
+        def stats(self):
+            with self._stats_lock:
+                return {"dispatches": self._dispatches,
+                        "tokens": self._tokens}
+
         def __call__(self, request):
-            """request = {"prompt_len": int, "max_new": int}; yields one
-            token id per step."""
+            """request = {"prompt_len", "max_new", "chunk"}; yields one
+            token id per step (chunk=1) or one token-id list per fused
+            chunk (chunk=k)."""
             import jax.numpy as jnp
 
             if hasattr(request, "json"):  # HTTP ingress
                 request = request.json()
             plen = int(request.get("prompt_len", 16))
             max_new = int(request.get("max_new", 16))
+            chunk = int(request.get("chunk", 1))
             prompt = jnp.asarray(
                 np.random.randint(0, self.cfg.vocab_size, (1, plen),
                                   dtype=np.int32))
             cache = self.gd.init_cache(self.cfg, 1, self.max_len)
             logits, cache = self._prefill(self.params, prompt, self.cfg,
                                           cache)
-            for _ in range(max_new):
-                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                yield int(tok[0])
-                logits, cache = self._step(self.params, cache, tok,
-                                           self.cfg)
+            self._count(1, 0)
+            if chunk <= 1:
+                for _ in range(max_new):
+                    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    self._count(0, 1)
+                    yield int(tok[0])
+                    logits, cache = self._step(self.params, cache, tok,
+                                               self.cfg)
+                    self._count(1, 0)
+                return
+            if max_new <= 0:
+                return
+            # Unlisted chunk size (e.g. ad-hoc HTTP request): jit on
+            # demand instead of dying with a KeyError mid-stream. No
+            # lock: dict get/set are GIL-atomic and jit_decode_chunk is
+            # lru_cached, so racing threads get the same wrapper.
+            step = self._chunk_steps.get(chunk)
+            if step is None:
+                step = self._chunk_steps[chunk] = \
+                    self.gd.jit_decode_chunk(self.cfg, chunk)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            self._count(0, 1)
+            yield [int(tok[0])]
+            # The library driver IS the measured path: decode_until
+            # yields exactly one trimmed slice per fused dispatch.
+            for slice_ in self.gd.decode_until(
+                    step, self.params, cache, tok, max_new - 1):
+                self._count(1, slice_.shape[1])
+                yield [int(t) for t in slice_[0]]
 
-    max_len = 16 + max_new + 8
-    handle = serve.run(GPTStream.bind(cfg_name, max_len),
+    # Cache sized for the worst chunk over-run: the last fused chunk may
+    # execute up to (chunk - 1) steps past max_new before truncation.
+    max_len = 16 + max_new + max(max(chunks), 8)
+    handle = serve.run(GPTStream.bind(cfg_name, max_len, chunks),
                        name="gpt_stream", route_prefix="/generate")
     assert handle.options(method_name="warm").remote(16).result(
         timeout=600) == "warm"
-    # End-to-end warm stream (covers the streaming transport itself).
-    list(handle.options(stream=True).remote(
-        {"prompt_len": 16, "max_new": 2}))
+    # End-to-end warm stream per mode (covers the streaming transport).
+    for c in chunks:
+        list(handle.options(stream=True).remote(
+            {"prompt_len": 16, "max_new": 2, "chunk": c}))
 
-    ttfts, tok_lats = [], []
-    total_tokens = [0]
-    lock = threading.Lock()
-
-    def client():
-        for _ in range(args.streams):
-            t0 = time.perf_counter()
-            gen = handle.options(stream=True).remote(
-                {"prompt_len": 16, "max_new": max_new})
-            last = t0
-            first = None
-            n = 0
-            for _tok in gen:
-                now = time.perf_counter()
-                if first is None:
-                    first = now - t0
-                else:
-                    tok_lats.append(now - last)
-                last = now
-                n += 1
-            with lock:
-                ttfts.append(first)
-                total_tokens[0] += n
-
-    threads = [threading.Thread(target=client)
-               for _ in range(args.clients)]
-    t_start = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t_start
-
-    ttfts.sort()
-    tok_lats.sort()
     model = f"gpt_{cfg_name}"
-    print(json.dumps({
-        "metric": f"serve_{model}_ttft_p50_ms",
-        "value": round(ttfts[len(ttfts) // 2] * 1000, 2), "unit": "ms",
-        "p95_ms": round(ttfts[int(len(ttfts) * 0.95)] * 1000, 2),
-        "clients": args.clients}))
-    if tok_lats:
+
+    def run_mode(chunk: int):
+        handle.options(method_name="reset_stats").remote().result(
+            timeout=60)
+        ttfts, tok_lats = [], []
+        total_tokens = [0]
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(args.streams):
+                t0 = time.perf_counter()
+                gen = handle.options(stream=True).remote(
+                    {"prompt_len": 16, "max_new": max_new, "chunk": chunk})
+                last = t0
+                first = None
+                n = 0
+                lats = []
+                for item in gen:
+                    now = time.perf_counter()
+                    width = len(item) if isinstance(item, list) else 1
+                    if first is None:
+                        first = now - t0
+                    else:
+                        # Amortized per-token latency: a fused chunk
+                        # lands j tokens in one arrival.
+                        lats.extend([(now - last) / width] * width)
+                    last = now
+                    n += width
+                with lock:
+                    ttfts.append(first)
+                    tok_lats.extend(lats)
+                    total_tokens[0] += n
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(args.clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+
+        stats = handle.options(method_name="stats").remote().result(
+            timeout=60)
+        dpt = stats["dispatches"] / max(stats["tokens"], 1)
+        suffix = "" if chunk == 1 else f"_chunk{chunk}"
+        ttfts.sort()
+        tok_lats.sort()
         print(json.dumps({
-            "metric": f"serve_{model}_tok_latency_p50_ms",
-            "value": round(tok_lats[len(tok_lats) // 2] * 1000, 2),
-            "unit": "ms",
-            "p95_ms": round(tok_lats[int(len(tok_lats) * 0.95)] * 1000, 2)}))
-    print(json.dumps({
-        "metric": f"serve_{model}_decode_throughput",
-        "value": round(total_tokens[0] / wall, 1), "unit": "tokens/s",
-        "clients": args.clients, "streams": args.clients * args.streams}))
+            "metric": f"serve_{model}_ttft_p50_ms{suffix}",
+            "value": round(ttfts[len(ttfts) // 2] * 1000, 2), "unit": "ms",
+            "p95_ms": round(ttfts[int(len(ttfts) * 0.95)] * 1000, 2),
+            "clients": args.clients, "chunk": chunk}))
+        if tok_lats:
+            print(json.dumps({
+                "metric": f"serve_{model}_tok_latency_p50_ms{suffix}",
+                "value": round(tok_lats[len(tok_lats) // 2] * 1000, 2),
+                "unit": "ms",
+                "p95_ms": round(tok_lats[int(len(tok_lats) * 0.95)] * 1000,
+                                2),
+                "p99_ms": round(tok_lats[int(len(tok_lats) * 0.99)] * 1000,
+                                2),
+                "chunk": chunk}))
+        print(json.dumps({
+            "metric": f"serve_{model}_decode_throughput{suffix}",
+            "value": round(total_tokens[0] / wall, 1), "unit": "tokens/s",
+            "clients": args.clients, "streams": args.clients * args.streams,
+            "chunk": chunk}))
+        print(json.dumps({
+            "metric": f"serve_{model}_dispatches_per_token{suffix}",
+            "value": round(dpt, 4), "unit": "dispatches/token",
+            "dispatches": stats["dispatches"], "tokens": stats["tokens"],
+            "chunk": chunk}))
+        return {"chunk": chunk,
+                "tok_p50_ms": round(
+                    tok_lats[len(tok_lats) // 2] * 1000, 2)
+                if tok_lats else None,
+                "tok_s": round(total_tokens[0] / wall, 1),
+                "dispatches_per_token": round(dpt, 4)}
+
+    results = [run_mode(c) for c in chunks]
+    if len(results) > 1:
+        base = next((r for r in results if r["chunk"] == 1), results[0])
+        best = min(results, key=lambda r: r["dispatches_per_token"])
+        print(json.dumps({
+            "metric": f"serve_{model}_chunked_decode_ab",
+            "value": round(base["dispatches_per_token"]
+                           / max(best["dispatches_per_token"], 1e-9), 2),
+            "unit": "x_fewer_dispatches", "modes": results}))
     serve.shutdown()
     rt.shutdown()
 

@@ -42,8 +42,11 @@ import itertools as _itertools
 _seq_iter = _itertools.count(1)
 
 
-_ts_cache_ms = 0
-_ts_cache_bytes = b"\x00" * 6
+# (ms, 6-byte big-endian prefix) as ONE atomically-assigned tuple:
+# concurrent submitters read it with a single load, so a reader can
+# never pair one thread's ms with another thread's byte string (the
+# torn read two separate globals allowed).
+_ts_cache = (0, b"\x00" * 6)
 
 
 def _rand_bytes(n: int) -> bytes:
@@ -77,12 +80,13 @@ class BaseID:
         # cryptographically random. The prefix is CACHED per millisecond:
         # submission bursts mint thousands of IDs per ms and the
         # int->to_bytes pair showed up in the submit-path profile.
-        global _ts_cache_ms, _ts_cache_bytes
+        global _ts_cache
         now = int(time.time() * 1000)
-        if now != _ts_cache_ms:
-            _ts_cache_ms = now
-            _ts_cache_bytes = now.to_bytes(6, "big", signed=False)[-6:]
-        return cls(bytes([cls._type_tag]) + _ts_cache_bytes
+        ms, prefix = _ts_cache
+        if now != ms:
+            prefix = now.to_bytes(6, "big", signed=False)[-6:]
+            _ts_cache = (now, prefix)
+        return cls(bytes([cls._type_tag]) + prefix
                    + _rand_bytes(_ID_LEN - 6))
 
     @classmethod

@@ -200,12 +200,15 @@ def render_prometheus(merged: dict, prefix: str = "ray_tpu") -> str:
                 cum = 0
                 for i, b in enumerate(bounds):
                     cum += v[i]
+                    # No backslash inside the f-string expression:
+                    # pre-3.12 interpreters reject it at compile time.
+                    le = f'le="{b}"'
                     lines.append(
-                        f"{full}_bucket"
-                        f"{fmt_labels(key, f'le=\"{b}\"')} {cum}")
+                        f"{full}_bucket{fmt_labels(key, le)} {cum}")
                 cum += v[len(bounds)]
+                le_inf = 'le="+Inf"'
                 lines.append(
-                    f"{full}_bucket{fmt_labels(key, 'le=\"+Inf\"')} {cum}")
+                    f"{full}_bucket{fmt_labels(key, le_inf)} {cum}")
                 lines.append(f"{full}_sum{fmt_labels(key)} {v[-2]}")
                 lines.append(f"{full}_count{fmt_labels(key)} {v[-1]}")
     return "\n".join(lines) + "\n"

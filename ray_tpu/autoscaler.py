@@ -196,7 +196,12 @@ class FakeGCEConnector(GCEConnector):
                 raise ValueError("node.runtime_version is required")
         name = f"{parent}/queuedResources/{qr_id}"
         if name in self.resources:
-            raise ValueError(f"queued resource {qr_id!r} already exists")
+            # The real TPU API answers a duplicate queuedResourceId with
+            # 409 Conflict / ALREADY_EXISTS (not 400); FileExistsError is
+            # this codebase's spelling of that, and LocalGCEAPIServer
+            # maps it to a genuine 409 envelope.
+            raise FileExistsError(
+                f"queued resource {qr_id!r} already exists")
         self.resources[name] = {"name": name, "body": body, "polls": 0}
         return {"name": f"{parent}/operations/op-{qr_id}", "done": False}
 
@@ -296,6 +301,13 @@ class HTTPGCEConnector(GCEConnector):
             if resp.status == 400:
                 raise ValueError(doc.get("error", {}).get(
                     "message", f"400: {path}"))
+            if resp.status == 409:
+                # ALREADY_EXISTS / Conflict — the TPU API's answer to a
+                # duplicate queuedResourceId (ADVICE.md: a replayed
+                # create whose first attempt committed must be adoptable,
+                # and the production endpoint speaks 409, not 400).
+                raise FileExistsError(doc.get("error", {}).get(
+                    "message", f"409: {path}"))
             if resp.status in (401, 403):
                 raise PermissionError(doc.get("error", {}).get(
                     "message", f"{resp.status}: {path}"))
@@ -315,13 +327,19 @@ class HTTPGCEConnector(GCEConnector):
                 "POST",
                 f"/v2/{parent}/queuedResources"
                 f"?queuedResourceId={quote(qr_id)}", body)
-        except ValueError as e:
+        except (FileExistsError, ValueError) as e:
             # The POST is retried on ambiguous connection failures, and
             # a lost RESPONSE means the first attempt may have committed
-            # — the replay then answers "already exists" (409-class).
-            # Create is ensure-exists here: confirm via GET and report
-            # success instead of failing a slice that is provisioning.
-            if "already exists" not in str(e):
+            # — the replay then answers 409 Conflict / ALREADY_EXISTS
+            # (FileExistsError; legacy endpoints phrase it as a 400
+            # "already exists"). Create is ensure-exists here: confirm
+            # via GET and report success instead of failing a slice that
+            # is provisioning. The message check applies to BOTH
+            # exception types: 409 is Conflict, not only ALREADY_EXISTS
+            # (e.g. "resource is being deleted" must still fail the
+            # create so the caller retries later).
+            msg = str(e).lower()
+            if "already exists" not in msg and "already_exists" not in msg:
                 raise
             name = f"{parent}/queuedResources/{qr_id}"
             try:
@@ -415,6 +433,8 @@ class LocalGCEAPIServer:
                         doc = api.delete_queued_resource(name)
                 except KeyError as e:
                     return self._error(404, "NOT_FOUND", str(e.args[0]))
+                except FileExistsError as e:
+                    return self._error(409, "ALREADY_EXISTS", str(e))
                 except ValueError as e:
                     return self._error(400, "INVALID_ARGUMENT", str(e))
                 except Exception as e:  # connector bug -> 500

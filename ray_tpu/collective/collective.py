@@ -228,10 +228,19 @@ class StoreGroup(BaseGroup):
     #: gen-(GC_LAG) slot long before its owner deletes it
     GC_LAG = 16
 
-    def __init__(self, name: str, world_size: int, rank: int):
+    #: default wait for a peer's publication; override per group via
+    #: ``init_collective_group(..., fetch_timeout_s=)`` when ranks can
+    #: legitimately be slower (large CPU-emulated payloads, preemption)
+    DEFAULT_FETCH_TIMEOUT_S = 120.0
+
+    def __init__(self, name: str, world_size: int, rank: int,
+                 fetch_timeout_s: Optional[float] = None):
         super().__init__(name, world_size, rank)
         from ray_tpu.core.worker import CoreWorker
 
+        self.fetch_timeout_s = (self.DEFAULT_FETCH_TIMEOUT_S
+                                if fetch_timeout_s is None
+                                else float(fetch_timeout_s))
         self._core = CoreWorker.current()
         self._gen = 0
         self._p2p_seq: Dict[tuple, int] = {}
@@ -245,7 +254,8 @@ class StoreGroup(BaseGroup):
     def _kv_put(self, key: str, value: bytes):
         self._core.kv_put(key, value, ns="collective")
 
-    def _kv_get(self, key: str, timeout: float = 120.0) -> bytes:
+    def _kv_get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        timeout = self.fetch_timeout_s if timeout is None else timeout
         deadline = time.time() + timeout
         while time.time() < deadline:
             out = self._core.kv_get(key, ns="collective")
@@ -323,7 +333,7 @@ class StoreGroup(BaseGroup):
         self._own_slots.setdefault(gen, []).append(key)
 
     def _fetch(self, gen: int, what: str, rank: int, tag: int = 0,
-               timeout: float = 120.0):
+               timeout: Optional[float] = None):
         import pickle
 
         blob = self._kv_get(self._slot(gen, what, rank, tag), timeout)
@@ -492,8 +502,16 @@ def _combine(a, b, op: str):
 def init_collective_group(world_size: int, rank: int, *,
                           backend: str = "store",
                           group_name: str = "default",
-                          mesh=None, axis: str = "dp") -> BaseGroup:
-    """Join/declare a collective group (reference ``collective.py:151``)."""
+                          mesh=None, axis: str = "dp",
+                          fetch_timeout_s: Optional[float] = None
+                          ) -> BaseGroup:
+    """Join/declare a collective group (reference ``collective.py:151``).
+
+    ``fetch_timeout_s`` bounds how long a store-backed op waits for a
+    peer's publication (default ``StoreGroup.DEFAULT_FETCH_TIMEOUT_S``,
+    120 s); raise it when ranks can legitimately lag — large
+    CPU-emulated payloads, preemptible hosts. Ignored by the xla
+    backend, whose collectives rendezvous inside XLA."""
     with _lock:
         key = (group_name, rank)
         if key in _groups:
@@ -503,6 +521,8 @@ def init_collective_group(world_size: int, rank: int, *,
                     f"group {group_name!r} rank {rank} already exists "
                     f"with world_size={g.world_size}; destroy it before "
                     f"re-creating with different membership")
+            if fetch_timeout_s is not None and hasattr(g, "fetch_timeout_s"):
+                g.fetch_timeout_s = float(fetch_timeout_s)
             return g
         if backend == "xla":
             if mesh is None:
@@ -511,7 +531,8 @@ def init_collective_group(world_size: int, rank: int, *,
                 mesh = create_mesh({axis: world_size})
             g: BaseGroup = XlaMeshGroup(group_name, mesh, axis)
         elif backend == "store":
-            g = StoreGroup(group_name, world_size, rank)
+            g = StoreGroup(group_name, world_size, rank,
+                           fetch_timeout_s=fetch_timeout_s)
         else:
             raise ValueError(f"unknown backend {backend!r}")
         _groups[key] = g
@@ -562,6 +583,13 @@ def destroy_collective_group(group_name: str = "default",
 # the common case — omit it.
 def allreduce(x, op: str = "sum", group_name: str = "default",
               rank: Optional[int] = None):
+    """Allreduce ``x`` across the group.
+
+    Zero-copy contract (store backend): results that rode the object
+    store are READ-ONLY shared-memory views — mutating one in place
+    raises "assignment destination is read-only". ``np.array(result)``
+    first if you need a writable buffer. Small (inline-KV) payloads
+    happen to come back writable; do not rely on it."""
     return get_group(group_name, rank).allreduce(x, op)
 
 
@@ -577,6 +605,12 @@ def reducescatter(x, op: str = "sum", group_name: str = "default",
 
 def broadcast(x, src_rank: int = 0, group_name: str = "default",
               rank: Optional[int] = None):
+    """Broadcast rank ``src_rank``'s payload to every rank.
+
+    Zero-copy contract (store backend): receivers get a READ-ONLY
+    shared-memory view of the published object (the src rank gets its
+    own input back). Copy before mutating in place — see
+    :func:`allreduce`."""
     return get_group(group_name, rank).broadcast(x, src_rank)
 
 

@@ -3,9 +3,10 @@
 The serving-side twin of :mod:`ray_tpu.models.gpt` (reference
 capability: vLLM-style decode loops the reference serves behind Ray
 Serve; here designed TPU-first): static-shape caches so XLA compiles
-exactly two programs (one prefill per bucket, one decode step), scan
-over the stacked layer parameters, and masked full-length attention
-reads so the decode step costs O(max_len) with no dynamic shapes.
+a fixed set of programs (one prefill per prompt bucket, one decode
+step, one fused k-step chunk per (bucket, k)), scan over the stacked
+layer parameters, and masked full-length attention reads so the decode
+step costs O(max_len) with no dynamic shapes.
 
 Layout notes for the MXU/HBM:
 - cache is [L, B, max_len, H, hd] in the model compute dtype (bf16 on
@@ -13,13 +14,45 @@ Layout notes for the MXU/HBM:
   it bf16 halves the HBM traffic that dominates decode latency.
 - the single-token block math reuses the training block's weights via
   the same ``_mm`` helper, so MXU-friendly dtypes match training.
+
+Chunked-decode contract (the serve hot path):
+
+- :func:`decode_chunk` fuses k autoregressive steps (sample → embed →
+  attend → append KV) into ONE jitted ``lax.scan``, so the host pays a
+  single dispatch + one device→host transfer per k tokens instead of
+  per token. Greedy when ``temperature == 0``; otherwise temperature
+  sampling with the PRNG key threaded through the scan carry (the key
+  chain matches :func:`generate`'s per-step ``jax.random.split``).
+- Compile matrix: one XLA program per (batch, max_len bucket, k,
+  temperature-is-zero, eos_token). Serving stacks should pick k from a
+  small fixed set (e.g. {8, 16}) exactly like prompt buckets.
+- EOS semantics (mask-and-carry): once a stream samples ``eos_token``
+  its lane keeps emitting ``eos_token`` for the rest of the chunk and
+  every later chunk — finished lanes are masked, not compacted, so
+  shapes stay static. :func:`decode_until` trims the emitted slice at
+  the first position where EVERY stream is done, so an early-stopping
+  batch never streams (or re-pays for) tokens past its last EOS.
+- Streaming granularity: drivers yield one ``[B, j]`` slice per chunk
+  (j ≤ k after EOS/max_new trimming); the serve replica forwards each
+  slice as one stream item, so HTTP chunked streaming stays
+  incremental at chunk granularity.
+- Cache writes past ``max_len`` clamp to the last slot (XLA
+  ``dynamic_update_slice`` semantics). Tokens emitted past ``max_new``
+  are discarded by the driver before any such position is read, so the
+  clamp is unobservable as long as prompt + max_new ≤ max_len.
+
+At ``temperature == 0`` the chunked path is asserted token-for-token
+identical to the per-token :func:`decode_step` loop (see
+``tests/test_models_gpt_decode_chunk.py``).
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import functools
+from typing import Dict, Iterator, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .gpt import (GPTConfig, Params, _mm, _project_vocab, _rmsnorm)
@@ -158,8 +191,8 @@ def generate(params: Params, prompt: jax.Array, cfg: GPTConfig,
     if temperature > 0.0 and rng is None:
         rng = jax.random.PRNGKey(0)
     cache = init_cache(cfg, B, max_len)
-    pf = jax.jit(prefill, static_argnums=(2,))
-    step = jax.jit(decode_step, static_argnums=(3,))
+    pf = _jitted_prefill()
+    step = _jitted_decode_step()
     logits, cache = pf(params, prompt, cfg, cache)
     for i in range(max_new_tokens):
         if temperature > 0.0:
@@ -171,3 +204,143 @@ def generate(params: Params, prompt: jax.Array, cfg: GPTConfig,
         yield token
         if i + 1 < max_new_tokens:
             logits, cache = step(params, cache, token, cfg)
+
+
+def _sample(logits, temperature: float, key):
+    """One sampling decision; greedy iff temperature == 0 (static)."""
+    if temperature > 0.0:
+        key, sub = jax.random.split(key)
+        token = jax.random.categorical(
+            sub, logits / temperature, axis=-1).astype(jnp.int32)
+    else:
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return token, key
+
+
+def decode_chunk(params: Params, cache: Cache, token: jax.Array,
+                 rng: jax.Array = None, *, cfg: GPTConfig, k: int,
+                 temperature: float = 0.0, eos_token: int = -1):
+    """k fused autoregressive steps in ONE program: a ``lax.scan`` over
+    the single-step body, so the whole chunk is one host→device
+    dispatch instead of k.
+
+    ``token`` [B] int32 is the last emitted token (fed as the first
+    step's input); returns ``(tokens [B, k], cache advanced k, done [B],
+    rng')``. Finished streams (``eos_token`` sampled, or fed in as
+    ``token``) are masked-and-carried: they keep emitting ``eos_token``
+    and their ``done`` flag survives across chunks via the returned
+    tokens' final column. ``cfg``/``k``/``temperature``/``eos_token``
+    are compile-time constants — jit through :func:`jit_decode_chunk`.
+    """
+    B = token.shape[0]
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    eos = jnp.asarray(eos_token, jnp.int32)
+    done0 = (token == eos) if eos_token >= 0 \
+        else jnp.zeros((B,), jnp.bool_)
+
+    def body(carry, _):
+        cache, tok, done, key = carry
+        logits, cache = decode_step(params, cache, tok, cfg)
+        nxt, key = _sample(logits, temperature, key)
+        if eos_token >= 0:
+            nxt = jnp.where(done, eos, nxt)
+            done = done | (nxt == eos)
+        return (cache, nxt, done, key), nxt
+
+    (cache, _, done, rng), toks = lax.scan(
+        body, (cache, token, done0, rng), None, length=k)
+    return jnp.moveaxis(toks, 0, 1), cache, done, rng
+
+
+@functools.lru_cache(maxsize=64)
+def jit_decode_chunk(cfg: GPTConfig, k: int, temperature: float = 0.0,
+                     eos_token: int = -1):
+    """Jitted :func:`decode_chunk` with the static knobs baked in: one
+    compiled program per (cache bucket, k). Returns
+    ``step(params, cache, token, rng) -> (tokens, cache, done, rng)``.
+    Cached on the (hashable) static knobs — repeated calls return the
+    SAME jit wrapper, so per-request drivers reuse the compiled program
+    instead of retracing (jax keys its cache on wrapper identity)."""
+    return jax.jit(functools.partial(
+        decode_chunk, cfg=cfg, k=k, temperature=temperature,
+        eos_token=eos_token))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_prefill():
+    return jax.jit(prefill, static_argnums=(2,))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_decode_step():
+    return jax.jit(decode_step, static_argnums=(3,))
+
+
+def decode_until(step, params: Params, cache: Cache, token: jax.Array,
+                 max_new: int, *, eos_token: int = -1,
+                 rng: jax.Array = None) -> Iterator[np.ndarray]:
+    """Drive a jitted chunk step until ``max_new`` tokens are emitted or
+    every stream has sampled ``eos_token``. Yields one trimmed np.int32
+    ``[B, j]`` slice per chunk (j ≤ k) — the streaming granularity.
+
+    EOS handling happens in two layers: inside the scan, finished lanes
+    are masked to keep emitting eos (static shapes); here, the emitted
+    slice is cut at the first position where ALL lanes are done, so an
+    early-stopping batch never streams tokens past its final EOS.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    done = np.zeros((token.shape[0],), bool)
+    if eos_token >= 0:
+        done |= np.asarray(token) == eos_token
+    remaining = max_new
+    while remaining > 0 and not done.all():
+        toks_dev, cache, _, rng = step(params, cache, token, rng)
+        toks = np.asarray(toks_dev)        # ONE transfer per chunk
+        j = min(toks.shape[1], remaining)
+        if eos_token >= 0:
+            cum = np.logical_or.accumulate(toks == eos_token, axis=1) \
+                | done[:, None]
+            all_done = np.all(cum, axis=0)
+            if all_done.any():
+                j = min(j, int(all_done.argmax()) + 1)
+            done = cum[:, j - 1].copy()
+        yield toks[:, :j]
+        remaining -= j
+        token = toks_dev[:, -1]            # stays on device
+
+
+def generate_chunked(params: Params, prompt: jax.Array, cfg: GPTConfig,
+                     max_new_tokens: int, *, chunk: int = 8,
+                     max_len: int = 0, temperature: float = 0.0,
+                     rng: jax.Array = None,
+                     eos_token: int = -1) -> Iterator[np.ndarray]:
+    """Chunked twin of :func:`generate`: yields np.int32 ``[B, j]``
+    slices — first the prefill-derived token alone (minimal TTFT), then
+    one slice per fused k-step chunk. At temperature 0 the concatenated
+    tokens are identical to :func:`generate`'s; at temperature > 0 the
+    PRNG split chain matches generate's per-step splits."""
+    B, S = prompt.shape
+    max_len = max_len or cfg.max_seq
+    if max_new_tokens <= 0:
+        return
+    if S + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache length {max_len}")
+    if temperature > 0.0 and rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = _jitted_prefill()(params, prompt, cfg, cache)
+    token, rng = _sample(logits, temperature,
+                         rng if rng is not None else jax.random.PRNGKey(0))
+    first = np.asarray(token)[:, None]
+    yield first
+    if max_new_tokens <= 1 or (eos_token >= 0
+                               and (first == eos_token).all()):
+        return
+    step = jit_decode_chunk(cfg, chunk, temperature, eos_token)
+    yield from decode_until(step, params, cache, token,
+                            max_new_tokens - 1, eos_token=eos_token,
+                            rng=rng)

@@ -470,15 +470,51 @@ class ServeController:
                 info = rt.get(handle.start.remote(
                     opts.get("host", "127.0.0.1"), port,
                     opts.get("request_timeout_s", 60.0)), timeout=30)
-            except Exception:  # noqa: BLE001 - node raced away; retry
-                traceback.print_exc()
-                continue
+            except Exception as e:  # noqa: BLE001 - node raced away; retry
+                # A prior fleet's proxy may still hold the name (this
+                # controller restarted or lost state): adopt the live
+                # actor instead of colliding with the identical create
+                # on every reconcile tick and never publishing
+                # _http_info (ADVICE.md low).
+                adopted = None
+                if "already taken" in str(e):
+                    adopted = self._adopt_proxy(name, opts, port)
+                if adopted is None:
+                    traceback.print_exc()
+                    continue
+                handle, info = adopted
             with self._lock:
                 self._proxies[nid] = {"handle": handle, "name": name,
                                       "info": info}
                 if primary_missing:
                     self._http_info = dict(info)
                     primary_missing = False
+
+    def _adopt_proxy(self, name: str, opts: dict, bind_port: int):
+        """Adopt a live proxy actor that already holds ``name``:
+        ``get_port`` is idempotent (None until started), and ``start``
+        is only issued when the actor never bound — re-starting a bound
+        proxy would spawn a second server thread. ``bind_port`` is the
+        caller's computed port for this slot (configured port for the
+        primary, 0 for secondaries — adopting a secondary must not bind
+        the primary's port). Returns (handle, info) or None if the
+        actor is gone/unresponsive (the name then frees up and the next
+        tick's create succeeds)."""
+        from .. import api as rt
+
+        try:
+            handle = rt.get_actor(name, timeout=5)
+            port = rt.get(handle.get_port.remote(), timeout=5)
+            if port is None:
+                info = rt.get(handle.start.remote(
+                    opts.get("host", "127.0.0.1"), bind_port,
+                    opts.get("request_timeout_s", 60.0)), timeout=30)
+            else:
+                info = {"host": opts.get("host", "127.0.0.1"),
+                        "port": port}
+            return handle, info
+        except Exception:  # noqa: BLE001 - stale name or dead actor
+            return None
 
     @staticmethod
     def _call_quietly(method, *args):

@@ -79,7 +79,15 @@ class Replica:
         ``serve/_private/replica.py:391-543`` handle_request_streaming):
         items from the user generator stream back to the caller one at a
         time over the core streaming-generator transport instead of
-        buffering the whole response."""
+        buffering the whole response.
+
+        Chunked-decode mode: handlers on the fused decode path yield
+        per-chunk token SLICES (one list per device dispatch) rather
+        than per-token items. Those stream through unchanged — one
+        stream item per chunk — unless the caller sets
+        ``ctx["flatten_chunks"]``, which re-yields each list/tuple item
+        element-wise so per-token consumers keep token granularity
+        without a second code path on the replica."""
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -89,39 +97,21 @@ class Replica:
 
             token = _request_model_id.set(ctx["multiplexed_model_id"])
         try:
-            if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
-                method = self._user
+            items = self._user_stream(method_name, args, kwargs)
+            if ctx and ctx.get("flatten_chunks"):
+                for item in items:
+                    if isinstance(item, (list, tuple)):
+                        yield from item
+                    elif getattr(item, "ndim", 0):
+                        # ndarray chunk slice (e.g. generate_chunked's
+                        # [B, j]): row-major flatten to scalars — for
+                        # the B == 1 serving case that is exactly
+                        # per-token order.
+                        yield from item.ravel().tolist()
+                    else:
+                        yield item
             else:
-                method = getattr(self._user, method_name)
-            out = method(*args, **kwargs)
-            if inspect.isasyncgen(out):
-                # Drain the async generator on a private loop; the
-                # replica's concurrency model is threads, not one loop.
-                loop = asyncio.new_event_loop()
-                try:
-                    while True:
-                        try:
-                            yield loop.run_until_complete(out.__anext__())
-                        except StopAsyncIteration:
-                            break
-                finally:
-                    # Abandoned stream: run the handler's cleanup
-                    # (try/finally, context managers) before the loop
-                    # goes away — GC would otherwise try to aclose on a
-                    # closed loop.
-                    try:
-                        loop.run_until_complete(out.aclose())
-                    except Exception:  # noqa: BLE001 - cleanup best-effort
-                        pass
-                    loop.close()
-            elif inspect.isgenerator(out) or hasattr(out, "__next__"):
-                yield from out
-            else:
-                if inspect.iscoroutine(out):
-                    out = asyncio.run(out)
-                # Non-generator handler called in streaming mode: a
-                # single-item stream keeps the caller's contract.
-                yield out
+                yield from items
         finally:
             if token is not None:
                 from .multiplex import _request_model_id
@@ -129,6 +119,44 @@ class Replica:
                 _request_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    def _user_stream(self, method_name: str, args: tuple, kwargs: dict):
+        """Invoke the user callable and normalize every handler shape
+        (sync/async generator, coroutine, plain value) into one sync
+        iterator."""
+        if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
+            method = self._user
+        else:
+            method = getattr(self._user, method_name)
+        out = method(*args, **kwargs)
+        if inspect.isasyncgen(out):
+            # Drain the async generator on a private loop; the
+            # replica's concurrency model is threads, not one loop.
+            loop = asyncio.new_event_loop()
+            try:
+                while True:
+                    try:
+                        yield loop.run_until_complete(out.__anext__())
+                    except StopAsyncIteration:
+                        break
+            finally:
+                # Abandoned stream: run the handler's cleanup
+                # (try/finally, context managers) before the loop
+                # goes away — GC would otherwise try to aclose on a
+                # closed loop.
+                try:
+                    loop.run_until_complete(out.aclose())
+                except Exception:  # noqa: BLE001 - cleanup best-effort
+                    pass
+                loop.close()
+        elif inspect.isgenerator(out) or hasattr(out, "__next__"):
+            yield from out
+        else:
+            if inspect.iscoroutine(out):
+                out = asyncio.run(out)
+            # Non-generator handler called in streaming mode: a
+            # single-item stream keeps the caller's contract.
+            yield out
 
     # ---------------------------------------------------------- control plane
     def get_metrics(self) -> Dict[str, Any]:

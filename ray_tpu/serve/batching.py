@@ -14,6 +14,24 @@ then 7, then 5 …) would trigger a new XLA compilation per size. With
 the static-shaped batch, and truncates the results — so the jitted callee
 only ever sees ``len(buckets)`` distinct shapes (SURVEY.md §7: "dynamic
 batching vs static XLA shapes via bucketed padding").
+
+**Streaming batches** (``stream=True``): the handler is a GENERATOR taking
+``List[T]`` and yielding per-batch slices — each yielded value is a list
+with one element per batched caller — and each caller's wrapped call
+returns an iterator of its own elements. This is how fused chunked decode
+batches concurrent streams: one ``lax.scan`` dispatch serves the whole
+batch, and every caller still streams its per-chunk token slices
+incrementally (the serve replica forwards them straight into the chunked
+HTTP path)::
+
+    @serve.batch(max_batch_size=4, stream=True)
+    def decode_batch(self, requests):        # one fused decode loop
+        for chunk in self._decode_chunks(requests):
+            yield chunk                       # List[per-caller slice]
+
+    def __call__(self, request):
+        for slice_ in self.decode_batch(request):
+            yield slice_                      # caller's own stream
 """
 from __future__ import annotations
 
@@ -42,16 +60,35 @@ def pad_to_bucket(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
+#: end-of-stream marker on the per-caller queues of a streaming batch
+_STREAM_END = object()
+
+
+class _StreamLane:
+    """One caller's lane of a streaming batch: an unbounded queue plus a
+    closed flag the consumer sets on abandonment, so the flusher stops
+    feeding (and, once every lane closes, stops computing) chunks nobody
+    will read."""
+
+    __slots__ = ("q", "closed")
+
+    def __init__(self):
+        self.q = queue.SimpleQueue()
+        self.closed = False
+
+
 class _BatchQueue:
     """One pending-request queue + flusher thread per wrapped function."""
 
     def __init__(self, fn: Callable, max_batch_size: int,
                  batch_wait_timeout_s: float,
-                 pad: bool, buckets: Optional[Sequence[int]]):
+                 pad: bool, buckets: Optional[Sequence[int]],
+                 stream: bool = False):
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout_s = batch_wait_timeout_s
         self.pad = pad
+        self.stream = stream
         self.buckets = sorted(buckets) if buckets else \
             default_buckets(max_batch_size)
         self.q: "queue.Queue" = queue.Queue()
@@ -88,6 +125,18 @@ class _BatchQueue:
         if self.pad:
             target = pad_to_bucket(n, self.buckets)
             items = items + [items[-1]] * (target - n)
+        if self.stream:
+            # Own thread per streaming batch: the flusher goes straight
+            # back to collecting the NEXT batch, so back-to-back batches
+            # of streams overlap instead of serializing behind one
+            # multi-second generation (head-of-line blocking). The
+            # handler must therefore tolerate concurrent invocations —
+            # the same contract this runtime's thread-concurrent
+            # replicas already impose.
+            threading.Thread(
+                target=self._run_batch_stream, args=(items, futs, n),
+                daemon=True, name="rt-serve-batch-stream").start()
+            return
         try:
             results = self.fn(items)
             if results is None or len(results) < n:
@@ -101,6 +150,39 @@ class _BatchQueue:
                 if not fut.done():
                     fut.set_exception(e)
 
+    def _run_batch_stream(self, items, futs, n):
+        """Streaming flush (runs on its own thread, one per batch): the
+        handler yields per-batch slices; element i of every slice is
+        routed to caller i's lane, so all callers stream concurrently
+        off ONE handler invocation, driven until exhaustion OR every
+        lane is abandoned. Closed lanes stop receiving chunks, so a
+        departed caller's queue can't grow."""
+        lanes = [_StreamLane() for _ in range(n)]
+        for fut, lane in zip(futs, lanes):
+            fut.set_result(lane)
+        try:
+            gen = self.fn(items)
+            try:
+                for slice_ in gen:
+                    if all(lane.closed for lane in lanes):
+                        break  # every consumer left; stop computing
+                    if slice_ is None or len(slice_) < n:
+                        raise ValueError(
+                            f"streaming batch handler yielded "
+                            f"{0 if slice_ is None else len(slice_)} "
+                            f"results for {n} requests")
+                    for lane, r in zip(lanes, list(slice_)[:n]):
+                        if not lane.closed:
+                            lane.q.put(("item", r))
+            finally:
+                if hasattr(gen, "close"):
+                    gen.close()  # run the handler's cleanup
+            for lane in lanes:
+                lane.q.put((_STREAM_END, None))
+        except Exception as e:  # noqa: BLE001 - fan out per caller
+            for lane in lanes:
+                lane.q.put(("err", e))
+
 
 # Runtime state (queues, locks) lives here — NOT in decorator closures —
 # because deployment classes are cloudpickled at ``bind()`` time and
@@ -110,7 +192,7 @@ _REG_LOCK = threading.Lock()
 
 
 def _queue_for(self_obj, key, fn, cfg) -> _BatchQueue:
-    max_bs, wait_s, pad, buckets = cfg
+    max_bs, wait_s, pad, buckets, stream = cfg
     if self_obj is not None:
         attr = f"__rt_batch_queue_{fn.__name__}"
         bq = self_obj.__dict__.get(attr)
@@ -119,20 +201,36 @@ def _queue_for(self_obj, key, fn, cfg) -> _BatchQueue:
                 bq = self_obj.__dict__.get(attr)
                 if bq is None:
                     bq = _BatchQueue(lambda items: fn(self_obj, items),
-                                     max_bs, wait_s, pad, buckets)
+                                     max_bs, wait_s, pad, buckets, stream)
                     object.__setattr__(self_obj, attr, bq)
         return bq
     with _REG_LOCK:
         bq = _REGISTRY.get(key)
         if bq is None:
             bq = _REGISTRY[key] = _BatchQueue(fn, max_bs, wait_s, pad,
-                                              buckets)
+                                              buckets, stream)
     return bq
+
+
+def _drain_stream(lane: _StreamLane):
+    """Caller-side iterator over one streaming-batch lane. Marks the
+    lane closed on exit — normal exhaustion, error, or abandonment
+    (GeneratorExit) — so the flusher stops feeding it."""
+    try:
+        while True:
+            kind, val = lane.q.get()
+            if kind is _STREAM_END:
+                return
+            if kind == "err":
+                raise val
+            yield val
+    finally:
+        lane.closed = True
 
 
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01, pad_to_bucket: bool = False,
-          buckets: Optional[Sequence[int]] = None):
+          buckets: Optional[Sequence[int]] = None, stream: bool = False):
     """Decorator: turn a ``List[T] -> List[R]`` handler into a ``T -> R``
     callable that transparently batches concurrent callers.
 
@@ -145,12 +243,17 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
 
         def __call__(self, request):
             return self.predict_batch(request)
+
+    With ``stream=True`` the handler is a generator yielding per-batch
+    slices (one element per batched caller) and each call returns an
+    iterator of that caller's elements — see the module docstring for
+    the fused-decode shape.
     """
 
     def decorate(fn):
         is_method = _looks_like_method(fn)
         cfg = (max_batch_size, batch_wait_timeout_s, pad_to_bucket,
-               tuple(buckets) if buckets else None)
+               tuple(buckets) if buckets else None, stream)
         key = (getattr(fn, "__module__", ""), getattr(fn, "__qualname__", ""))
 
         @functools.wraps(fn)
@@ -161,8 +264,9 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                 self_obj, item = args
             else:
                 self_obj, (item,) = None, args
-            return _mod._queue_for(self_obj, key, fn, cfg).submit(
+            out = _mod._queue_for(self_obj, key, fn, cfg).submit(
                 item).result()
+            return _drain_stream(out) if stream else out
 
         wrapper.__rt_is_batched__ = True
         return wrapper

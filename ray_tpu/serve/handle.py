@@ -168,39 +168,50 @@ class DeploymentHandle:
 
     def __init__(self, app_name: str, deployment_name: str,
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = "", stream: bool = False):
+                 multiplexed_model_id: str = "", stream: bool = False,
+                 flatten_chunks: bool = False):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.method_name = method_name
         self.multiplexed_model_id = multiplexed_model_id
         self.stream = stream
+        # Chunked-decode replicas stream per-chunk token slices; with
+        # flatten_chunks the replica re-yields each slice element-wise
+        # so this caller sees per-token items over the same transport.
+        self.flatten_chunks = flatten_chunks
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.app_name, self.deployment_name, self.method_name,
-                 self.multiplexed_model_id, self.stream))
+                 self.multiplexed_model_id, self.stream,
+                 self.flatten_chunks))
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                flatten_chunks: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self.method_name,
             multiplexed_model_id if multiplexed_model_id is not None
             else self.multiplexed_model_id,
-            self.stream if stream is None else stream)
+            self.stream if stream is None else stream,
+            self.flatten_chunks if flatten_chunks is None
+            else flatten_chunks)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.app_name, self.deployment_name, name,
-                                self.multiplexed_model_id, self.stream)
+                                self.multiplexed_model_id, self.stream,
+                                self.flatten_chunks)
 
     def remote(self, *args, **kwargs):
         router = get_router(self.app_name, self.deployment_name)
         if self.stream:
             return router.submit_stream(self.method_name, args, kwargs,
-                                        model_id=self.multiplexed_model_id)
+                                        model_id=self.multiplexed_model_id,
+                                        flatten_chunks=self.flatten_chunks)
         return router.submit(self.method_name, args, kwargs,
                              model_id=self.multiplexed_model_id)
 
@@ -338,8 +349,9 @@ class Router:
                                   (method_name, args, kwargs), model_id)
 
     def submit_stream(self, method_name: str, args: tuple, kwargs: dict,
-                      timeout_s: float = 60.0,
-                      model_id: str = "") -> "DeploymentResponseGenerator":
+                      timeout_s: float = 60.0, model_id: str = "",
+                      flatten_chunks: bool = False
+                      ) -> "DeploymentResponseGenerator":
         """Streaming dispatch: same admission + pow-2 pick as submit(),
         but the replica call rides the core streaming-generator
         transport and the in-flight slot is held until the stream ends
@@ -361,7 +373,12 @@ class Router:
                     f"request within {timeout_s}s")
             if not waited:
                 self.refresh()
-        ctx = {"multiplexed_model_id": model_id} if model_id else None
+        ctx = {}
+        if model_id:
+            ctx["multiplexed_model_id"] = model_id
+        if flatten_chunks:
+            ctx["flatten_chunks"] = True
+        ctx = ctx or None
         gen = handle.handle_request_streaming.options(
             num_returns="streaming").remote(method_name, args, kwargs, ctx)
         return DeploymentResponseGenerator(self, rid, gen)

@@ -95,6 +95,16 @@ def pytest_runtest_call(item):
     import threading as _threading
 
     done = _threading.Event()
+    # Serializes "watchdog fires" against "teardown begins": teardown
+    # sets done as its first statement and then passes through this
+    # gate before restoring the handler; the watchdog re-checks done
+    # under the gate right before pthread_kill, and the signal handler
+    # itself re-checks done at delivery. Together these close both
+    # SIGALRM races (ADVICE.md low): a test finishing at the deadline
+    # can't be failed post-hoc, and a stack dump outlasting the
+    # finally's join can't fire into a restored (default) handler and
+    # kill pytest.
+    kill_gate = _threading.Lock()
 
     def watch():
         if not done.wait(_WATCHDOG_S):
@@ -106,20 +116,29 @@ def pytest_runtest_call(item):
             # Fail the test rather than hang the suite. The signal lands
             # in the MAIN thread (test body); loops on worker threads
             # keep running so teardown fixtures can still clean up.
-            # Re-check AFTER the (slow) stack dumps: if the test just
-            # finished, the main thread may already have restored the
-            # default SIGALRM action, which would kill the whole process.
             import signal as _signal
 
-            if done.is_set():
-                return
-            try:
-                _signal.pthread_kill(_threading.main_thread().ident,
-                                     _signal.SIGALRM)
-            except Exception:
-                pass
+            with kill_gate:
+                # Teardown may have begun while the (slow) stack dumps
+                # ran: once done is set the test finished — firing now
+                # would fail it after the fact (or, after the handler
+                # restore, terminate the whole process).
+                if done.is_set():
+                    return
+                try:
+                    _signal.pthread_kill(_threading.main_thread().ident,
+                                         _signal.SIGALRM)
+                except Exception:
+                    pass
 
     def _raise(signum, frame):
+        # The handler runs on the main thread, possibly only once it
+        # re-enters the interpreter INSIDE the finally below — after the
+        # test body already returned. done is the test-completion fact,
+        # so a late-delivered signal becomes a no-op instead of failing
+        # a finished test from its own teardown.
+        if done.is_set():
+            return
         raise TestHungError(
             f"{item.nodeid} exceeded {_WATCHDOG_S}s watchdog; stacks in "
             f"/tmp/rt_stacks_{os.getpid()}.txt")
@@ -130,10 +149,17 @@ def pytest_runtest_call(item):
     try:
         return (yield)
     finally:
+        # done FIRST (single atomic call): both the watchdog's gate
+        # check and the signal handler consult it, so a kill decided or
+        # delivered from here on is a no-op.
         done.set()
-        # Only restore the handler once the watchdog can no longer fire
-        # (it may be mid-stack-dump right at the deadline: a SIGALRM
-        # delivered after restore would hit SIG_DFL and kill pytest).
+        with kill_gate:
+            # Barrier only: if the watchdog is mid-decision, wait it
+            # out before restoring the handler.
+            pass
+        # The join is best-effort (a slow dump may outlast it); the
+        # done/gate pair above keeps a late watchdog from firing either
+        # way, so restoring the handler here is safe even on timeout.
         t.join(timeout=10)
         try:
             signal.signal(signal.SIGALRM, prev)

@@ -1,0 +1,239 @@
+"""Fused multi-token decode (``decode_chunk``/``decode_until``): the
+chunked path must be token-for-token identical to the per-token
+``decode_step`` loop at temperature 0, stop at EOS inside a chunk
+without emitting trailing tokens, and stream per-chunk slices through a
+live serve deployment (including the batched streaming mode)."""
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def nano():
+    from ray_tpu.models import gpt
+
+    return gpt.CONFIGS["nano"]
+
+
+@pytest.fixture(scope="module")
+def nano_params(nano):
+    import jax
+
+    from ray_tpu.models import gpt
+
+    return gpt.init_params(jax.random.PRNGKey(0), nano)
+
+
+def _per_token(params, prompt, cfg, max_new, **kw):
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt_decode
+
+    return np.stack([np.asarray(t) for t in gpt_decode.generate(
+        params, jnp.asarray(prompt), cfg, max_new, **kw)], axis=1)
+
+
+def _chunked(params, prompt, cfg, max_new, **kw):
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt_decode
+
+    slices = list(gpt_decode.generate_chunked(
+        params, jnp.asarray(prompt), cfg, max_new, **kw))
+    return slices, np.concatenate(slices, axis=1)
+
+
+@pytest.mark.parametrize("chunk", [4, 5, 16])
+def test_chunk_matches_per_token_greedy(nano, nano_params, chunk):
+    """Temperature 0: the fused scan emits exactly the per-token loop's
+    tokens — dividing, non-dividing, and larger-than-max_new chunks."""
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, nano.vocab_size, (2, 8)).astype(np.int32)
+    max_new = 12
+    want = _per_token(nano_params, prompt, nano, max_new, max_len=32)
+    slices, got = _chunked(nano_params, prompt, nano, max_new,
+                           chunk=chunk, max_len=32)
+    assert got.shape == (2, max_new)
+    assert (got == want).all(), (got, want)
+    # Streaming granularity: prefill token first, then <=chunk slices.
+    assert slices[0].shape[1] == 1
+    assert all(s.shape[1] <= chunk for s in slices[1:])
+
+
+def test_eos_inside_chunk_stops_early(nano, nano_params):
+    """Pick the greedy token at step 5 as EOS: the chunked stream must
+    end AT that token — no trailing tokens from the rest of the chunk —
+    and never restart."""
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, nano.vocab_size, (1, 8)).astype(np.int32)
+    ref = _per_token(nano_params, prompt, nano, 16, max_len=32)[0]
+    eos = int(ref[5])
+    stop = int(np.argmax(ref == eos))  # first occurrence (may be < 5)
+    _, got = _chunked(nano_params, prompt, nano, 16, chunk=4, max_len=32,
+                      eos_token=eos)
+    assert got.shape[1] == stop + 1, (got, ref, eos)
+    assert int(got[0, -1]) == eos
+    assert (got[0] == ref[:stop + 1]).all()
+
+
+def test_eos_masks_finished_stream_in_batch(nano, nano_params):
+    """B=2 with one stream finishing first: the finished lane is
+    masked-and-carried (keeps emitting eos) while the other decodes on,
+    and the batch stops when BOTH are done or max_new is hit."""
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, nano.vocab_size, (2, 8)).astype(np.int32)
+    ref = _per_token(nano_params, prompt, nano, 12, max_len=32)
+    # EOS = stream 0's token at position 3; ensure stream 1 doesn't
+    # emit it at/before that position (else pick another seed offset).
+    eos = int(ref[0, 3])
+    first0 = int(np.argmax(ref[0] == eos))
+    hits1 = np.nonzero(ref[1] == eos)[0]
+    assume_ok = not len(hits1) or hits1[0] > first0
+    assert assume_ok, "seed produced overlapping EOS; adjust test seed"
+    _, got = _chunked(nano_params, prompt, nano, 12, chunk=4, max_len=32,
+                      eos_token=eos)
+    n = got.shape[1]
+    assert n == 12 if not len(hits1) else n == hits1[0] + 1
+    # Stream 0: real tokens up to its EOS, eos-padding after.
+    assert (got[0, :first0 + 1] == ref[0, :first0 + 1]).all()
+    assert (got[0, first0:] == eos).all()
+    # Stream 1: untouched by stream 0's stopping.
+    assert (got[1] == ref[1, :n]).all()
+
+
+def test_temperature_sampling_deterministic(nano, nano_params):
+    """temperature>0 threads the PRNG key through the scan carry: same
+    seed → same tokens, different seed → (almost surely) different."""
+    import jax
+
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, nano.vocab_size, (1, 8)).astype(np.int32)
+    kw = dict(chunk=4, max_len=32, temperature=1.0)
+    _, a = _chunked(nano_params, prompt, nano, 12,
+                    rng=jax.random.PRNGKey(7), **kw)
+    _, b = _chunked(nano_params, prompt, nano, 12,
+                    rng=jax.random.PRNGKey(7), **kw)
+    _, c = _chunked(nano_params, prompt, nano, 12,
+                    rng=jax.random.PRNGKey(8), **kw)
+    assert (a == b).all()
+    assert a.shape == c.shape == (1, 12)
+    assert not (a == c).all()
+
+
+def test_serve_streams_chunk_slices(rt_cluster):
+    """Live serve deployment on the fused path: per-chunk token slices
+    arrive as individual stream items (incremental, not buffered), and
+    flatten_chunks re-yields them per token — both matching the
+    per-token reference decode."""
+    import jax
+
+    from ray_tpu import serve
+    from ray_tpu.models import gpt
+
+    nano = gpt.CONFIGS["nano"]
+    params = gpt.init_params(jax.random.PRNGKey(0), nano)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, nano.vocab_size, (1, 8)).astype(np.int32)
+    want = _per_token(params, prompt, nano, 9, max_len=32)[0].tolist()
+
+    serve.start(proxy=False)
+    try:
+        @serve.deployment
+        class ChunkDecoder:
+            def __init__(self, prompt):
+                from ray_tpu.models import gpt as _gpt
+
+                self.cfg = _gpt.CONFIGS["nano"]
+                self.params = _gpt.init_params(jax.random.PRNGKey(0),
+                                               self.cfg)
+                self.prompt = np.asarray(prompt)
+
+            def __call__(self, request):
+                from ray_tpu.models import gpt_decode
+
+                max_new, mode = request
+                for slice_ in gpt_decode.generate_chunked(
+                        self.params, self.prompt, self.cfg, max_new,
+                        chunk=4, max_len=32):
+                    # Both producer shapes must stream/flatten: raw
+                    # [j] ndarray rows and plain int lists.
+                    yield (slice_[0] if mode == "array"
+                           else [int(t) for t in slice_[0]])
+
+        h = serve.run(ChunkDecoder.bind(prompt), name="chunkdec",
+                      route_prefix=None)
+        for mode in ("list", "array"):
+            items = list(h.options(stream=True).remote((9, mode)))
+            # Chunk granularity: first item is the prefill token alone,
+            # later items are whole chunk slices.
+            assert [len(i) for i in items] == [1, 4, 4]
+            assert [int(t) for i in items for t in i] == want
+            # flatten_chunks: same stream, token granularity.
+            toks = list(h.options(stream=True,
+                                  flatten_chunks=True).remote((9, mode)))
+            assert toks == want
+        serve.delete("chunkdec")
+    finally:
+        serve.shutdown()
+
+
+def test_batched_streaming_decode(rt_cluster):
+    """@serve.batch(stream=True): concurrent callers are fused into ONE
+    batched handler invocation whose yielded per-batch slices fan out to
+    each caller's own stream."""
+    import threading
+
+    from ray_tpu import serve
+
+    calls = []
+
+    class Fanout:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2,
+                     stream=True)
+        def decode_batch(self, starts):
+            calls.append(len(starts))
+            for step in range(3):  # 3 "chunks" per stream
+                yield [[s + step * 10, s + step * 10 + 1]
+                       for s in starts]
+
+        def run(self, start):
+            return list(self.decode_batch(start))
+
+    f = Fanout()
+    out = {}
+
+    def worker(s):
+        out[s] = f.run(s)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in (100, 200, 300)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for s in (100, 200, 300):
+        assert out[s] == [[s, s + 1], [s + 10, s + 11], [s + 20, s + 21]]
+    # All three callers rode one (or at most two, if the flusher raced
+    # the submits) batched invocations — not three.
+    assert sum(calls) >= 3 and len(calls) <= 2, calls
+
+
+def test_batched_streaming_error_fans_out():
+    """A handler raising mid-stream fails every batched caller, after
+    delivering the chunks that preceded the error."""
+    from ray_tpu import serve
+
+    class Bad:
+        @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.01,
+                     stream=True)
+        def decode_batch(self, items):
+            yield [i * 2 for i in items]
+            raise RuntimeError("device fell over")
+
+        def run(self, x):
+            return self.decode_batch(x)
+
+    b = Bad()
+    gen = b.run(21)
+    assert next(gen) == 42
+    with pytest.raises(RuntimeError, match="fell over"):
+        list(gen)
