@@ -19,6 +19,11 @@ the replica. JSON lines; chunk 1 keeps the legacy metric names.
 
 Run: ``python benchmarks/serve_gpt.py [--clients 4] [--tokens 32]
 [--chunk 1,8]`` (CPU fallback shrinks the model).
+
+``--overload`` switches to the request-lifecycle A/B instead: offered
+load ~3x a 4-slot replica, once with an effectively unbounded admission
+queue and once with the bounded queue + 503/BackPressure shedding;
+reports shed rate, goodput, and completion p50/p99 per mode.
 """
 from __future__ import annotations
 
@@ -42,6 +47,15 @@ def main():
     parser.add_argument("--chunk", default="1,8",
                         help="comma-separated decode chunk sizes to A/B "
                              "(1 = per-token decode_step loop)")
+    parser.add_argument("--overload", action="store_true",
+                        help="overload A/B instead of the chunk A/B: drive "
+                             "the deployment past saturation twice — "
+                             "unbounded queue vs bounded queue + shedding — "
+                             "and report shed rate, goodput, and completion "
+                             "p99 per mode")
+    parser.add_argument("--overload-duration", type=float, default=8.0)
+    parser.add_argument("--overload-clients", type=int, default=24,
+                        help="concurrent clients (~3x a 4-slot replica)")
     args = parser.parse_args()
     chunks = [int(c) for c in args.chunk.split(",") if c.strip()]
 
@@ -165,6 +179,12 @@ def main():
     # Cache sized for the worst chunk over-run: the last fused chunk may
     # execute up to (chunk - 1) steps past max_new before truncation.
     max_len = 16 + max_new + max(max(chunks), 8)
+    if args.overload:
+        run_overload_ab(args, serve, GPTStream, cfg_name, max_len, chunks,
+                        f"gpt_{cfg_name}")
+        serve.shutdown()
+        rt.shutdown()
+        return
     handle = serve.run(GPTStream.bind(cfg_name, max_len, chunks),
                        name="gpt_stream", route_prefix="/generate")
     assert handle.options(method_name="warm").remote(16).result(
@@ -256,6 +276,10 @@ def main():
                 "dispatches_per_token": round(dpt, 4)}
 
     results = [run_mode(c) for c in chunks]
+    _finish_chunk_ab(results, model, serve, rt)
+
+
+def _finish_chunk_ab(results, model, serve, rt):
     if len(results) > 1:
         base = next((r for r in results if r["chunk"] == 1), results[0])
         best = min(results, key=lambda r: r["dispatches_per_token"])
@@ -266,6 +290,104 @@ def main():
             "unit": "x_fewer_dispatches", "modes": results}))
     serve.shutdown()
     rt.shutdown()
+
+
+def run_overload_ab(args, serve, GPTStream, cfg_name, max_len, chunks,
+                    model):
+    """Overload A/B (ISSUE 2 CI satellite): offered load ~3x a 4-slot
+    replica, once with an effectively unbounded admission queue and once
+    with the bounded queue + shedding. Reports shed rate, goodput
+    (completed tokens/s), and completion p50/p99 of ACCEPTED streams per
+    mode — the bounded mode should hold p99 roughly at the service time
+    of a full pipeline while the unbounded mode's p99 grows with the
+    queue."""
+    from ray_tpu.serve import BackPressureError, RequestDeadlineExceeded
+
+    chunk = max(chunks)
+    max_new = min(args.tokens, 8)
+    timeout_s = 10.0
+    summary = []
+    for mode, max_queued in (("unshed", 1_000_000), ("shed", 4)):
+        handle = serve.run(
+            GPTStream.options(num_replicas=1, max_ongoing_requests=4,
+                              max_queued_requests=max_queued)
+            .bind(cfg_name, max_len, chunks),
+            name="gpt_overload", route_prefix="/overload")
+        handle.options(method_name="warm").remote(16).result(timeout=600)
+        list(handle.options(stream=True).remote(
+            {"prompt_len": 16, "max_new": 2, "chunk": chunk}))
+
+        lock = threading.Lock()
+        stats = {"offered": 0, "completed": 0, "shed": 0, "expired": 0,
+                 "errors": 0, "tokens": 0}
+        completion_s = []
+        stop_at = time.perf_counter() + args.overload_duration
+
+        def client():
+            while time.perf_counter() < stop_at:
+                with lock:
+                    stats["offered"] += 1
+                t0 = time.perf_counter()
+                try:
+                    gen = handle.options(
+                        stream=True, timeout_s=timeout_s).remote(
+                        {"prompt_len": 16, "max_new": max_new,
+                         "chunk": chunk})
+                    n = 0
+                    for item in gen:
+                        n += len(item) if isinstance(item, list) else 1
+                    with lock:
+                        stats["completed"] += 1
+                        stats["tokens"] += n
+                        completion_s.append(time.perf_counter() - t0)
+                except BackPressureError:
+                    with lock:
+                        stats["shed"] += 1
+                    time.sleep(0.05)  # honor the backoff contract
+                except (RequestDeadlineExceeded, TimeoutError):
+                    with lock:
+                        stats["expired"] += 1
+                except Exception:  # noqa: BLE001
+                    with lock:
+                        stats["errors"] += 1
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(args.overload_clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        completion_s.sort()
+        p50 = completion_s[len(completion_s) // 2] if completion_s else None
+        p99 = completion_s[int(len(completion_s) * 0.99)] \
+            if completion_s else None
+        row = {
+            "metric": f"serve_{model}_overload_{mode}",
+            "value": round(stats["tokens"] / wall, 1),
+            "unit": "goodput_tokens_s",
+            "offered": stats["offered"], "completed": stats["completed"],
+            "shed": stats["shed"], "expired": stats["expired"],
+            "errors": stats["errors"],
+            "shed_rate": round(stats["shed"] / max(stats["offered"], 1), 3),
+            "completion_p50_s": round(p50, 3) if p50 else None,
+            "completion_p99_s": round(p99, 3) if p99 else None,
+            "clients": args.overload_clients,
+            "max_queued_requests": max_queued,
+        }
+        print(json.dumps(row))
+        summary.append(row)
+        serve.delete("gpt_overload")
+    if len(summary) == 2:
+        unshed, shed = summary
+        print(json.dumps({
+            "metric": f"serve_{model}_overload_ab_p99_ratio",
+            "value": round((unshed["completion_p99_s"] or 0)
+                           / max(shed["completion_p99_s"] or 1e-9, 1e-9), 2),
+            "unit": "x_p99_unshed_vs_shed",
+            "goodput_ratio": round(shed["value"]
+                                   / max(unshed["value"], 1e-9), 2)}))
 
 
 if __name__ == "__main__":

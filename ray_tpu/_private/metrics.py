@@ -245,5 +245,39 @@ def core_metrics() -> dict:
     return _core
 
 
+# ------------------------------------------------------------- serve set
+# Request-lifecycle counters for the serve data plane (shed / expired /
+# retried / overload re-picks). Incremented in whichever process observes
+# the event — proxy, router (caller), replica, batcher — and merged at
+# the head like every other instrument. Label conventions:
+# ``deployment`` names the deployment; ``where`` distinguishes the layer
+# that dropped the request (router | proxy | replica | batcher).
+_serve: dict = {}
+_serve_lock = threading.Lock()
+
+
+def serve_metrics() -> dict:
+    with _serve_lock:
+        if _serve:
+            return _serve
+        _serve.update(
+            requests_shed=Counter(
+                "serve_requests_shed_total",
+                "Requests shed under overload (backpressure / 503)"),
+            requests_expired=Counter(
+                "serve_requests_expired_total",
+                "Requests dropped because their deadline passed before "
+                "execution"),
+            retries=Counter(
+                "serve_request_retries_total",
+                "Budgeted request retries after replica failure"),
+            overload_repicks=Counter(
+                "serve_overload_repicks_total",
+                "Replica overload pushbacks answered by re-picking "
+                "another replica"),
+        )
+        return _serve
+
+
 def now() -> float:
     return time.time()

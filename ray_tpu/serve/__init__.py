@@ -14,14 +14,18 @@ from .config import (AutoscalingConfig, DeploymentConfig, HTTPOptions, gRPCOptio
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
-from .request import Request, Response
+from .request import (BackPressureError, ReplicaOverloadedError, Request,
+                      RequestDeadlineExceeded, Response,
+                      get_request_deadline)
 
 __all__ = [
-    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "Application", "AutoscalingConfig", "BackPressureError", "Deployment",
+    "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
-    "HTTPOptions", "gRPCOptions", "Request",
+    "HTTPOptions", "gRPCOptions", "ReplicaOverloadedError", "Request",
+    "RequestDeadlineExceeded",
     "Response", "batch", "default_buckets", "delete", "deployment",
-    "get_multiplexed_model_id", "multiplexed",
+    "get_multiplexed_model_id", "get_request_deadline", "multiplexed",
     "get_app_handle", "get_deployment_handle", "pad_to_bucket", "run",
     "shutdown", "start", "status",
 ]

@@ -36,6 +36,9 @@ class ServeController:
         # ensure_proxies() records the bind options.
         self._proxies: Dict[str, dict] = {}
         self._proxy_opts: Optional[dict] = None
+        # node_id -> {"shed_total", "expired_total"} pulled from each
+        # proxy on the health pass (request-lifecycle visibility).
+        self._proxy_stats: Dict[str, dict] = {}
         self._stop = threading.Event()
         self._loop_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="rt-serve-ctrl")
@@ -144,6 +147,10 @@ class ServeController:
                 return None
             return {"version": d["version"],
                     "max_ongoing_requests": d["config"].max_ongoing_requests,
+                    # Router-side pending bound before shedding with
+                    # BackPressureError (request-lifecycle layer).
+                    "max_queued_requests": getattr(
+                        d["config"], "max_queued_requests", 64),
                     "replicas": {rid: r["handle"]
                                  for rid, r in d["replicas"].items()},
                     # rid -> node_id, for locality-preferring routing
@@ -178,11 +185,24 @@ class ServeController:
                                    else "UPDATING"),
                         "replicas": n_healthy,
                         "target": d["target"],
+                        # Shed/expired/overload visibility (collected on
+                        # the health pass; see _health_check).
+                        "lifecycle": dict(d.get("lifecycle") or
+                                          {"expired": 0, "overloaded": 0,
+                                           "total": 0}),
                     }
                 apps[name] = {"route_prefix": app["route_prefix"],
                               "ingress": app["ingress"],
                               "deployments": deps}
-            return {"applications": apps, "http": self._http_info}
+            proxy_stats = dict(self._proxy_stats)
+            lifecycle = {
+                "proxy_shed_total": sum(s.get("shed_total", 0)
+                                        for s in proxy_stats.values()),
+                "proxy_expired_total": sum(s.get("expired_total", 0)
+                                           for s in proxy_stats.values()),
+            }
+            return {"applications": apps, "http": self._http_info,
+                    "lifecycle": lifecycle}
 
     def set_http_info(self, info: dict):
         self._http_info = info
@@ -266,16 +286,35 @@ class ServeController:
             return
         d["last_health"] = time.time()
         with self._lock:
-            probes = [(rid, r["handle"].check_health.remote())
+            probes = [(rid, r["handle"].check_health.remote(),
+                       r["handle"].get_metrics.remote())
                       for rid, r in d["replicas"].items()]
         dead = []
-        for rid, ref in probes:
+        # Live-replica lifecycle totals (expired / overloaded / served),
+        # piggybacked on the health pass and surfaced via status().
+        life = {"expired": 0, "overloaded": 0, "total": 0}
+        for rid, ref, mref in probes:
             try:
                 ok = rt.get(ref, timeout=5)
                 if not ok:
                     dead.append(rid)
+                    continue
             except Exception:  # noqa: BLE001 - died or hung
                 dead.append(rid)
+                continue
+            # Metrics scrape is best-effort: only a failed HEALTH probe
+            # may kill a replica — a momentarily stalled get_metrics
+            # (e.g. user code holding the GIL through a long compile)
+            # must not take down a healthy replica.
+            try:
+                m = rt.get(mref, timeout=5)
+                life["expired"] += int(m.get("expired", 0))
+                life["overloaded"] += int(m.get("overloaded", 0))
+                life["total"] += int(m.get("total", 0))
+            except Exception:  # noqa: BLE001 - totals dip this round
+                pass
+        if probes:
+            d["lifecycle"] = life
         if dead:
             with self._lock:
                 for rid in dead:
@@ -383,8 +422,13 @@ class ServeController:
         opts.setdefault("scheduling_strategy", "SPREAD")
         actor_cls = rt.remote(Replica).options(
             max_concurrency=cfg.max_ongoing_requests + 4, **opts)
+        # The replica enforces max_ongoing_requests itself: client-side
+        # admission undercounts when several routers share one replica,
+        # so the server gate (typed ReplicaOverloadedError pushback) is
+        # the authoritative one.
         handle = actor_cls.remote(app_name, dname, rid, d["payload"],
-                                  cfg.user_config)
+                                  cfg.user_config,
+                                  cfg.max_ongoing_requests)
         return rid, handle
 
     # ------------------------------------------------------------- proxies
@@ -444,10 +488,21 @@ class ServeController:
                 except Exception:  # noqa: BLE001 - proxy dead
                     with self._lock:
                         self._proxies.pop(nid, None)
+                        self._proxy_stats.pop(nid, None)
                     try:
                         rt.kill(handle)
                     except Exception:  # noqa: BLE001
                         pass
+                    continue
+                # Piggyback shed/expired totals for status(); tolerate
+                # adopted proxies predating the RPC.
+                try:
+                    stats = rt.get(handle.get_lifecycle_stats.remote(),
+                                   timeout=5)
+                    with self._lock:
+                        self._proxy_stats[nid] = stats
+                except Exception:  # noqa: BLE001 - older proxy
+                    pass
         opts = self._proxy_opts
         primary_missing = not any(p["name"] == "SERVE_PROXY"
                                   for p in self._proxies.values())

@@ -19,11 +19,28 @@ import time
 import traceback
 from typing import Dict, Optional
 
+from ..exceptions import GetTimeoutError, TaskError
 from .config import SERVE_CONTROLLER_NAME
 from .handle import DeploymentHandle
-from .request import Request, Response, encode_body
+from .request import (BackPressureError, Request, RequestDeadlineExceeded,
+                      Response, encode_body)
 
 _MAX_BODY = 256 * 1024 * 1024
+
+
+def _is_backpressure(e: Exception) -> bool:
+    """Shed signal, raised locally by this proxy's router or re-raised
+    TaskError-wrapped from a composed deployment's nested handle call."""
+    return isinstance(e, BackPressureError) or (
+        isinstance(e, TaskError)
+        and getattr(e, "cause_type", "") == "BackPressureError")
+
+
+def _is_deadline(e: Exception) -> bool:
+    return isinstance(e, (RequestDeadlineExceeded, GetTimeoutError,
+                          TimeoutError)) or (
+        isinstance(e, TaskError)
+        and getattr(e, "cause_type", "") == "RequestDeadlineExceeded")
 
 
 class ProxyActor:
@@ -40,6 +57,10 @@ class ProxyActor:
         self._port: Optional[int] = None
         self._started = threading.Event()
         self._request_timeout_s = 60.0
+        # Lifecycle accounting (pulled by the controller for status()).
+        self._stats_lock = threading.Lock()
+        self._shed_total = 0
+        self._expired_total = 0
 
     def start(self, host: str, port: int, request_timeout_s: float = 60.0
               ) -> dict:
@@ -138,7 +159,11 @@ class ProxyActor:
                 req = await self._read_request(reader)
                 if req is None:
                     break
-                status, ctype, body = await self._dispatch(req)
+                status, ctype, body, *rest = await self._dispatch(req)
+                extra = rest[0] if rest else {}
+                hdr_extra = "".join(f"{k}: {v}\r\n"
+                                    for k, v in (extra or {}).items()
+                                    ).encode()
                 keep = req.headers.get("connection", "").lower() != "close"
                 if callable(body):
                     # Streaming response: chunked transfer encoding, one
@@ -147,6 +172,7 @@ class ProxyActor:
                     writer.write(
                         b"HTTP/1.1 %d %s\r\n" % (status, _reason(status)) +
                         b"Content-Type: %s\r\n" % ctype.encode() +
+                        hdr_extra +
                         b"Transfer-Encoding: chunked\r\n" +
                         (b"Connection: keep-alive\r\n" if keep
                          else b"Connection: close\r\n") + b"\r\n")
@@ -170,6 +196,7 @@ class ProxyActor:
                 writer.write(
                     b"HTTP/1.1 %d %s\r\n" % (status, _reason(status)) +
                     b"Content-Type: %s\r\n" % ctype.encode() +
+                    hdr_extra +
                     b"Content-Length: %d\r\n" % len(body) +
                     (b"Connection: keep-alive\r\n" if keep
                      else b"Connection: close\r\n") +
@@ -236,10 +263,9 @@ class ProxyActor:
                         self._pool, self._call_app_stream, target, req),
                     timeout=self._request_timeout_s)
             except asyncio.TimeoutError:
-                return 504, "text/plain", b"request timed out"
+                return self._timeout_response()
             except Exception as e:  # noqa: BLE001
-                return 500, "text/plain", (
-                    f"{type(e).__name__}: {e}".encode())
+                return self._error_response(e)
 
             def next_chunk():
                 """Blocking puller run on the proxy pool; None ends the
@@ -267,15 +293,47 @@ class ProxyActor:
                     self._pool, self._call_app, target, req),
                 timeout=self._request_timeout_s)
         except asyncio.TimeoutError:
-            return 504, "text/plain", b"request timed out"
+            return self._timeout_response()
         except Exception as e:  # noqa: BLE001
-            return 500, "text/plain", (
-                f"{type(e).__name__}: {e}".encode())
+            return self._error_response(e)
         if isinstance(result, Response):
             status, ctype, body = result.encode()
             return status, ctype, body
         ctype, body = encode_body(result)
         return 200, ctype, body
+
+    # --------------------------------------------------- lifecycle mapping
+    def _timeout_response(self):
+        with self._stats_lock:
+            self._expired_total += 1
+        return 504, "text/plain", b"request timed out"
+
+    def _error_response(self, e: Exception):
+        """Map request-lifecycle errors onto HTTP semantics: shed →
+        ``503`` + ``Retry-After`` (the client contract: back off at
+        least that many seconds before resubmitting — the deployment is
+        saturated, not broken); expired → ``504``; anything else →
+        ``500``."""
+        if _is_backpressure(e):
+            retry_after = max(1, int(round(
+                getattr(e, "retry_after_s", 1.0) or 1.0)))
+            with self._stats_lock:
+                self._shed_total += 1
+            from .._private.metrics import serve_metrics
+
+            serve_metrics()["requests_shed"].inc(labels={"where": "proxy"})
+            return (503, "text/plain",
+                    b"deployment overloaded; request shed",
+                    {"Retry-After": str(retry_after)})
+        if _is_deadline(e):
+            return self._timeout_response()
+        return 500, "text/plain", f"{type(e).__name__}: {e}".encode()
+
+    def get_lifecycle_stats(self) -> dict:
+        """Shed/expired totals since proxy start (controller status)."""
+        with self._stats_lock:
+            return {"shed_total": self._shed_total,
+                    "expired_total": self._expired_total}
 
     def _call_app(self, target: dict, req: Request):
         # Server span per request (recorded only when tracing is on in
@@ -287,9 +345,12 @@ class ProxyActor:
 
         with tracing.span(f"http {req.method} {req.path}", kind="server",
                           route=target.get("prefix", "")):
-            handle = DeploymentHandle(target["app"], target["ingress"])
-            return handle.remote(req).result(
-                timeout=self._request_timeout_s)
+            # The handle stamps the absolute deadline at submission from
+            # timeout_s; result() inherits it, so the replica, batcher,
+            # and any retry all share ONE request-scoped window.
+            handle = DeploymentHandle(target["app"], target["ingress"],
+                                      timeout_s=self._request_timeout_s)
+            return handle.remote(req).result()
 
     def _call_app_stream(self, target: dict, req: Request):
         """Returns (generator, ManualSpan-or-None). The server span must
@@ -302,7 +363,8 @@ class ProxyActor:
             f"http {req.method} {req.path} [stream]", "server",
             route=target.get("prefix", ""))
         handle = DeploymentHandle(target["app"], target["ingress"],
-                                  stream=True)
+                                  stream=True,
+                                  timeout_s=self._request_timeout_s)
         if ms is None:
             return handle.remote(req), None
         with ms.activate():
@@ -373,16 +435,36 @@ class ProxyActor:
         return Request(method="GRPC", path=method, headers=headers,
                        body=bytes(data))
 
+    def _grpc_status(self, e: Exception):
+        """gRPC twin of ``_error_response``: shed → RESOURCE_EXHAUSTED
+        (with the same retry-after contract in the detail string),
+        expired → DEADLINE_EXCEEDED."""
+        import grpc
+
+        if _is_backpressure(e):
+            with self._stats_lock:
+                self._shed_total += 1
+            from .._private.metrics import serve_metrics
+
+            serve_metrics()["requests_shed"].inc(labels={"where": "proxy"})
+            retry_after = max(1, int(round(
+                getattr(e, "retry_after_s", 1.0) or 1.0)))
+            return (grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"deployment overloaded; retry after {retry_after}s")
+        if _is_deadline(e):
+            with self._stats_lock:
+                self._expired_total += 1
+            return grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out"
+        return grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+
     def _grpc_unary_call(self, target: dict, method: str):
         def call(data, context):
             try:
                 result = self._call_app(
                     target, self._grpc_request(method, data, context))
             except Exception as e:  # noqa: BLE001
-                import grpc
-
-                context.abort(grpc.StatusCode.INTERNAL,
-                              f"{type(e).__name__}: {e}")
+                code, detail = self._grpc_status(e)
+                context.abort(code, detail)
                 return b""
             if isinstance(result, Response):
                 _, _, body = result.encode()
@@ -402,16 +484,15 @@ class ProxyActor:
                 if span is not None:
                     span.finish()
             except Exception as e:  # noqa: BLE001
-                import grpc
-
                 if span is not None:
                     span.finish("error")
-                context.abort(grpc.StatusCode.INTERNAL,
-                              f"{type(e).__name__}: {e}")
+                code, detail = self._grpc_status(e)
+                context.abort(code, detail)
 
         return call
 
 
 def _reason(status: int) -> bytes:
     return {200: b"OK", 404: b"Not Found", 500: b"Internal Server Error",
+            503: b"Service Unavailable",
             504: b"Gateway Timeout"}.get(status, b"Unknown")

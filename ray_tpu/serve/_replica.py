@@ -13,18 +13,34 @@ import asyncio
 import inspect
 import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import cloudpickle
+
+from .request import (ReplicaOverloadedError, RequestDeadlineExceeded,
+                      _request_deadline, deadline_expired)
+
+#: Bound on the fault-injection invocation log (test hook, see below).
+_INVOCATION_LOG_CAP = 10_000
 
 
 class Replica:
     """Created by the controller with
     ``max_concurrency = max_ongoing_requests + headroom`` so that metrics and
-    health probes still run while requests saturate the pool."""
+    health probes still run while requests saturate the pool.
+
+    Request lifecycle (server half; ``handle.py`` is the client half):
+    every request is admitted under the lock BEFORE user code runs —
+    a replica at ``max_ongoing_requests`` pushes back with the typed
+    ``ReplicaOverloadedError`` (the router re-picks, it does not mark
+    the replica dead), and a request whose absolute deadline already
+    passed is dropped with ``RequestDeadlineExceeded`` so TPU cycles are
+    never spent computing answers nobody is waiting for. The deadline is
+    exposed to user code (and the batcher) via a contextvar."""
 
     def __init__(self, app_name: str, deployment_name: str, replica_id: str,
-                 payload: bytes, user_config: Any = None):
+                 payload: bytes, user_config: Any = None,
+                 max_ongoing_requests: int = 0):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.replica_id = replica_id
@@ -38,22 +54,83 @@ class Replica:
         self._lock = threading.Lock()
         self._ongoing = 0
         self._total = 0
+        # Server-side admission bound; 0 = unlimited (the controller
+        # passes the deployment's max_ongoing_requests).
+        self._max_ongoing = int(max_ongoing_requests or 0)
+        self._expired = 0
+        self._overloaded = 0
         self._start_time = time.time()
+        # Fault-injection hook (armed via set_fault_injection; testing
+        # only): optional per-request latency/error plus an invocation
+        # log recording (method, start, deadline) for every admitted
+        # request — overload and deadline tests assert on it instead of
+        # relying on real slowness.
+        self._fault: Dict[str, Any] = {}
+        self._invocations: list = []
         if user_config is not None:
             self.reconfigure(user_config)
 
     # ------------------------------------------------------------ data plane
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict,
-                       ctx: dict = None):
+    def _admit(self, method_name: str, ctx: Optional[dict]
+               ) -> Optional[float]:
+        """Admission gate run before any user code; returns the request
+        deadline. Raises the typed pushback/expiry errors."""
+        deadline = (ctx or {}).get("deadline_s")
         with self._lock:
+            if deadline_expired(deadline):
+                self._expired += 1
+                self._count_lifecycle("requests_expired", "replica")
+                raise RequestDeadlineExceeded(
+                    f"request deadline passed before {self.replica_id} "
+                    f"started {method_name}")
+            if self._max_ongoing and self._ongoing >= self._max_ongoing:
+                self._overloaded += 1
+                raise ReplicaOverloadedError(
+                    f"{self.replica_id} at max_ongoing_requests="
+                    f"{self._max_ongoing}")
             self._ongoing += 1
             self._total += 1
+        return deadline
+
+    def _count_lifecycle(self, name: str, where: str):
+        from .._private.metrics import serve_metrics
+
+        serve_metrics()[name].inc(
+            labels={"deployment": self.deployment_name, "where": where})
+
+    def _pre_invoke(self, method_name: str, deadline: Optional[float]):
+        """Fault-injection hook: log the invocation, then apply the
+        configured latency/error. A no-op unless armed."""
+        fi = self._fault
+        if not fi:
+            return
+        with self._lock:
+            self._invocations.append(
+                {"method": method_name, "start": time.time(),
+                 "deadline": deadline})
+            if len(self._invocations) > _INVOCATION_LOG_CAP:
+                del self._invocations[:-_INVOCATION_LOG_CAP]
+        if fi.get("latency_s"):
+            time.sleep(fi["latency_s"])
+        rate = fi.get("error_rate", 0.0)
+        if rate:
+            import random
+
+            if random.random() < rate:
+                raise RuntimeError(
+                    f"injected fault on {self.replica_id}.{method_name}")
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict,
+                       ctx: dict = None):
+        deadline = self._admit(method_name, ctx)
         token = None
         if ctx and ctx.get("multiplexed_model_id"):
             from .multiplex import _request_model_id
 
             token = _request_model_id.set(ctx["multiplexed_model_id"])
+        dl_token = _request_deadline.set(deadline)
         try:
+            self._pre_invoke(method_name, deadline)
             if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
                 method = self._user
             else:
@@ -66,6 +143,7 @@ class Replica:
                 out = asyncio.run(out)
             return out
         finally:
+            _request_deadline.reset(dl_token)
             if token is not None:
                 from .multiplex import _request_model_id
 
@@ -88,15 +166,15 @@ class Replica:
         ``ctx["flatten_chunks"]``, which re-yields each list/tuple item
         element-wise so per-token consumers keep token granularity
         without a second code path on the replica."""
-        with self._lock:
-            self._ongoing += 1
-            self._total += 1
+        deadline = self._admit(method_name, ctx)
         token = None
         if ctx and ctx.get("multiplexed_model_id"):
             from .multiplex import _request_model_id
 
             token = _request_model_id.set(ctx["multiplexed_model_id"])
+        dl_token = _request_deadline.set(deadline)
         try:
+            self._pre_invoke(method_name, deadline)
             items = self._user_stream(method_name, args, kwargs)
             if ctx and ctx.get("flatten_chunks"):
                 for item in items:
@@ -113,6 +191,7 @@ class Replica:
             else:
                 yield from items
         finally:
+            _request_deadline.reset(dl_token)
             if token is not None:
                 from .multiplex import _request_model_id
 
@@ -162,7 +241,33 @@ class Replica:
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:
             return {"replica_id": self.replica_id, "ongoing": self._ongoing,
-                    "total": self._total, "uptime": time.time() - self._start_time}
+                    "total": self._total,
+                    "expired": self._expired,
+                    "overloaded": self._overloaded,
+                    "uptime": time.time() - self._start_time}
+
+    def set_fault_injection(self, latency_s: float = 0.0,
+                            error_rate: float = 0.0) -> bool:
+        """Arm the per-request fault-injection hook (testing only): every
+        admitted request is logged, then delayed ``latency_s`` and failed
+        with probability ``error_rate`` before user code runs."""
+        with self._lock:
+            self._fault = {"latency_s": float(latency_s),
+                           "error_rate": float(error_rate)}
+            self._invocations = []
+        return True
+
+    def clear_fault_injection(self) -> bool:
+        with self._lock:
+            self._fault = {}
+        return True
+
+    def get_invocation_log(self) -> list:
+        """Invocation records ({method, start, deadline}) captured while
+        fault injection is armed — the overload tests assert that no
+        invocation STARTED after its request deadline."""
+        with self._lock:
+            return list(self._invocations)
 
     def get_node_id(self):
         """The node hosting this replica (locality routing hint)."""

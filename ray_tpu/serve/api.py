@@ -40,6 +40,7 @@ class Deployment:
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
                 autoscaling_config: Union[None, dict,
                                           AutoscalingConfig] = None,
                 user_config: Any = None,
@@ -52,6 +53,8 @@ class Deployment:
             updates["num_replicas"] = num_replicas
         if max_ongoing_requests is not None:
             updates["max_ongoing_requests"] = max_ongoing_requests
+        if max_queued_requests is not None:
+            updates["max_queued_requests"] = max_queued_requests
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -87,6 +90,7 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
                name: Optional[str] = None,
                num_replicas: Union[int, str, None] = None,
                max_ongoing_requests: Optional[int] = None,
+               max_queued_requests: Optional[int] = None,
                autoscaling_config: Union[None, dict,
                                          AutoscalingConfig] = None,
                user_config: Any = None,
@@ -97,6 +101,35 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
 
     ``num_replicas="auto"`` enables autoscaling with default bounds, like the
     reference's ``handle_num_replicas_auto``.
+
+    **Request lifecycle** (deadline → budgeted retry → shed):
+
+    - Every request is stamped with an absolute deadline at the edge
+      (HTTP proxy: ``request_timeout_s``; handles:
+      ``handle.options(timeout_s=...)``, default 60 s) and carries it
+      proxy → router → replica → batcher. A replica drops an
+      already-expired request before invoking user code and the batcher
+      drops expired entries at flush time, so no device cycles are spent
+      on answers nobody is waiting for; callers see
+      ``RequestDeadlineExceeded`` (HTTP ``504``). User code can read its
+      remaining budget via ``serve.get_request_deadline()``.
+    - ``DeploymentResponse.result()`` retries replica death with
+      exponential backoff + jitter, deducting elapsed time (a retry
+      never restarts the window), and spends a per-router **retry
+      budget** (token bucket fed ~10% of successes plus a small
+      reserve) so a dying deployment can't amplify its own load with a
+      retry storm. Streaming calls transparently re-route as long as no
+      item has been delivered. When the budget or attempts are
+      exhausted, the ORIGINAL error raises.
+    - ``max_ongoing_requests`` is enforced on the replica itself: a
+      saturated replica answers with a typed overload pushback and the
+      router re-picks another replica without marking it dead. Once
+      every replica is saturated and ``max_queued_requests`` callers
+      are already queued, submissions shed with ``BackPressureError`` —
+      the HTTP proxy maps it to ``503`` with a ``Retry-After`` header
+      (the client contract: back off at least that many seconds), gRPC
+      to ``RESOURCE_EXHAUSTED``. Shed/expired/retry counters are
+      exported via ``_private.metrics`` and ``serve.status()``.
     """
 
     def decorate(obj):
@@ -113,6 +146,8 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
             cfg.num_replicas = int(nr)
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         cfg.autoscaling_config = asc
         if user_config is not None:
             cfg.user_config = user_config

@@ -42,6 +42,9 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from .request import (RequestDeadlineExceeded, deadline_expired,
+                      get_request_deadline)
+
 
 def default_buckets(max_batch_size: int) -> List[int]:
     """Powers of two up to (and including) max_batch_size."""
@@ -97,15 +100,17 @@ class _BatchQueue:
             target=self._flusher, daemon=True, name="rt-serve-batch")
         self._thread.start()
 
-    def submit(self, item) -> "concurrent.futures.Future":
+    def submit(self, item,
+               deadline_s: Optional[float] = None
+               ) -> "concurrent.futures.Future":
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
-        self.q.put((item, fut))
+        self.q.put((item, fut, deadline_s))
         return fut
 
     def _flusher(self):
         while True:
-            item, fut = self.q.get()
-            batch = [(item, fut)]
+            entry = self.q.get()
+            batch = [entry]
             deadline = time.monotonic() + self.timeout_s
             while len(batch) < self.max_batch_size:
                 remaining = deadline - time.monotonic()
@@ -117,7 +122,29 @@ class _BatchQueue:
                     break
             self._run_batch(batch)
 
+    def _drop_expired(self, batch):
+        """Flush-time expiry sweep: entries whose request deadline passed
+        while queued are failed out of the batch instead of padding it —
+        the device dispatch never spends cycles on answers whose callers
+        already gave up. Returns the still-live entries."""
+        live = []
+        for item, fut, dl in batch:
+            if deadline_expired(dl):
+                if not fut.done():
+                    fut.set_exception(RequestDeadlineExceeded(
+                        "request expired while queued for batching"))
+                from .._private.metrics import serve_metrics
+
+                serve_metrics()["requests_expired"].inc(
+                    labels={"where": "batcher"})
+            else:
+                live.append((item, fut, dl))
+        return live
+
     def _run_batch(self, batch):
+        batch = self._drop_expired(batch)
+        if not batch:
+            return  # every caller's deadline passed: skip the dispatch
         items = [b[0] for b in batch]
         futs = [b[1] for b in batch]
         self.batch_sizes.append(len(items))
@@ -264,8 +291,11 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                 self_obj, item = args
             else:
                 self_obj, (item,) = None, args
+            # Inherit the caller's request deadline (set by the replica
+            # around user code) so queued entries can be dropped at
+            # flush time once nobody is waiting for them.
             out = _mod._queue_for(self_obj, key, fn, cfg).submit(
-                item).result()
+                item, deadline_s=get_request_deadline()).result()
             return _drain_stream(out) if stream else out
 
         wrapper.__rt_is_batched__ = True

@@ -43,6 +43,13 @@ class DeploymentConfig:
 
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    #: Pending-queue bound per router: once every replica is saturated
+    #: AND this many callers are already waiting for admission, further
+    #: submissions are shed with ``BackPressureError`` (HTTP 503 +
+    #: ``Retry-After`` at the proxy) instead of queuing without bound.
+    #: Bounded queues are what keep accepted-request tail latency flat
+    #: under overload — see the request-lifecycle notes in ``api.py``.
+    max_queued_requests: int = 64
     autoscaling_config: Optional[AutoscalingConfig] = None
     user_config: Any = None
     health_check_period_s: float = 2.0

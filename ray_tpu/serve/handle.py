@@ -10,6 +10,21 @@ Design differences from the reference: the router lives entirely in the
 caller process (no dedicated router actors), tracks in-flight counts
 locally, and learns replica membership by polling the controller with a
 version number — membership changes are rare; request dispatch is hot.
+
+Request lifecycle (this module is the client half; ``_replica.py`` is
+the server half):
+
+- every submission is stamped with an **absolute deadline** that rides
+  the request context to the replica and the batcher, so no layer
+  restarts its own timeout window (``request.py``);
+- retries are **budgeted**: a per-router token bucket earns a fraction
+  of each success and spends one token per retry, so a dying deployment
+  degrades to its organic failure rate instead of melting the cluster
+  with a retry storm; retries back off exponentially with jitter;
+- a replica's typed ``ReplicaOverloadedError`` pushback means
+  "re-pick, don't mark dead"; when every replica is saturated and the
+  pending queue is past ``max_queued_requests``, submissions shed with
+  ``BackPressureError`` instead of queuing without bound.
 """
 from __future__ import annotations
 
@@ -18,8 +33,12 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from ..exceptions import (ActorDiedError, ActorUnavailableError, RayTpuError,
-                          TaskError, WorkerCrashedError)
+from ..exceptions import (ActorDiedError, ActorUnavailableError,
+                          GetTimeoutError, RayTpuError, TaskError,
+                          WorkerCrashedError)
+from .request import (BackPressureError, ReplicaOverloadedError,
+                      RequestDeadlineExceeded, deadline_expired,
+                      get_request_deadline, make_deadline, remaining_s)
 
 _RETRYABLE_CAUSES = ("ActorDiedError", "ActorUnavailableError",
                      "WorkerCrashedError", "ConnectionLost",
@@ -42,6 +61,28 @@ def _is_replica_failure(e: Exception) -> bool:
     from ..core.worker import ACTOR_NOT_ON_WORKER
 
     return ACTOR_NOT_ON_WORKER in str(e)
+
+
+def _is_overload(e: Exception) -> bool:
+    """Replica-side admission pushback (crosses the wire as TaskError)."""
+    return isinstance(e, ReplicaOverloadedError) or (
+        isinstance(e, TaskError)
+        and getattr(e, "cause_type", "") == "ReplicaOverloadedError")
+
+
+def _is_deadline_error(e: Exception) -> bool:
+    """The replica (or batcher) dropped the request as already expired."""
+    return isinstance(e, RequestDeadlineExceeded) or (
+        isinstance(e, TaskError)
+        and getattr(e, "cause_type", "") == "RequestDeadlineExceeded")
+
+
+def _serve_counters():
+    from .._private.metrics import serve_metrics
+
+    return serve_metrics()
+
+
 from .config import SERVE_CONTROLLER_NAME
 
 _routers: Dict[Tuple[str, str], "Router"] = {}
@@ -77,44 +118,149 @@ def reset_routers():
         _routers.clear()
 
 
+class RetryBudget:
+    """Finagle-style retry budget (token bucket).
+
+    Each success deposits ``deposit_ratio`` tokens; a small time-based
+    reserve trickles in so a cold or low-traffic router can still retry;
+    each retry withdraws one token. At steady state retries are capped at
+    ~``deposit_ratio`` of the success rate, which is what stops a dying
+    deployment from amplifying its own load with a retry storm."""
+
+    def __init__(self, deposit_ratio: float = 0.1,
+                 reserve_per_s: float = 2.0, cap: float = 100.0,
+                 initial: float = 10.0):
+        self.deposit_ratio = deposit_ratio
+        self.reserve_per_s = reserve_per_s
+        self.cap = cap
+        self._lock = threading.Lock()
+        self._tokens = min(initial, cap)
+        self._at = time.monotonic()
+
+    def _replenish_locked(self):
+        now = time.monotonic()
+        self._tokens = min(self.cap,
+                           self._tokens + (now - self._at)
+                           * self.reserve_per_s)
+        self._at = now
+
+    def record_success(self):
+        with self._lock:
+            self._replenish_locked()
+            self._tokens = min(self.cap, self._tokens + self.deposit_ratio)
+
+    def take(self) -> bool:
+        """Withdraw one retry token; False = budget exhausted, don't retry."""
+        with self._lock:
+            self._replenish_locked()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            self._replenish_locked()
+            return self._tokens
+
+
+def _backoff_sleep(backoff_s: float, deadline_s: Optional[float]):
+    """Jittered backoff, never sleeping past the request deadline."""
+    delay = backoff_s * (0.5 + random.random() * 0.5)
+    rem = remaining_s(deadline_s)
+    if rem is not None:
+        delay = min(delay, max(rem, 0.0))
+    if delay > 0:
+        time.sleep(delay)
+
+
 class DeploymentResponse:
     """Future-like result of ``handle.remote()``; also awaitable inside
-    async actors (delegates to the ObjectRef awaitable)."""
+    async actors (delegates to the ObjectRef awaitable).
+
+    ``result()`` owns the client half of the retry story: budgeted,
+    backoff-spaced resubmission on replica death, deadline-preserving
+    (a retry inherits the submission's remaining time instead of
+    restarting the full window), and overload re-picks that route
+    around saturated replicas without marking them dead."""
 
     def __init__(self, router: "Router", rid: str, ref,
-                 call: Tuple[str, tuple, dict], model_id: str = ""):
+                 call: Tuple[str, tuple, dict], model_id: str = "",
+                 deadline_s: Optional[float] = None):
         self._router = router
         self._rid = rid
         self._ref = ref
         self._call = call
         self._model_id = model_id
+        self._deadline_s = deadline_s
 
     @property
     def object_ref(self):
         return self._ref
 
     def result(self, timeout: Optional[float] = None,
-               _retries: int = 2) -> Any:
+               _retries: Optional[int] = None) -> Any:
         from .. import api as rt
 
-        try:
-            return rt.get(self._ref, timeout=timeout)
-        except Exception as e:  # noqa: BLE001
-            # Replica died mid-request: refresh membership and retry on a
-            # different replica (reference: router retry on
-            # ActorDiedError, ``router.py``).
-            if not _is_replica_failure(e):
-                raise
-            self._router.mark_dead(self._rid)
-            if _retries <= 0:
-                raise
-            method, args, kwargs = self._call
-            # Carry the multiplexed model id so a transparent retry
-            # still executes in the original tenant's context.
-            resp = self._router.submit(method, args, kwargs,
-                                       model_id=self._model_id)
-            self._rid, self._ref = resp._rid, resp._ref
-            return self.result(timeout=timeout, _retries=_retries - 1)
+        max_retries = (Router.DEFAULT_MAX_RETRIES if _retries is None
+                       else _retries)
+        # The wait window: an EXPLICIT result() timeout owns it (longer
+        # or shorter than the submission deadline — the caller said so);
+        # otherwise the submission's request deadline governs. Retries
+        # below resubmit with THIS deadline, so a retried call deducts
+        # time already spent instead of restarting the full 60 s window.
+        deadline = (make_deadline(timeout) if timeout is not None
+                    else self._deadline_s)
+        attempts = 0
+        backoff = Router.RETRY_BACKOFF_BASE_S
+        labels = {"deployment": self._router.deployment_name}
+        while True:
+            try:
+                out = rt.get(self._ref, timeout=remaining_s(deadline))
+                self._router.budget.record_success()
+                return out
+            except Exception as e:  # noqa: BLE001
+                if isinstance(e, GetTimeoutError):
+                    # With no explicit timeout, the wait bound IS the
+                    # request deadline — it can fire before the
+                    # replica's own typed rejection arrives; surface it
+                    # as the deadline error it is. An explicit
+                    # result(timeout=...) keeps its classic
+                    # GetTimeoutError semantics.
+                    if timeout is None and deadline_expired(deadline):
+                        raise RequestDeadlineExceeded(
+                            f"request to {self._router.deployment_name} "
+                            f"expired after "
+                            f"{self._call[0]!r} was submitted") from e
+                    raise
+                if _is_deadline_error(e):
+                    raise RequestDeadlineExceeded(
+                        f"request to {self._router.deployment_name} "
+                        f"expired before execution") from e
+                if _is_overload(e):
+                    # Typed pushback: the replica is full, not broken.
+                    # Re-pick another one; no budget spend, no mark_dead.
+                    self._router.note_overloaded(self._rid)
+                    _serve_counters()["overload_repicks"].inc(labels=labels)
+                elif _is_replica_failure(e):
+                    self._router.mark_dead(self._rid)
+                    if attempts >= max_retries \
+                            or deadline_expired(deadline) \
+                            or not self._router.budget.take():
+                        raise
+                    attempts += 1
+                    _serve_counters()["retries"].inc(labels=labels)
+                else:
+                    raise
+                _backoff_sleep(backoff, deadline)
+                backoff = min(backoff * 2, Router.RETRY_BACKOFF_CAP_S)
+                method, args, kwargs = self._call
+                # Carry the multiplexed model id so a transparent retry
+                # still executes in the original tenant's context.
+                resp = self._router.submit(method, args, kwargs,
+                                           deadline_s=deadline,
+                                           model_id=self._model_id)
+                self._rid, self._ref = resp._rid, resp._ref
 
     def __await__(self):
         return self._ref.__await__()
@@ -124,13 +270,29 @@ class DeploymentResponseGenerator:
     """Iterable result of ``handle.options(stream=True).remote()``
     (reference: ``serve/handle.py`` DeploymentResponseGenerator). Items
     arrive as the replica's generator yields them; in-flight accounting
-    is released once, on exhaustion, failure, or abandonment."""
+    is released once, on exhaustion, failure, or abandonment.
 
-    def __init__(self, router: "Router", rid: str, gen):
+    **Retry-before-first-item**: stream setup against a dead or
+    saturated replica transparently re-routes — budgeted and
+    backoff-spaced like unary retries — as long as no item has been
+    delivered yet. Once the caller holds an item the stream has state on
+    a specific replica and a mid-stream failure raises."""
+
+    def __init__(self, router: "Router", rid: str, gen,
+                 call: Optional[Tuple[str, tuple, dict]] = None,
+                 model_id: str = "", flatten_chunks: bool = False,
+                 deadline_s: Optional[float] = None):
         self._router = router
         self._rid = rid
         self._gen = gen
+        self._call = call
+        self._model_id = model_id
+        self._flatten_chunks = flatten_chunks
+        self._deadline_s = deadline_s
         self._done = False
+        self._got_first = False
+        self._reroutes = 0
+        self._backoff = Router.RETRY_BACKOFF_BASE_S
 
     def _finish(self):
         if not self._done:
@@ -145,16 +307,61 @@ class DeploymentResponseGenerator:
 
         if self._done:
             raise StopIteration
+        while True:
+            try:
+                try:
+                    ref = next(self._gen)
+                except StopIteration:
+                    self._finish()
+                    raise
+                item = rt.get(ref)
+            except StopIteration:
+                raise
+            except Exception as e:  # noqa: BLE001
+                if self._got_first or self._call is None \
+                        or not self._reroute(e):
+                    self._finish()
+                    raise
+                continue
+            if not self._got_first:
+                self._got_first = True
+                self._router.budget.record_success()
+            return item
+
+    def _reroute(self, e: Exception) -> bool:
+        """Re-route a not-yet-started stream; True = resubmitted."""
+        labels = {"deployment": self._router.deployment_name}
+        if deadline_expired(self._deadline_s) or _is_deadline_error(e):
+            return False
+        if _is_overload(e):
+            self._router.note_overloaded(self._rid)
+            _serve_counters()["overload_repicks"].inc(labels=labels)
+        elif _is_replica_failure(e):
+            self._router.mark_dead(self._rid)
+            if self._reroutes >= Router.DEFAULT_MAX_RETRIES \
+                    or not self._router.budget.take():
+                return False
+            self._reroutes += 1
+            _serve_counters()["retries"].inc(labels=labels)
+        else:
+            return False
+        _backoff_sleep(self._backoff, self._deadline_s)
+        self._backoff = min(self._backoff * 2, Router.RETRY_BACKOFF_CAP_S)
+        method, args, kwargs = self._call
         try:
-            ref = next(self._gen)
-        except StopIteration:
-            self._finish()
-            raise
-        try:
-            return rt.get(ref)
-        except Exception:
-            self._finish()
-            raise
+            rid, gen = self._router._submit_stream_raw(
+                method, args, kwargs, deadline_s=self._deadline_s,
+                model_id=self._model_id,
+                flatten_chunks=self._flatten_chunks)
+        except Exception:  # noqa: BLE001 - nothing admitted the re-route;
+            return False   # _finish() releases the old slot exactly once
+        # Old slot released only now: on the failure path mark_dead
+        # already dropped the rid (release is a no-op), and releasing
+        # the overloaded slot before a FAILED resubmit would let
+        # _finish() decrement the same slot twice.
+        self._router.release(self._rid)
+        self._rid, self._gen = rid, gen
+        return True
 
     def __del__(self):
         try:
@@ -169,7 +376,8 @@ class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str,
                  method_name: str = "__call__",
                  multiplexed_model_id: str = "", stream: bool = False,
-                 flatten_chunks: bool = False):
+                 flatten_chunks: bool = False,
+                 timeout_s: Optional[float] = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.method_name = method_name
@@ -179,17 +387,23 @@ class DeploymentHandle:
         # flatten_chunks the replica re-yields each slice element-wise
         # so this caller sees per-token items over the same transport.
         self.flatten_chunks = flatten_chunks
+        # Per-call deadline budget: requests submitted through this
+        # handle are stamped with now + timeout_s (None = router
+        # default). The proxy sets this from request_timeout_s so HTTP
+        # deadlines propagate end to end.
+        self.timeout_s = timeout_s
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.app_name, self.deployment_name, self.method_name,
                  self.multiplexed_model_id, self.stream,
-                 self.flatten_chunks))
+                 self.flatten_chunks, self.timeout_s))
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
                 stream: Optional[bool] = None,
-                flatten_chunks: Optional[bool] = None) -> "DeploymentHandle":
+                flatten_chunks: Optional[bool] = None,
+                timeout_s: Optional[float] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self.method_name,
@@ -197,22 +411,25 @@ class DeploymentHandle:
             else self.multiplexed_model_id,
             self.stream if stream is None else stream,
             self.flatten_chunks if flatten_chunks is None
-            else flatten_chunks)
+            else flatten_chunks,
+            self.timeout_s if timeout_s is None else timeout_s)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.app_name, self.deployment_name, name,
                                 self.multiplexed_model_id, self.stream,
-                                self.flatten_chunks)
+                                self.flatten_chunks, self.timeout_s)
 
     def remote(self, *args, **kwargs):
         router = get_router(self.app_name, self.deployment_name)
         if self.stream:
             return router.submit_stream(self.method_name, args, kwargs,
+                                        timeout_s=self.timeout_s,
                                         model_id=self.multiplexed_model_id,
                                         flatten_chunks=self.flatten_chunks)
         return router.submit(self.method_name, args, kwargs,
+                             timeout_s=self.timeout_s,
                              model_id=self.multiplexed_model_id)
 
     def __repr__(self):
@@ -221,10 +438,24 @@ class DeploymentHandle:
 
 
 class Router:
-    """Power-of-two-choices replica scheduler with local admission control."""
+    """Power-of-two-choices replica scheduler with local admission control,
+    budgeted retries, and bounded-queue load shedding."""
 
     MEMBERSHIP_TTL_S = 1.0
     _MODEL_AFFINITY_CAP = 1024
+    DEFAULT_TIMEOUT_S = 60.0
+    DEFAULT_MAX_RETRIES = 3
+    RETRY_BACKOFF_BASE_S = 0.05
+    RETRY_BACKOFF_CAP_S = 2.0
+    # Admission wait: starts fine-grained, decays to the cap while no
+    # replica admits (satellite fix: the old fixed 0.05 s wait +
+    # unconditional refresh() hammered the controller at ~20 Hz per
+    # blocked caller when no replica was up).
+    ADMISSION_BACKOFF_MIN_S = 0.02
+    ADMISSION_BACKOFF_MAX_S = 1.0
+    # How long an overload pushback keeps a replica out of the pick set
+    # (self-expiring: the mark heals even if no completion arrives).
+    SATURATION_MARK_S = 0.25
 
     def __init__(self, app_name: str, deployment_name: str):
         self.app_name = app_name
@@ -234,6 +465,7 @@ class Router:
         self._replicas: Dict[str, Any] = {}   # rid -> ActorHandle
         self._replica_nodes: Dict[str, Any] = {}  # rid -> node_id
         self._ongoing: Dict[str, int] = {}
+        self._saturated: Dict[str, float] = {}  # rid -> mark expiry
         self._version = -1
         # This process's node, for locality-preferring choice
         # (reference: pow_2_scheduler prefer-local-node ranking).
@@ -246,6 +478,9 @@ class Router:
         except Exception:  # noqa: BLE001
             self._local_node = None
         self._max_ongoing = 16
+        self._max_queued = 64
+        self._pending = 0  # callers blocked in the admission wait loop
+        self.budget = RetryBudget()
         self._last_refresh = 0.0
         self._outstanding: Dict[Any, str] = {}  # ObjectRef -> rid
         # model_id -> replica ids that served it (multiplex affinity).
@@ -285,10 +520,14 @@ class Router:
                 return
             self._version = info["version"]
             self._max_ongoing = info["max_ongoing_requests"]
+            self._max_queued = info.get("max_queued_requests",
+                                        self._max_queued)
             new = dict(info["replicas"])  # rid -> ActorHandle
             self._replicas = new
             self._replica_nodes = dict(info.get("replica_nodes") or {})
             self._ongoing = {rid: self._ongoing.get(rid, 0) for rid in new}
+            self._saturated = {rid: t for rid, t in self._saturated.items()
+                               if rid in new}
             # Membership changed: drop affinity entries for dead replicas.
             for mid in list(self._model_affinity):
                 kept = self._model_affinity[mid] & set(new)
@@ -302,86 +541,147 @@ class Router:
         with self._cond:
             self._replicas.pop(rid, None)
             self._ongoing.pop(rid, None)
+            self._saturated.pop(rid, None)
             self._last_refresh = 0.0
             self._cond.notify_all()
+
+    def note_overloaded(self, rid: str):
+        """Replica pushback: keep it out of the pick set briefly so
+        re-picks spread to other replicas; the mark self-expires (the
+        local in-flight estimate undercounted — other routers filled the
+        replica — so waiting for our own completions would never clear
+        it)."""
+        with self._cond:
+            if rid in self._replicas:
+                self._saturated[rid] = time.monotonic() \
+                    + self.SATURATION_MARK_S
 
     def close(self):
         self.closed = True
         self._waiter_wake.set()
 
     # ----------------------------------------------------------- data plane
-    def submit(self, method_name: str, args: tuple, kwargs: dict,
-               timeout_s: float = 60.0,
-               model_id: str = "") -> DeploymentResponse:
-        from .. import api as rt
+    def _acquire(self, deadline_s: Optional[float], model_id: str
+                 ) -> Tuple[str, Any]:
+        """Admission: block until a replica has an in-flight slot, with
+        capped exponential backoff between controller refreshes.
 
+        Sheds with ``BackPressureError`` when every known replica is
+        saturated AND ``max_queued_requests`` callers are already
+        waiting — bounded queues, not unbounded ones, are what keep
+        accepted-request latency flat under overload. An empty replica
+        set (deployment still starting) queues rather than sheds."""
         self.refresh()
-        deadline = time.monotonic() + timeout_s
-        while True:
-            with self._cond:
-                rid = self._pick_locked(model_id)
-                if rid is not None:
-                    self._ongoing[rid] += 1
-                    handle = self._replicas[rid]
-                    break
-                waited = self._cond.wait(timeout=0.05)
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica of {self.deployment_name} accepted the "
-                    f"request within {timeout_s}s")
-            if not waited:
-                self.refresh()
+        backoff = self.ADMISSION_BACKOFF_MIN_S
+        queued = False
+        try:
+            while True:
+                with self._cond:
+                    rid = self._pick_locked(model_id)
+                    if rid is not None:
+                        self._ongoing[rid] += 1
+                        return rid, self._replicas[rid]
+                    if not queued:
+                        if self._replicas \
+                                and self._pending >= self._max_queued:
+                            _serve_counters()["requests_shed"].inc(
+                                labels={"deployment": self.deployment_name,
+                                        "where": "router"})
+                            raise BackPressureError(
+                                f"all replicas of {self.deployment_name} "
+                                f"saturated and {self._pending} requests "
+                                f"already queued "
+                                f"(max_queued_requests="
+                                f"{self._max_queued})")
+                        self._pending += 1
+                        queued = True
+                    notified = self._cond.wait(timeout=backoff)
+                if deadline_expired(deadline_s):
+                    raise TimeoutError(
+                        f"no replica of {self.deployment_name} accepted "
+                        f"the request before its deadline")
+                if notified:
+                    backoff = self.ADMISSION_BACKOFF_MIN_S
+                else:
+                    backoff = min(backoff * 2, self.ADMISSION_BACKOFF_MAX_S)
+                    self.refresh()
+        finally:
+            if queued:
+                with self._cond:
+                    self._pending -= 1
+
+    def _stamp_deadline(self, timeout_s: Optional[float]) -> float:
+        """Fresh submission deadline: now + timeout, CAPPED by the
+        ambient request deadline when called from inside a replica (a
+        composed deployment's nested call inherits the outer request's
+        remaining time instead of minting a fresh 60 s window)."""
+        deadline_s = make_deadline(
+            self.DEFAULT_TIMEOUT_S if timeout_s is None else timeout_s)
+        ambient = get_request_deadline()
+        if ambient is not None and ambient < deadline_s:
+            deadline_s = ambient
+        return deadline_s
+
+    def submit(self, method_name: str, args: tuple, kwargs: dict,
+               timeout_s: Optional[float] = None,
+               model_id: str = "",
+               deadline_s: Optional[float] = None) -> DeploymentResponse:
+        # A fresh submission stamps its deadline once; a retry passes
+        # the original deadline through so the window never restarts.
+        if deadline_s is None:
+            deadline_s = self._stamp_deadline(timeout_s)
+        rid, handle = self._acquire(deadline_s, model_id)
+        ctx: Dict[str, Any] = {"deadline_s": deadline_s}
         if model_id:
             with self._cond:
                 self._model_affinity.setdefault(model_id, set()).add(rid)
                 self._model_affinity.move_to_end(model_id)
                 while len(self._model_affinity) > self._MODEL_AFFINITY_CAP:
                     self._model_affinity.popitem(last=False)
-            ref = handle.handle_request.remote(
-                method_name, args, kwargs, {"multiplexed_model_id":
-                                            model_id})
-        else:
-            ref = handle.handle_request.remote(method_name, args, kwargs)
+            ctx["multiplexed_model_id"] = model_id
+        ref = handle.handle_request.remote(method_name, args, kwargs, ctx)
         with self._cond:
             self._outstanding[ref] = rid
         self._waiter_wake.set()
         return DeploymentResponse(self, rid, ref,
-                                  (method_name, args, kwargs), model_id)
+                                  (method_name, args, kwargs), model_id,
+                                  deadline_s=deadline_s)
+
+    def _submit_stream_raw(self, method_name: str, args: tuple, kwargs: dict,
+                           deadline_s: Optional[float], model_id: str,
+                           flatten_chunks: bool) -> Tuple[str, Any]:
+        """Admission + dispatch for one stream attempt; returns
+        (rid, core streaming generator). Shared by first submission and
+        the generator's retry-before-first-item re-routes."""
+        rid, handle = self._acquire(deadline_s, model_id)
+        ctx: Dict[str, Any] = {"deadline_s": deadline_s}
+        if model_id:
+            ctx["multiplexed_model_id"] = model_id
+        if flatten_chunks:
+            ctx["flatten_chunks"] = True
+        gen = handle.handle_request_streaming.options(
+            num_returns="streaming").remote(method_name, args, kwargs, ctx)
+        return rid, gen
 
     def submit_stream(self, method_name: str, args: tuple, kwargs: dict,
-                      timeout_s: float = 60.0, model_id: str = "",
+                      timeout_s: Optional[float] = None, model_id: str = "",
                       flatten_chunks: bool = False
                       ) -> "DeploymentResponseGenerator":
         """Streaming dispatch: same admission + pow-2 pick as submit(),
         but the replica call rides the core streaming-generator
         transport and the in-flight slot is held until the stream ends
         (released by the DeploymentResponseGenerator, not the completion
-        loop — a stream has no single completion ref to wait on)."""
-        self.refresh()
-        deadline = time.monotonic() + timeout_s
-        while True:
-            with self._cond:
-                rid = self._pick_locked(model_id)
-                if rid is not None:
-                    self._ongoing[rid] += 1
-                    handle = self._replicas[rid]
-                    break
-                waited = self._cond.wait(timeout=0.05)
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica of {self.deployment_name} accepted the "
-                    f"request within {timeout_s}s")
-            if not waited:
-                self.refresh()
-        ctx = {}
-        if model_id:
-            ctx["multiplexed_model_id"] = model_id
-        if flatten_chunks:
-            ctx["flatten_chunks"] = True
-        ctx = ctx or None
-        gen = handle.handle_request_streaming.options(
-            num_returns="streaming").remote(method_name, args, kwargs, ctx)
-        return DeploymentResponseGenerator(self, rid, gen)
+        loop — a stream has no single completion ref to wait on). The
+        deadline bounds stream SETUP (time to first item); an
+        already-flowing stream may outlive it."""
+        deadline_s = self._stamp_deadline(timeout_s)
+        rid, gen = self._submit_stream_raw(
+            method_name, args, kwargs, deadline_s=deadline_s,
+            model_id=model_id, flatten_chunks=flatten_chunks)
+        return DeploymentResponseGenerator(
+            self, rid, gen, call=(method_name, args, kwargs),
+            model_id=model_id, flatten_chunks=flatten_chunks,
+            deadline_s=deadline_s)
 
     def release(self, rid: str):
         """Return one in-flight slot (stream finished or abandoned)."""
@@ -391,8 +691,13 @@ class Router:
             self._cond.notify_all()
 
     def _pick_locked(self, model_id: str = "") -> Optional[str]:
+        if self._saturated:
+            now = time.monotonic()
+            for r in [r for r, t in self._saturated.items() if t <= now]:
+                del self._saturated[r]
         rids = [r for r in self._replicas
-                if self._ongoing.get(r, 0) < self._max_ongoing]
+                if self._ongoing.get(r, 0) < self._max_ongoing
+                and r not in self._saturated]
         if not rids:
             return None
         if model_id:
@@ -450,4 +755,7 @@ class Router:
         with self._cond:
             return {"replicas": len(self._replicas),
                     "ongoing": dict(self._ongoing),
+                    "pending": self._pending,
+                    "saturated": len(self._saturated),
+                    "retry_tokens": self.budget.tokens(),
                     "version": self._version}

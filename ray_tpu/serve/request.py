@@ -1,17 +1,87 @@
-"""Picklable HTTP request/response surface handed to ingress deployments.
+"""Picklable HTTP request/response surface handed to ingress deployments,
+plus the request-lifecycle vocabulary shared by the whole serve data
+plane: absolute deadlines and the typed overload/expiry errors.
 
 The reference hands replicas a Starlette ``Request`` over ASGI
 (reference: ``python/ray/serve/_private/http_util.py``); this runtime ships
 a plain picklable snapshot instead, because requests cross a process
 boundary (proxy actor -> replica actor) rather than staying inside one
 asyncio app.
+
+Deadlines are **absolute wall-clock timestamps** (``time.time()``), like
+gRPC deadlines: a request is stamped once at the edge (proxy or handle)
+and every downstream hop — router admission, replica dispatch, the
+batcher's flush — compares against the same instant instead of restarting
+its own timeout window. Wall-clock (not monotonic) because the stamp
+crosses process boundaries; NTP-level skew is negligible against
+second-scale request timeouts.
 """
 from __future__ import annotations
 
+import contextvars
 import json as _json
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qsl, urlsplit
+
+from ..exceptions import RayTpuError
+
+
+class RequestDeadlineExceeded(RayTpuError, TimeoutError):
+    """The request's absolute deadline passed before (or while) a replica
+    could produce an answer. Never retried — nobody is waiting."""
+
+
+class ReplicaOverloadedError(RayTpuError):
+    """Typed replica pushback: the replica is at ``max_ongoing_requests``.
+
+    The router treats this as "re-pick another replica, don't mark this
+    one dead" — overload is a routing signal, not a failure."""
+
+
+class BackPressureError(RayTpuError):
+    """Every replica is saturated and the pending queue is past its bound;
+    the request was shed instead of queued. The HTTP proxy maps this to
+    ``503`` + ``Retry-After``; handle callers receive it directly.
+
+    ``retry_after_s`` is the server's backoff hint."""
+
+    def __init__(self, message: str = "deployment is overloaded",
+                 retry_after_s: float = 1.0):
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (BackPressureError, (str(self.args[0] if self.args else ""),
+                                    self.retry_after_s))
+
+
+def make_deadline(timeout_s: Optional[float]) -> Optional[float]:
+    """Absolute wall-clock deadline for a fresh request (None = no limit)."""
+    return None if timeout_s is None else _time.time() + timeout_s
+
+
+def remaining_s(deadline_s: Optional[float]) -> Optional[float]:
+    """Seconds until the deadline (may be <= 0); None = unbounded."""
+    return None if deadline_s is None else deadline_s - _time.time()
+
+
+def deadline_expired(deadline_s: Optional[float]) -> bool:
+    return deadline_s is not None and _time.time() > deadline_s
+
+
+#: Per-request deadline, set by the replica around user code so nested
+#: work (the batcher, composed handle calls) inherits the caller's
+#: deadline without threading it through user signatures.
+_request_deadline: "contextvars.ContextVar[Optional[float]]" = \
+    contextvars.ContextVar("rt_serve_request_deadline", default=None)
+
+
+def get_request_deadline() -> Optional[float]:
+    """Absolute deadline of the request being handled on this thread
+    (None outside a deadline-stamped request)."""
+    return _request_deadline.get()
 
 
 @dataclass

@@ -20,11 +20,21 @@ KV_LAST_CONFIG = "last_deploy_config"
 
 @dataclass
 class DeploymentSchema:
-    """Per-deployment override block (reference: ``DeploymentSchema``)."""
+    """Per-deployment override block (reference: ``DeploymentSchema``).
+
+    Request-lifecycle knobs: ``max_ongoing_requests`` is enforced BOTH
+    client-side (router admission) and server-side (the replica pushes
+    back with a typed overload error the router answers by re-picking);
+    ``max_queued_requests`` bounds how many callers may wait for
+    admission once every replica is saturated — beyond it the request is
+    shed (``BackPressureError``; HTTP ``503`` + ``Retry-After`` at the
+    proxy). Bounded queues keep accepted-request tail latency flat under
+    overload instead of letting it grow with the queue."""
 
     name: str
     num_replicas: Optional[int] = None
     max_ongoing_requests: Optional[int] = None
+    max_queued_requests: Optional[int] = None
     autoscaling_config: Optional[Dict[str, Any]] = None
     user_config: Any = None
     ray_actor_options: Optional[Dict[str, Any]] = None
@@ -146,6 +156,8 @@ def apply_overrides(spec: Dict[str, Any],
             cfg.num_replicas = o.num_replicas
         if o.max_ongoing_requests is not None:
             cfg.max_ongoing_requests = o.max_ongoing_requests
+        if o.max_queued_requests is not None:
+            cfg.max_queued_requests = o.max_queued_requests
         if o.autoscaling_config is not None:
             from .config import AutoscalingConfig
 

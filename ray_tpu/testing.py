@@ -158,3 +158,130 @@ class WorkerKiller:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+def _serve_replica_handles(app_name: str, deployment_name: str,
+                           timeout: float = 10.0) -> dict:
+    """Live replica handles ({rid: ActorHandle}) of one serve deployment,
+    straight from the controller's membership view."""
+    import ray_tpu as rt
+    from ray_tpu.serve.config import SERVE_CONTROLLER_NAME
+
+    ctrl = rt.get_actor(SERVE_CONTROLLER_NAME, timeout=timeout)
+    info = rt.get(ctrl.get_replicas.remote(app_name, deployment_name),
+                  timeout=timeout)
+    if info is None:
+        return {}
+    return dict(info["replicas"])
+
+
+def set_replica_fault_injection(app_name: str, deployment_name: str, *,
+                                latency_s: float = 0.0,
+                                error_rate: float = 0.0) -> int:
+    """Arm the per-request fault-injection hook on every live replica of
+    one deployment (latency + probabilistic errors applied BEFORE user
+    code, plus an invocation log). Returns how many replicas were armed.
+
+    This is how overload and deadline behavior is tested without real
+    slowness: ``latency_s`` saturates ``max_ongoing_requests`` on
+    demand, and the invocation log proves no request ran past its
+    deadline."""
+    import ray_tpu as rt
+
+    handles = _serve_replica_handles(app_name, deployment_name)
+    for h in handles.values():
+        rt.get(h.set_fault_injection.remote(latency_s, error_rate),
+               timeout=10)
+    return len(handles)
+
+
+def clear_replica_fault_injection(app_name: str, deployment_name: str) -> int:
+    import ray_tpu as rt
+
+    handles = _serve_replica_handles(app_name, deployment_name)
+    for h in handles.values():
+        rt.get(h.clear_fault_injection.remote(), timeout=10)
+    return len(handles)
+
+
+def get_replica_invocation_logs(app_name: str, deployment_name: str) -> list:
+    """Concatenated invocation records ({method, start, deadline}) from
+    every live replica with fault injection armed."""
+    import ray_tpu as rt
+
+    out = []
+    for h in _serve_replica_handles(app_name, deployment_name).values():
+        try:
+            out.extend(rt.get(h.get_invocation_log.remote(), timeout=10))
+        except Exception:  # noqa: BLE001 - replica died mid-collection
+            pass
+    return out
+
+
+class ReplicaKiller:
+    """Serve-aware sibling of ``WorkerKiller``: kills random replica
+    ACTORS of one deployment while traffic runs, exercising the serve
+    retry path (budgeted resubmission, membership refresh, controller
+    heal) rather than the task-retry path.
+
+    Usage::
+
+        with ReplicaKiller("app", "Deployment", interval_s=0.5) as killer:
+            ... drive traffic through the handle ...
+        assert killer.kills > 0
+    """
+
+    def __init__(self, app_name: str, deployment_name: str,
+                 interval_s: float = 0.5, max_kills: int = 1_000_000):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.kills = 0
+        self.killed_rids: list = []
+        self._stop = None
+        self._thread = None
+
+    def _loop(self):
+        import random
+
+        import ray_tpu as rt
+
+        while not self._stop.is_set() and self.kills < self.max_kills:
+            self._stop.wait(self.interval_s)
+            if self._stop.is_set():
+                return
+            try:
+                handles = _serve_replica_handles(self.app_name,
+                                                 self.deployment_name)
+            except Exception:  # noqa: BLE001 - serve tearing down
+                return
+            if not handles:
+                continue
+            rid = random.choice(list(handles))
+            try:
+                rt.kill(handles[rid])
+                self.kills += 1
+                self.killed_rids.append(rid)
+            except Exception:  # noqa: BLE001 - already dead
+                pass
+
+    def start(self) -> "ReplicaKiller":
+        import threading
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="replica-killer", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -29,6 +29,12 @@ import faulthandler  # noqa: E402
 
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'`; long chaos/soak variants opt out.
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 suite")
+
 # Hang watchdog: any single test running >120s dumps every thread's stack
 # AND every asyncio task's coroutine stack (the part thread dumps can't see)
 # to /tmp/rt_stacks_<pid>.txt (pytest's fd capture would swallow stderr).

@@ -1,0 +1,432 @@
+"""Request-lifecycle hardening under overload: deadline propagation,
+budgeted retries, and load shedding (ISSUE 2; reference: the reference's
+``test_request_timeout.py`` / backpressure tests, rebuilt for this
+runtime's proxy + router + replica admission stack).
+
+The fault-injection hook (``Replica.set_fault_injection`` via
+``ray_tpu.testing``) replaces real slowness: latency saturates
+``max_ongoing_requests`` on demand and the invocation log proves no
+request ever STARTED after its deadline."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+from ray_tpu.serve import (BackPressureError, RequestDeadlineExceeded)
+from ray_tpu.testing import (ReplicaKiller, clear_replica_fault_injection,
+                             get_replica_invocation_logs,
+                             set_replica_fault_injection)
+
+
+@pytest.fixture
+def serve_instance(rt_cluster):
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    yield serve
+    serve.shutdown()
+
+
+@serve.deployment
+class Echo:
+    def __call__(self, x):
+        if hasattr(x, "json"):  # HTTP ingress
+            x = x.json()
+        return {"y": x}
+
+
+def test_shed_503_with_retry_after(serve_instance):
+    """Offered load >> capacity: the proxy sheds with 503 + Retry-After
+    while accepted requests still answer correctly."""
+    app = Echo.options(num_replicas=1, max_ongoing_requests=2,
+                       max_queued_requests=2).bind()
+    serve.run(app, name="shed", route_prefix="/shed")
+    assert set_replica_fault_injection("shed", "Echo", latency_s=0.8) == 1
+    port = serve.status()["http"]["port"]
+
+    results = []
+    lock = threading.Lock()
+
+    def call(i):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/shed", data=json.dumps(i).encode())
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = (resp.status, json.loads(resp.read()), None)
+        except urllib.error.HTTPError as e:
+            out = (e.code, None, e.headers.get("Retry-After"))
+        with lock:
+            results.append((i, out))
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    ok = [(i, r) for i, r in results if r[0] == 200]
+    shed = [(i, r) for i, r in results if r[0] == 503]
+    assert shed, f"nothing shed: {[r[0] for _, r in results]}"
+    assert ok, "everything shed; accepted requests must still answer"
+    for i, r in ok:
+        assert r[1] == {"y": i}
+    for _, r in shed:
+        assert r[2] is not None and int(r[2]) >= 1, \
+            f"503 without a Retry-After contract: {r!r}"
+
+    # Shed totals reach the controller's status dict via the proxy
+    # health pass (period 5 s).
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        life = serve.status().get("lifecycle", {})
+        if life.get("proxy_shed_total", 0) >= len(shed):
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail(f"status never surfaced shed counters: "
+                    f"{serve.status().get('lifecycle')}")
+    clear_replica_fault_injection("shed", "Echo")
+    serve.delete("shed")
+
+
+def test_handle_backpressure_typed_error(serve_instance):
+    """Handle callers get the typed BackPressureError (the gRPC/handle
+    equivalent of the proxy's 503), raised at submission time.
+
+    ``handle.remote()`` BLOCKS in router admission while a slot is
+    unavailable, so saturation needs background threads: one occupies
+    the single in-flight slot, one occupies the single queue slot, and
+    then the main thread's submission must shed immediately."""
+    app = Echo.options(num_replicas=1, max_ongoing_requests=1,
+                       max_queued_requests=1).bind()
+    h = serve.run(app, name="bp", route_prefix=None)
+    assert h.remote(1).result(timeout=10) == {"y": 1}  # warm the router
+    set_replica_fault_injection("bp", "Echo", latency_s=1.5)
+
+    def occupy():
+        try:
+            h.options(timeout_s=5.0).remote(0).result()
+        except Exception:  # noqa: BLE001 - only saturation matters here
+            pass
+
+    threads = [threading.Thread(target=occupy) for _ in range(2)]
+    threads[0].start()
+    time.sleep(0.3)  # thread 0 holds the in-flight slot (1.5 s latency)
+    threads[1].start()
+    time.sleep(0.3)  # thread 1 is parked in the admission queue
+    with pytest.raises(BackPressureError):
+        h.remote(99)
+    for t in threads:
+        t.join()
+    clear_replica_fault_injection("bp", "Echo")
+    serve.delete("bp")
+
+
+def test_expired_request_dropped_at_replica(serve_instance):
+    """A request whose deadline already passed is rejected before user
+    code runs — the invocation log records zero starts for it."""
+    app = Echo.options(num_replicas=1).bind()
+    h = serve.run(app, name="expired", route_prefix=None)
+    set_replica_fault_injection("expired", "Echo")  # arm logging only
+
+    with pytest.raises(RequestDeadlineExceeded):
+        h.options(timeout_s=0.0).remote(1).result()
+    assert get_replica_invocation_logs("expired", "Echo") == []
+
+    # A sane deadline still flows through to completion.
+    assert h.options(timeout_s=30.0).remote(2).result() == {"y": 2}
+    log = get_replica_invocation_logs("expired", "Echo")
+    assert len(log) == 1 and log[0]["deadline"] is not None
+    clear_replica_fault_injection("expired", "Echo")
+    serve.delete("expired")
+
+
+def test_expired_entry_dropped_at_batcher(serve_instance):
+    """The batcher drops entries whose deadline passed while queued; live
+    entries in the same flush still execute."""
+
+    @serve.deployment(max_ongoing_requests=8)
+    class Batched:
+        def __init__(self):
+            self.sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.4)
+        def predict(self, xs):
+            self.sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        def __call__(self, x):
+            return self.predict(x)
+
+        def seen(self, _):
+            return self.sizes
+
+    h = serve.run(Batched.bind(), name="batchdl", route_prefix=None)
+    errors = {}
+    results = {}
+
+    def call(i, timeout_s):
+        try:
+            results[i] = h.options(timeout_s=timeout_s).remote(i).result()
+        except Exception as e:  # noqa: BLE001
+            errors[i] = e
+
+    # Entry 0's 0.05 s deadline expires during the 0.4 s batch wait;
+    # entry 1 has plenty of budget and must survive the same flush.
+    t0 = threading.Thread(target=call, args=(0, 0.05))
+    t1 = threading.Thread(target=call, args=(1, 30.0))
+    t0.start()
+    t1.start()
+    t0.join()
+    t1.join()
+    assert results.get(1) == 2
+    assert isinstance(errors.get(0), RequestDeadlineExceeded), errors
+    sizes = h.seen.remote(None).result(timeout=10)
+    assert sizes and max(sizes) == 1, \
+        f"expired entry reached the batch handler: {sizes}"
+    serve.delete("batchdl")
+
+
+def test_nested_call_inherits_outer_deadline(serve_instance):
+    """A composed deployment's nested handle call inherits the OUTER
+    request's remaining deadline instead of minting a fresh 60 s window
+    — the whole call tree shares one budget."""
+
+    @serve.deployment
+    class Inner:
+        def __call__(self, x):
+            return x
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __call__(self, x):
+            # No explicit timeout: without inheritance this would wait
+            # the full 60 s default against the saturated Inner.
+            return self.inner.remote(x).result()
+
+    app = Outer.bind(Inner.options(num_replicas=1,
+                                   max_ongoing_requests=16).bind())
+    h = serve.run(app, name="nested", route_prefix=None)
+    assert h.remote(5).result(timeout=10) == 5
+    set_replica_fault_injection("nested", "Inner", latency_s=3.0)
+    t0 = time.time()
+    with pytest.raises((RequestDeadlineExceeded, TimeoutError)):
+        h.options(timeout_s=0.5).remote(1).result()
+    assert time.time() - t0 < 5, \
+        "nested call did not inherit the outer 0.5 s deadline"
+    clear_replica_fault_injection("nested", "Inner")
+    serve.delete("nested")
+
+
+def test_budgeted_retry_exhaustion_raises_original(serve_instance):
+    """With the retry budget drained, a replica failure surfaces as the
+    ORIGINAL error instead of silently resubmitting forever."""
+
+    @serve.deployment(num_replicas=1, health_check_period_s=30.0)
+    class Fragile:
+        def __call__(self, x):
+            return x
+
+        def die(self, _):
+            import os
+
+            os._exit(1)
+
+    h = serve.run(Fragile.bind(), name="exhaust", route_prefix=None)
+    assert h.remote(1).result(timeout=10) == 1
+
+    from ray_tpu.serve.handle import get_router
+
+    router = get_router("exhaust", "Fragile")
+    router.budget.reserve_per_s = 0.0  # no trickle back
+    with router.budget._lock:
+        router.budget._tokens = 0.0
+    t0 = time.time()
+    with pytest.raises(Exception) as ei:
+        h.die.remote(None).result(timeout=30)
+    # The original replica-death error, not a timeout and not a
+    # backpressure/deadline mapping.
+    assert not isinstance(ei.value, (BackPressureError,
+                                     RequestDeadlineExceeded, TimeoutError))
+    assert time.time() - t0 < 25, "exhausted budget should fail fast"
+    serve.delete("exhaust")
+
+
+def test_streaming_retry_before_first_item(serve_instance):
+    """Stream setup against a dead replica transparently re-routes as
+    long as no item was delivered (the router's membership view is up to
+    1 s stale after a kill — streams opened in that window land on the
+    corpse and must re-pick)."""
+
+    @serve.deployment(num_replicas=2, health_check_period_s=30.0)
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * 10
+
+    h = serve.run(Streamer.bind(), name="sretry", route_prefix=None)
+    # Warm the router's membership view, then kill one replica behind
+    # its back.
+    assert list(h.options(stream=True).remote(3)) == [0, 10, 20]
+    from ray_tpu.testing import _serve_replica_handles
+
+    handles = _serve_replica_handles("sretry", "Streamer")
+    assert len(handles) == 2
+    rt.kill(next(iter(handles.values())))
+    deadline = time.time() + 2
+    ok = 0
+    while time.time() < deadline:
+        out = list(h.options(stream=True).remote(4))
+        assert out == [0, 10, 20, 30], out
+        ok += 1
+    assert ok > 4  # several streams ran inside the stale-view window
+    serve.delete("sretry")
+
+
+def test_overload_no_invocation_after_deadline(serve_instance):
+    """Acceptance: under offered load >= 3x capacity, zero replica
+    invocations start after their request deadline has passed, and
+    accepted-request latency stays bounded by the deadline window."""
+    app = Echo.options(num_replicas=1, max_ongoing_requests=2,
+                       max_queued_requests=4).bind()
+    h = serve.run(app, name="satur", route_prefix=None)
+    set_replica_fault_injection("satur", "Echo", latency_s=0.25)
+
+    outcomes = {"ok": 0, "shed": 0, "expired": 0, "other": 0}
+    durations = []
+    lock = threading.Lock()
+    timeout_s = 2.0
+
+    def call(i):
+        t0 = time.time()
+        try:
+            h.options(timeout_s=timeout_s).remote(i).result()
+            key = "ok"
+        except BackPressureError:
+            key = "shed"
+        except (RequestDeadlineExceeded, TimeoutError):
+            key = "expired"
+        except Exception:  # noqa: BLE001
+            key = "other"
+        with lock:
+            outcomes[key] += 1
+            durations.append(time.time() - t0)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert outcomes["ok"] > 0, outcomes
+    assert outcomes["shed"] > 0, f"3x overload never shed: {outcomes}"
+    assert outcomes["other"] == 0, outcomes
+    # Bounded latency: nobody waited meaningfully past the deadline
+    # window (no unbounded queue growth).
+    assert max(durations) < timeout_s + 1.0, max(durations)
+    log = get_replica_invocation_logs("satur", "Echo")
+    assert log, "fault-injection log empty"
+    late = [e for e in log
+            if e["deadline"] is not None and e["start"] > e["deadline"]]
+    assert not late, f"{len(late)} invocations started past their deadline"
+    clear_replica_fault_injection("satur", "Echo")
+    serve.delete("satur")
+
+
+def test_kill_under_load_with_replica_killer(serve_instance):
+    """Kill-under-load (test_chaos.py pattern, serve edition): traffic
+    keeps making progress while a ReplicaKiller snipes replicas, and the
+    controller heals the deployment afterwards."""
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.2)
+    class Svc:
+        def __call__(self, x):
+            time.sleep(0.05)
+            return x + 1
+
+    h = serve.run(Svc.bind(), name="chaos", route_prefix=None)
+    ok = [0]
+    lock = threading.Lock()
+
+    def client(base):
+        for i in range(15):
+            try:
+                if h.remote(base + i).result(timeout=30) == base + i + 1:
+                    with lock:
+                        ok[0] += 1
+            except Exception:  # noqa: BLE001 - budget may run dry
+                pass
+
+    with ReplicaKiller("chaos", "Svc", interval_s=0.3) as killer:
+        threads = [threading.Thread(target=client, args=(100 * c,))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert killer.kills >= 1, "killer never fired"
+    assert ok[0] >= 45, f"only {ok[0]}/60 requests survived the chaos"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = serve.status()["applications"]["chaos"]["deployments"]["Svc"]
+        if st["replicas"] == 2:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("controller never healed back to 2 replicas")
+    serve.delete("chaos")
+
+
+@pytest.mark.slow
+def test_long_chaos_streams_and_unary_mixed(serve_instance):
+    """Long chaos soak (slow tier): mixed unary + streaming traffic under
+    sustained replica kills keeps a high goodput and ends healthy."""
+
+    @serve.deployment(num_replicas=3, health_check_period_s=0.2)
+    class Mixed:
+        def __call__(self, x):
+            time.sleep(0.01)
+            return x * 2
+
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    h = serve.run(Mixed.bind(), name="soak", route_prefix=None)
+    ok = [0]
+    total = [0]
+    lock = threading.Lock()
+
+    def client(c):
+        for i in range(30):
+            with lock:
+                total[0] += 1
+            try:
+                if i % 3 == 0:
+                    out = list(h.options(
+                        stream=True, method_name="stream").remote(4))
+                    good = out == [0, 1, 2, 3]
+                else:
+                    good = h.remote(i).result(timeout=30) == i * 2
+                if good:
+                    with lock:
+                        ok[0] += 1
+            except Exception:  # noqa: BLE001
+                pass
+
+    with ReplicaKiller("soak", "Mixed", interval_s=0.5) as killer:
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert killer.kills >= 3
+    assert ok[0] / total[0] >= 0.8, f"goodput {ok[0]}/{total[0]}"
+    serve.delete("soak")
