@@ -176,8 +176,10 @@ class HeadService:
         self._spawn_env = spawn_env_with_pkg_root()
         self.task_events: deque = deque(maxlen=100_000)
         # Finished tracing spans reported by workers/drivers
-        # (ray_tpu/util/tracing.py).
+        # (ray_tpu/util/tracing.py), plus the cluster-wide count of
+        # spans processes dropped at buffer capacity before flushing.
         self.spans: deque = deque(maxlen=100_000)
+        self.spans_dropped_total = 0
         self._shutting_down = False
         # Observability: per-process metric snapshots (worker_id → snap)
         # merged on demand; dashboard server started in start().
@@ -1558,12 +1560,26 @@ class HeadService:
         return {}
 
     async def _rpc_report_spans(self, payload, bufs):
+        # New wire shape: {"spans": [...], "dropped": n}; a bare list is
+        # the legacy shape from pre-upgrade workers.
+        if isinstance(payload, dict):
+            self.spans_dropped_total += int(payload.get("dropped", 0))
+            payload = payload.get("spans", [])
+        if self.spans.maxlen:
+            # The bounded deque evicts silently on extend; those drops
+            # must show in the same honest count as process-side ones.
+            self.spans_dropped_total += max(
+                0, len(self.spans) + len(payload) - self.spans.maxlen)
         self.spans.extend(payload)
         return {}
 
     async def _rpc_get_spans(self, payload, bufs):
         limit = payload.get("limit", 1000)
-        return list(self.spans)[-limit:]
+        spans = list(self.spans)[-limit:]
+        if payload.get("with_meta"):
+            return {"spans": spans,
+                    "dropped_total": self.spans_dropped_total}
+        return spans
 
     # ------------------------------------------------- object directory
     async def _rpc_object_loc_add(self, payload, bufs):
@@ -1667,6 +1683,16 @@ class HeadService:
 
     async def _rpc_metrics_text(self, payload, bufs):
         return {"text": self.metrics_text()}
+
+    async def _rpc_metrics_merged(self, payload, bufs):
+        """Cluster-merged metric snapshot in wire form — the structured
+        twin of metrics_text, for consumers that compute on buckets
+        (serve.status()'s latency block)."""
+        from . import metrics as m
+
+        snaps = [m.global_registry().snapshot()]
+        snaps.extend(self.metrics_snapshots.values())
+        return m.merged_to_wire(m.merge_snapshots(snaps))
 
     async def _rpc_state(self, payload, bufs):
         return self.state_listing(payload.get("kind", "summary"))
@@ -2080,7 +2106,9 @@ class HeadService:
                 "tid": sp["trace_id"][:12],
                 "args": {"span_id": sp["span_id"],
                          "parent_id": sp.get("parent_id"),
-                         "status": sp.get("status", "ok")},
+                         "status": sp.get("status", "ok"),
+                         **({"attrs": sp["attrs"]} if sp.get("attrs")
+                            else {})},
             })
         return out
 

@@ -14,11 +14,40 @@ durations, labels as a frozen kv tuple.
 from __future__ import annotations
 
 import contextlib
+import os
+import re
 import threading
 import time
-from typing import Dict, Iterable, List, Optional, Tuple
+import warnings
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 LabelPairs = Tuple[Tuple[str, str], ...]
+
+#: Prometheus metric-name grammar (data model spec).
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+#: A histogram whose name suggests it measures time must carry the
+#: canonical ``_seconds`` unit suffix.
+_DURATION_HINTS = ("duration", "latency", "wait", "elapsed", "_time",
+                   "ttft", "tpot")
+
+
+def lint_metric_name(name: str, kind: str) -> List[str]:
+    """Prometheus naming-convention problems for an instrument, or []."""
+    problems = []
+    if not _METRIC_NAME_RE.match(name):
+        problems.append(
+            f"metric name {name!r} does not match the prometheus naming "
+            f"regex {_METRIC_NAME_RE.pattern}")
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append(
+            f"counter {name!r} must end in '_total' (prometheus counter "
+            f"convention)")
+    if kind == "histogram" and not name.endswith("_seconds") and \
+            any(h in name for h in _DURATION_HINTS):
+        problems.append(
+            f"duration histogram {name!r} must end in '_seconds' "
+            f"(prometheus base-unit convention)")
+    return problems
 
 
 def _labels(kv: Optional[Dict[str, str]]) -> LabelPairs:
@@ -111,18 +140,34 @@ class Histogram(_Instrument):
 
 
 class MetricsRegistry:
-    def __init__(self):
+    def __init__(self, strict: Optional[bool] = None):
         self._lock = threading.Lock()
         self._instruments: Dict[str, _Instrument] = {}
+        # Naming lint mode: warn by default, raise in strict mode
+        # (tests set strict=True or RT_METRICS_STRICT=1 so convention
+        # drift fails fast instead of shipping unscrapeable names).
+        if strict is None:
+            strict = os.environ.get("RT_METRICS_STRICT", "").lower() in (
+                "1", "true", "yes", "on")
+        self.strict = strict
+        self._linted: set = set()
 
     def register(self, inst: _Instrument):
+        problems = lint_metric_name(inst.name, inst.kind)
+        if problems and self.strict:
+            raise ValueError("; ".join(problems))
         with self._lock:
             existing = self._instruments.get(inst.name)
             if existing is not None and existing.kind != inst.kind:
                 raise ValueError(
                     f"metric {inst.name!r} already registered as "
                     f"{existing.kind}")
+            first_sight = inst.name not in self._linted
+            self._linted.add(inst.name)
             self._instruments[inst.name] = inst
+        if problems and first_sight:
+            for p in problems:
+                warnings.warn(p, stacklevel=3)
 
     def get(self, name: str) -> Optional[_Instrument]:
         with self._lock:
@@ -156,24 +201,57 @@ def global_registry() -> MetricsRegistry:
 
 def merge_snapshots(snaps: List[dict]) -> dict:
     """Head-side merge of per-process snapshots (sum counters/histograms,
-    last-writer-wins gauges)."""
+    last-writer-wins gauges).
+
+    Two processes reporting DIFFERENT ``bounds`` for the same histogram
+    name (a rolling deploy changed the buckets, or two libraries collide
+    on a name) cannot be element-wise summed — the old code's ``zip``
+    silently truncated the longer list, corrupting every count. Such
+    snapshots now merge into separate sub-series kept under the entry's
+    ``bounds_conflict`` list (one per distinct bounds tuple) and render
+    with a ``bounds_conflict`` label so no sample is lost or miscounted."""
     merged: dict = {}
     for snap in snaps:
         for name, data in snap.items():
             ent = merged.setdefault(name, {
                 "kind": data["kind"], "description": data["description"],
                 "bounds": data.get("bounds", []), "values": {}})
+            values = ent["values"]
+            if data["kind"] == "histogram" and \
+                    list(data.get("bounds", [])) != list(ent["bounds"]):
+                sub = None
+                for c in ent.setdefault("bounds_conflict", []):
+                    if c["bounds"] == list(data.get("bounds", [])):
+                        sub = c
+                        break
+                if sub is None:
+                    sub = {"bounds": list(data.get("bounds", [])),
+                           "values": {}}
+                    ent["bounds_conflict"].append(sub)
+                values = sub["values"]
             for key_list, v in data["values"]:
                 key = tuple(tuple(p) for p in key_list)
                 if data["kind"] == "counter":
-                    ent["values"][key] = ent["values"].get(key, 0.0) + v
+                    values[key] = values.get(key, 0.0) + v
                 elif data["kind"] == "gauge":
-                    ent["values"][key] = v
-                else:  # histogram: element-wise sum
-                    cur = ent["values"].get(key)
-                    ent["values"][key] = (
+                    values[key] = v
+                else:  # histogram: element-wise sum (bounds match here)
+                    cur = values.get(key)
+                    values[key] = (
                         [a + b for a, b in zip(cur, v)] if cur else list(v))
     return merged
+
+
+def escape_label_value(v) -> str:
+    """Prometheus exposition escaping for a label value: backslash,
+    double-quote, and line-feed must be escaped or the line is invalid."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(s: str) -> str:
+    """HELP text escaping (backslash and line-feed per the spec)."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def render_prometheus(merged: dict, prefix: str = "ray_tpu") -> str:
@@ -181,36 +259,45 @@ def render_prometheus(merged: dict, prefix: str = "ray_tpu") -> str:
     lines: List[str] = []
 
     def fmt_labels(key: LabelPairs, extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in key]
+        parts = [f'{k}="{escape_label_value(v)}"' for k, v in key]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render_hist(full, key, bounds, v, extra_pair=None):
+        base_key = key if extra_pair is None else key + (extra_pair,)
+        cum = 0
+        for i, b in enumerate(bounds):
+            cum += v[i]
+            # No backslash inside the f-string expression:
+            # pre-3.12 interpreters reject it at compile time.
+            le = f'le="{b}"'
+            lines.append(f"{full}_bucket{fmt_labels(base_key, le)} {cum}")
+        cum += v[len(bounds)]
+        le_inf = 'le="+Inf"'
+        lines.append(f"{full}_bucket{fmt_labels(base_key, le_inf)} {cum}")
+        lines.append(f"{full}_sum{fmt_labels(base_key)} {v[-2]}")
+        lines.append(f"{full}_count{fmt_labels(base_key)} {v[-1]}")
 
     for name in sorted(merged):
         ent = merged[name]
         full = f"{prefix}_{name}"
         if ent["description"]:
-            lines.append(f"# HELP {full} {ent['description']}")
+            lines.append(
+                f"# HELP {full} {_escape_help(ent['description'])}")
         lines.append(f"# TYPE {full} {ent['kind']}")
         for key, v in sorted(ent["values"].items()):
             if ent["kind"] in ("counter", "gauge"):
                 lines.append(f"{full}{fmt_labels(key)} {v}")
             else:
-                bounds = ent["bounds"]
-                cum = 0
-                for i, b in enumerate(bounds):
-                    cum += v[i]
-                    # No backslash inside the f-string expression:
-                    # pre-3.12 interpreters reject it at compile time.
-                    le = f'le="{b}"'
-                    lines.append(
-                        f"{full}_bucket{fmt_labels(key, le)} {cum}")
-                cum += v[len(bounds)]
-                le_inf = 'le="+Inf"'
-                lines.append(
-                    f"{full}_bucket{fmt_labels(key, le_inf)} {cum}")
-                lines.append(f"{full}_sum{fmt_labels(key)} {v[-2]}")
-                lines.append(f"{full}_count{fmt_labels(key)} {v[-1]}")
+                render_hist(full, key, ent["bounds"], v)
+        # Series whose processes reported different bucket bounds render
+        # separately, marked by a bounds_conflict label (summing them
+        # would corrupt every count).
+        for i, sub in enumerate(ent.get("bounds_conflict", [])):
+            pair = ("bounds_conflict", str(i + 1))
+            for key, v in sorted(sub["values"].items()):
+                render_hist(full, key, sub["bounds"], v, extra_pair=pair)
     return "\n".join(lines) + "\n"
 
 
@@ -256,6 +343,14 @@ _serve: dict = {}
 _serve_lock = threading.Lock()
 
 
+#: Sub-second-biased bounds for per-token latency (TPOT): decode chunks
+#: land tokens every fraction of a millisecond to tens of ms.
+_TOKEN_BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+_BATCH_SIZE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+_RATIO_BOUNDS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+
 def serve_metrics() -> dict:
     with _serve_lock:
         if _serve:
@@ -275,8 +370,120 @@ def serve_metrics() -> dict:
                 "serve_overload_repicks_total",
                 "Replica overload pushbacks answered by re-picking "
                 "another replica"),
+            # ---- latency histograms (ISSUE 4 tentpole). Each stage is
+            # observed by the layer that owns it: e2e/TTFT/TPOT by the
+            # caller-side router (covers handle AND proxy traffic —
+            # the proxy calls through a handle), queue waits by the
+            # layer doing the queueing, batch shape by the batcher.
+            e2e_latency=Histogram(
+                "serve_request_e2e_seconds",
+                "End-to-end request latency observed at the caller "
+                "(submission to result, or to stream exhaustion)"),
+            ttft=Histogram(
+                "serve_ttft_seconds",
+                "Time from stream submission to the first item "
+                "(time-to-first-token)"),
+            tpot=Histogram(
+                "serve_tpot_seconds",
+                "Per-token inter-chunk latency of streamed responses "
+                "(time-per-output-token)", bounds=_TOKEN_BOUNDS),
+            queue_wait=Histogram(
+                "serve_queue_wait_seconds",
+                "Time a request waited before dispatch, by layer "
+                "(where=router: admission wait; where=replica: "
+                "submission-to-admission transit)"),
+            batch_wait=Histogram(
+                "serve_batch_wait_seconds",
+                "Time a request waited in the @serve.batch queue before "
+                "its batch flushed"),
+            batch_size=Histogram(
+                "serve_batch_size",
+                "Observed (pre-padding) batch sizes at flush",
+                bounds=_BATCH_SIZE_BOUNDS),
+            batch_fill_ratio=Histogram(
+                "serve_batch_fill_ratio",
+                "Observed batch size / max_batch_size at flush",
+                bounds=_RATIO_BOUNDS),
         )
         return _serve
+
+
+def merged_to_wire(merged: dict) -> dict:
+    """Merged snapshot → RPC-safe form (tuple label keys become lists,
+    mirroring ``MetricsRegistry.snapshot``'s wire format)."""
+    out = {}
+    for name, ent in merged.items():
+        w = {"kind": ent["kind"], "description": ent["description"],
+             "bounds": list(ent["bounds"]),
+             "values": [(list(list(p) for p in k), v)
+                        for k, v in ent["values"].items()]}
+        if ent.get("bounds_conflict"):
+            w["bounds_conflict"] = [
+                {"bounds": list(sub["bounds"]),
+                 "values": [(list(list(p) for p in k), v)
+                            for k, v in sub["values"].items()]}
+                for sub in ent["bounds_conflict"]]
+        out[name] = w
+    return out
+
+
+def quantile_from_buckets(bounds: Sequence[float], counts: Sequence[float],
+                          q: float) -> Optional[float]:
+    """Quantile estimate from cumulative-free bucket counts (the wire
+    layout: one count per bound plus the +Inf overflow). Linear
+    interpolation inside the winning bucket, like PromQL's
+    ``histogram_quantile``; the +Inf bucket clamps to the last bound."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0.0
+    lower = 0.0
+    for i, b in enumerate(bounds):
+        prev = cum
+        cum += counts[i]
+        if cum >= target:
+            frac = (target - prev) / max(counts[i], 1e-12)
+            return lower + (b - lower) * min(max(frac, 0.0), 1.0)
+        lower = b
+    return float(bounds[-1]) if bounds else None
+
+
+def histogram_summary(wire: dict, metric: str,
+                      label_filter: Optional[Dict[str, str]] = None,
+                      qs: Sequence[float] = (0.5, 0.95, 0.99)
+                      ) -> Optional[dict]:
+    """p50/p95/p99 (+count/sum) for one histogram in a wire-format merged
+    snapshot, summing every label set matching ``label_filter``. Returns
+    None when the metric is absent or has no observations."""
+    ent = wire.get(metric)
+    if ent is None or ent.get("kind") != "histogram":
+        return None
+    want = set((label_filter or {}).items())
+    bounds = ent.get("bounds", [])
+    agg: Optional[List[float]] = None
+    for key_list, v in ent.get("values", []):
+        if not want <= {(p[0], p[1]) for p in key_list}:
+            continue
+        agg = [a + b for a, b in zip(agg, v)] if agg else list(v)
+    if agg is None or agg[-1] <= 0:
+        return None
+    buckets = agg[:len(bounds) + 1]
+    out = {f"p{int(q * 100)}_s": quantile_from_buckets(bounds, buckets, q)
+           for q in qs}
+    out["count"] = agg[-1]
+    out["mean_s"] = agg[-2] / agg[-1]
+    # Differing-bounds sub-series cannot join one quantile computation;
+    # surface what the quantiles above do NOT cover instead of silently
+    # dropping those observations from the summary.
+    excluded = 0
+    for sub in ent.get("bounds_conflict", []):
+        for key_list, v in sub.get("values", []):
+            if want <= {(p[0], p[1]) for p in key_list}:
+                excluded += v[-1]
+    if excluded:
+        out["excluded_bounds_conflict_count"] = excluded
+    return out
 
 
 def now() -> float:

@@ -3482,18 +3482,21 @@ class CoreWorker:
             except Exception:
                 pass
         spans = tracing.drain()
-        if spans:
+        dropped = tracing.take_dropped()
+        if spans or dropped:
             me = self.worker_id.hex()
             for s in spans:
                 s.setdefault("process", me)
             try:
-                self.head_call("report_spans", spans)
+                self.head_call("report_spans",
+                               {"spans": spans, "dropped": dropped})
             except Exception:
                 # Head unreachable (e.g. crash-restart window): put the
                 # spans back for the next flush — traces covering a
                 # failure window are the ones worth keeping. The deque
                 # bound caps memory if the head stays gone.
                 tracing.requeue(spans)
+                tracing.add_dropped(dropped)
         self.flush_metrics()
 
     def flush_metrics(self):

@@ -201,8 +201,45 @@ class ServeController:
                 "proxy_expired_total": sum(s.get("expired_total", 0)
                                            for s in proxy_stats.values()),
             }
-            return {"applications": apps, "http": self._http_info,
-                    "lifecycle": lifecycle}
+            out = {"applications": apps, "http": self._http_info,
+                   "lifecycle": lifecycle}
+        self._attach_latency(out)
+        return out
+
+    def _attach_latency(self, status: dict):
+        """Per-deployment latency block (p50/p95/p99 from the
+        cluster-merged histogram buckets): e2e, TTFT, and TPOT as
+        observed by every caller-side router in the cluster, plus the
+        queue-wait split. Best-effort — a head hiccup leaves status
+        without the block rather than failing it. Runs OUTSIDE the state
+        lock (it is an RPC to the head)."""
+        try:
+            from ..core.worker import CoreWorker
+
+            merged = CoreWorker.current().head_call("metrics_merged")
+        except Exception:  # noqa: BLE001 - status stays useful without it
+            return
+        from .._private.metrics import histogram_summary
+
+        for app in status["applications"].values():
+            for dname, d in app["deployments"].items():
+                block = {}
+                for key, metric in (
+                        ("e2e", "serve_request_e2e_seconds"),
+                        ("ttft", "serve_ttft_seconds"),
+                        ("tpot", "serve_tpot_seconds")):
+                    s = histogram_summary(merged, metric,
+                                          {"deployment": dname})
+                    if s is not None:
+                        block[key] = s
+                for where in ("router", "replica"):
+                    s = histogram_summary(
+                        merged, "serve_queue_wait_seconds",
+                        {"deployment": dname, "where": where})
+                    if s is not None:
+                        block[f"queue_wait_{where}"] = s
+                if block:
+                    d["latency"] = block
 
     def set_http_info(self, info: dict):
         self._http_info = info

@@ -350,7 +350,14 @@ class ProxyActor:
             # and any retry all share ONE request-scoped window.
             handle = DeploymentHandle(target["app"], target["ingress"],
                                       timeout_s=self._request_timeout_s)
-            return handle.remote(req).result()
+            # proxy.admission stage: ingress overhead through submission
+            # (router admission inside nests as router.queue_wait). The
+            # wait for the RESULT is deliberately outside — that time
+            # belongs to the replica-side stages.
+            with tracing.span("proxy.admission", kind="stage",
+                              deployment=target["ingress"]):
+                resp = handle.remote(req)
+            return resp.result()
 
     def _call_app_stream(self, target: dict, req: Request):
         """Returns (generator, ManualSpan-or-None). The server span must
@@ -368,7 +375,9 @@ class ProxyActor:
         if ms is None:
             return handle.remote(req), None
         with ms.activate():
-            return handle.remote(req), ms
+            with tracing.span("proxy.admission", kind="stage",
+                              deployment=target["ingress"]):
+                return handle.remote(req), ms
 
     # ---------------------------------------------------------- gRPC ingress
     def start_grpc(self, host: str, port: int) -> dict:

@@ -17,8 +17,11 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-from .request import (ReplicaOverloadedError, RequestDeadlineExceeded,
-                      _request_deadline, deadline_expired)
+from ..util import tracing
+from .request import (SUBMITTED_AT_KEY, TRACE_CTX_KEY,
+                      ReplicaOverloadedError, RequestDeadlineExceeded,
+                      _request_deadline, _request_deployment,
+                      deadline_expired)
 
 #: Bound on the fault-injection invocation log (test hook, see below).
 _INVOCATION_LOG_CAP = 10_000
@@ -90,7 +93,32 @@ class Replica:
                     f"{self._max_ongoing}")
             self._ongoing += 1
             self._total += 1
+        self._observe_queue_wait(ctx)
         return deadline
+
+    def _observe_queue_wait(self, ctx: Optional[dict]):
+        """``replica.queue_wait`` stage: submission stamp (router side)
+        to admission here — transit plus any actor-mailbox queueing.
+        Wall-clock across processes, like the deadline it rides with."""
+        submitted_at = (ctx or {}).get(SUBMITTED_AT_KEY)
+        if submitted_at is None:
+            return
+        now = time.time()
+        # Cross-machine wall clocks: clamp so skew never yields a
+        # negative wait (histogram) or an end-before-start span.
+        start = min(submitted_at, now)
+        from .._private.metrics import serve_metrics
+
+        serve_metrics()["queue_wait"].observe(
+            now - start,
+            labels={"deployment": self.deployment_name,
+                    "where": "replica"})
+        tctx = (ctx or {}).get(TRACE_CTX_KEY)
+        if tctx is not None:
+            tracing.record_span("replica.queue_wait", start, now,
+                                parent_ctx=tctx,
+                                deployment=self.deployment_name,
+                                replica=self.replica_id)
 
     def _count_lifecycle(self, name: str, where: str):
         from .._private.metrics import serve_metrics
@@ -129,20 +157,29 @@ class Replica:
 
             token = _request_model_id.set(ctx["multiplexed_model_id"])
         dl_token = _request_deadline.set(deadline)
+        dep_token = _request_deployment.set(self.deployment_name)
         try:
             self._pre_invoke(method_name, deadline)
             if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
                 method = self._user
             else:
                 method = getattr(self._user, method_name)
-            out = method(*args, **kwargs)
-            if inspect.iscoroutine(out):
-                # Per-call loop: our replicas are thread-concurrent, not
-                # loop-concurrent; shared batching state lives in
-                # serve.batching's thread queues instead.
-                out = asyncio.run(out)
+            # user_code stage span: the slice of the request actually
+            # spent in the deployment's handler (queue waits and
+            # transport excluded). Nested spans/handle calls/batch
+            # submissions inside the handler parent under it.
+            with tracing.span("user_code", kind="stage",
+                              deployment=self.deployment_name,
+                              method=method_name):
+                out = method(*args, **kwargs)
+                if inspect.iscoroutine(out):
+                    # Per-call loop: our replicas are thread-concurrent,
+                    # not loop-concurrent; shared batching state lives
+                    # in serve.batching's thread queues instead.
+                    out = asyncio.run(out)
             return out
         finally:
+            _request_deployment.reset(dep_token)
             _request_deadline.reset(dl_token)
             if token is not None:
                 from .multiplex import _request_model_id
@@ -173,24 +210,33 @@ class Replica:
 
             token = _request_model_id.set(ctx["multiplexed_model_id"])
         dl_token = _request_deadline.set(deadline)
+        dep_token = _request_deployment.set(self.deployment_name)
         try:
             self._pre_invoke(method_name, deadline)
-            items = self._user_stream(method_name, args, kwargs)
-            if ctx and ctx.get("flatten_chunks"):
-                for item in items:
-                    if isinstance(item, (list, tuple)):
-                        yield from item
-                    elif getattr(item, "ndim", 0):
-                        # ndarray chunk slice (e.g. generate_chunked's
-                        # [B, j]): row-major flatten to scalars — for
-                        # the B == 1 serving case that is exactly
-                        # per-token order.
-                        yield from item.ravel().tolist()
-                    else:
-                        yield item
-            else:
-                yield from items
+            # user_code stage span covers the ITERATION of the handler
+            # (the whole stream), mirroring _traced_gen's contract for
+            # generator tasks; per-dispatch chunk spans nest inside it.
+            with tracing.span("user_code", kind="stage",
+                              deployment=self.deployment_name,
+                              method=method_name):
+                items = self._traced_items(
+                    self._user_stream(method_name, args, kwargs))
+                if ctx and ctx.get("flatten_chunks"):
+                    for item in items:
+                        if isinstance(item, (list, tuple)):
+                            yield from item
+                        elif getattr(item, "ndim", 0):
+                            # ndarray chunk slice (e.g. generate_chunked's
+                            # [B, j]): row-major flatten to scalars — for
+                            # the B == 1 serving case that is exactly
+                            # per-token order.
+                            yield from item.ravel().tolist()
+                        else:
+                            yield item
+                else:
+                    yield from items
         finally:
+            _request_deployment.reset(dep_token)
             _request_deadline.reset(dl_token)
             if token is not None:
                 from .multiplex import _request_model_id
@@ -198,6 +244,42 @@ class Replica:
                 _request_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    @staticmethod
+    def _traced_items(items):
+        """Pass-through iterator that records one stage span per stream
+        item when the request is traced: ``decode.chunk`` for chunk
+        slices (list/tuple/array — one fused device dispatch each),
+        ``stream.item`` for scalar items. The span covers the time this
+        replica spent PRODUCING the item (the pull from the user
+        generator), which for chunked decode is exactly one dispatch."""
+        from ..util.tracing import current_context, record_span
+
+        if current_context() is None:
+            yield from items  # untraced: zero per-item overhead
+            return
+        idx = 0
+        while True:
+            t0 = time.time()
+            try:
+                item = next(items)
+            except StopIteration:
+                return
+            chunk = isinstance(item, (list, tuple)) or \
+                bool(getattr(item, "ndim", 0))
+            if isinstance(item, (list, tuple)):
+                width = len(item)
+            elif getattr(item, "ndim", 0):
+                # ndarray chunk slice [B, j]: every element is a token
+                # (len() would report B, undercounting by the chunk
+                # factor the span exists to record).
+                width = int(getattr(item, "size", 1))
+            else:
+                width = 1
+            record_span("decode.chunk" if chunk else "stream.item",
+                        t0, index=idx, tokens=width)
+            idx += 1
+            yield item
 
     def _user_stream(self, method_name: str, args: tuple, kwargs: dict):
         """Invoke the user callable and normalize every handler shape

@@ -42,8 +42,9 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..util import tracing
 from .request import (RequestDeadlineExceeded, deadline_expired,
-                      get_request_deadline)
+                      get_request_deadline, get_request_deployment)
 
 
 def default_buckets(max_batch_size: int) -> List[int]:
@@ -101,10 +102,17 @@ class _BatchQueue:
         self._thread.start()
 
     def submit(self, item,
-               deadline_s: Optional[float] = None
-               ) -> "concurrent.futures.Future":
+               deadline_s: Optional[float] = None,
+               trace_ctx: Optional[dict] = None,
+               deployment: str = "") -> "concurrent.futures.Future":
+        """Enqueue one caller's item. ``trace_ctx``/``deployment`` are
+        the caller's request identity, captured at the wrapper (the
+        flusher thread has no request context of its own): the flush
+        records a ``batch.wait`` span per entry and labels the batch
+        histograms by deployment."""
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
-        self.q.put((item, fut, deadline_s))
+        self.q.put((item, fut, deadline_s, trace_ctx, time.time(),
+                    deployment))
         return fut
 
     def _flusher(self):
@@ -128,7 +136,8 @@ class _BatchQueue:
         the device dispatch never spends cycles on answers whose callers
         already gave up. Returns the still-live entries."""
         live = []
-        for item, fut, dl in batch:
+        for entry in batch:
+            item, fut, dl = entry[0], entry[1], entry[2]
             if deadline_expired(dl):
                 if not fut.done():
                     fut.set_exception(RequestDeadlineExceeded(
@@ -136,17 +145,43 @@ class _BatchQueue:
                 from .._private.metrics import serve_metrics
 
                 serve_metrics()["requests_expired"].inc(
-                    labels={"where": "batcher"})
+                    labels={"where": "batcher",
+                            "deployment": entry[5] or ""})
             else:
-                live.append((item, fut, dl))
+                live.append(entry)
         return live
+
+    def _observe_flush(self, batch):
+        """Batch-shape histograms + one ``batch.wait`` stage span per
+        traced entry, recorded at flush time (the stage ends when the
+        batch leaves the queue for the handler)."""
+        from .._private.metrics import serve_metrics
+
+        sm = serve_metrics()
+        flush_t = time.time()
+        n = len(batch)
+        labels = {"deployment": batch[0][5] or ""}
+        sm["batch_size"].observe(n, labels=labels)
+        sm["batch_fill_ratio"].observe(n / max(self.max_batch_size, 1),
+                                       labels=labels)
+        for _item, _fut, _dl, tctx, enq_t, dep in batch:
+            sm["batch_wait"].observe(max(flush_t - enq_t, 0.0),
+                                     labels={"deployment": dep or ""})
+            if tctx is not None:
+                tracing.record_span("batch.wait", enq_t, flush_t,
+                                    parent_ctx=tctx, batch_size=n,
+                                    deployment=dep or "")
 
     def _run_batch(self, batch):
         batch = self._drop_expired(batch)
         if not batch:
             return  # every caller's deadline passed: skip the dispatch
+        self._observe_flush(batch)
         items = [b[0] for b in batch]
         futs = [b[1] for b in batch]
+        # First traced caller's context parents the handler invocation's
+        # spans/submissions (the flusher thread has no context).
+        lead_ctx = next((b[3] for b in batch if b[3] is not None), None)
         self.batch_sizes.append(len(items))
         n = len(items)
         if self.pad:
@@ -161,11 +196,13 @@ class _BatchQueue:
             # the same contract this runtime's thread-concurrent
             # replicas already impose.
             threading.Thread(
-                target=self._run_batch_stream, args=(items, futs, n),
+                target=self._run_batch_stream,
+                args=(items, futs, n, lead_ctx),
                 daemon=True, name="rt-serve-batch-stream").start()
             return
         try:
-            results = self.fn(items)
+            with tracing.activate_context(lead_ctx):
+                results = self.fn(items)
             if results is None or len(results) < n:
                 raise ValueError(
                     f"batch handler returned {0 if results is None else len(results)} "
@@ -177,7 +214,7 @@ class _BatchQueue:
                 if not fut.done():
                     fut.set_exception(e)
 
-    def _run_batch_stream(self, items, futs, n):
+    def _run_batch_stream(self, items, futs, n, lead_ctx=None):
         """Streaming flush (runs on its own thread, one per batch): the
         handler yields per-batch slices; element i of every slice is
         routed to caller i's lane, so all callers stream concurrently
@@ -188,22 +225,27 @@ class _BatchQueue:
         for fut, lane in zip(futs, lanes):
             fut.set_result(lane)
         try:
-            gen = self.fn(items)
-            try:
-                for slice_ in gen:
-                    if all(lane.closed for lane in lanes):
-                        break  # every consumer left; stop computing
-                    if slice_ is None or len(slice_) < n:
-                        raise ValueError(
-                            f"streaming batch handler yielded "
-                            f"{0 if slice_ is None else len(slice_)} "
-                            f"results for {n} requests")
-                    for lane, r in zip(lanes, list(slice_)[:n]):
-                        if not lane.closed:
-                            lane.q.put(("item", r))
-            finally:
-                if hasattr(gen, "close"):
-                    gen.close()  # run the handler's cleanup
+            # The lead caller's trace context stays active for the WHOLE
+            # drive loop: every resume of the handler generator (each
+            # fused dispatch) runs on this thread, and its spans/nested
+            # submissions must join the request's trace.
+            with tracing.activate_context(lead_ctx):
+                gen = self.fn(items)
+                try:
+                    for slice_ in gen:
+                        if all(lane.closed for lane in lanes):
+                            break  # every consumer left; stop computing
+                        if slice_ is None or len(slice_) < n:
+                            raise ValueError(
+                                f"streaming batch handler yielded "
+                                f"{0 if slice_ is None else len(slice_)} "
+                                f"results for {n} requests")
+                        for lane, r in zip(lanes, list(slice_)[:n]):
+                            if not lane.closed:
+                                lane.q.put(("item", r))
+                finally:
+                    if hasattr(gen, "close"):
+                        gen.close()  # run the handler's cleanup
             for lane in lanes:
                 lane.q.put((_STREAM_END, None))
         except Exception as e:  # noqa: BLE001 - fan out per caller
@@ -293,9 +335,14 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                 self_obj, (item,) = None, args
             # Inherit the caller's request deadline (set by the replica
             # around user code) so queued entries can be dropped at
-            # flush time once nobody is waiting for them.
+            # flush time once nobody is waiting for them — plus its
+            # trace context and deployment name, captured HERE because
+            # the flusher thread that records the batch.wait stage has
+            # no request context of its own.
             out = _mod._queue_for(self_obj, key, fn, cfg).submit(
-                item, deadline_s=get_request_deadline()).result()
+                item, deadline_s=get_request_deadline(),
+                trace_ctx=tracing.current_context(),
+                deployment=get_request_deployment() or "").result()
             return _drain_stream(out) if stream else out
 
         wrapper.__rt_is_batched__ = True

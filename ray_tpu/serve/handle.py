@@ -36,9 +36,11 @@ from typing import Any, Dict, Optional, Tuple
 from ..exceptions import (ActorDiedError, ActorUnavailableError,
                           GetTimeoutError, RayTpuError, TaskError,
                           WorkerCrashedError)
-from .request import (BackPressureError, ReplicaOverloadedError,
-                      RequestDeadlineExceeded, deadline_expired,
-                      get_request_deadline, make_deadline, remaining_s)
+from ..util import tracing
+from .request import (SUBMITTED_AT_KEY, TRACE_CTX_KEY, BackPressureError,
+                      ReplicaOverloadedError, RequestDeadlineExceeded,
+                      deadline_expired, get_request_deadline,
+                      make_deadline, remaining_s)
 
 _RETRYABLE_CAUSES = ("ActorDiedError", "ActorUnavailableError",
                      "WorkerCrashedError", "ConnectionLost",
@@ -186,13 +188,20 @@ class DeploymentResponse:
 
     def __init__(self, router: "Router", rid: str, ref,
                  call: Tuple[str, tuple, dict], model_id: str = "",
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 t0: Optional[float] = None):
         self._router = router
         self._rid = rid
         self._ref = ref
         self._call = call
         self._model_id = model_id
         self._deadline_s = deadline_s
+        # Submission instant (perf_counter) for the e2e latency
+        # histogram; a retry keeps the ORIGINAL t0 — the caller has been
+        # waiting since the first submission. Observed at most once —
+        # result() is legal to call repeatedly.
+        self._t0 = time.perf_counter() if t0 is None else t0
+        self._e2e_observed = False
 
     @property
     def object_ref(self):
@@ -218,6 +227,10 @@ class DeploymentResponse:
             try:
                 out = rt.get(self._ref, timeout=remaining_s(deadline))
                 self._router.budget.record_success()
+                if not self._e2e_observed:
+                    self._e2e_observed = True
+                    _serve_counters()["e2e_latency"].observe(
+                        time.perf_counter() - self._t0, labels=labels)
                 return out
             except Exception as e:  # noqa: BLE001
                 if isinstance(e, GetTimeoutError):
@@ -281,7 +294,8 @@ class DeploymentResponseGenerator:
     def __init__(self, router: "Router", rid: str, gen,
                  call: Optional[Tuple[str, tuple, dict]] = None,
                  model_id: str = "", flatten_chunks: bool = False,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 t0: Optional[float] = None):
         self._router = router
         self._rid = rid
         self._gen = gen
@@ -293,6 +307,11 @@ class DeploymentResponseGenerator:
         self._got_first = False
         self._reroutes = 0
         self._backoff = Router.RETRY_BACKOFF_BASE_S
+        # Latency accounting: TTFT on the first item, per-token TPOT on
+        # every later arrival (a fused chunk lands `width` tokens in one
+        # arrival), e2e on clean exhaustion.
+        self._t0 = time.perf_counter() if t0 is None else t0
+        self._last_item_at: Optional[float] = None
 
     def _finish(self):
         if not self._done:
@@ -307,11 +326,15 @@ class DeploymentResponseGenerator:
 
         if self._done:
             raise StopIteration
+        labels = {"deployment": self._router.deployment_name}
         while True:
             try:
                 try:
                     ref = next(self._gen)
                 except StopIteration:
+                    if self._got_first:
+                        _serve_counters()["e2e_latency"].observe(
+                            time.perf_counter() - self._t0, labels=labels)
                     self._finish()
                     raise
                 item = rt.get(ref)
@@ -323,9 +346,30 @@ class DeploymentResponseGenerator:
                     self._finish()
                     raise
                 continue
+            now = time.perf_counter()
             if not self._got_first:
                 self._got_first = True
                 self._router.budget.record_success()
+                _serve_counters()["ttft"].observe(now - self._t0,
+                                                  labels=labels)
+            else:
+                # Tokens landed by this arrival: list/tuple chunk slice
+                # length, ndarray element count (a [B, j] slice is B*j
+                # tokens — len() would say B), else one. Empty filler
+                # slices (lockstep batch handlers) land nothing and
+                # must not record a bogus 1-token sample.
+                if isinstance(item, (list, tuple)):
+                    width = len(item)
+                elif getattr(item, "ndim", 0):
+                    width = int(getattr(item, "size", 1))
+                else:
+                    width = 1
+                if width > 0:
+                    per_token = (now - self._last_item_at) / width
+                    tpot = _serve_counters()["tpot"]
+                    for _ in range(width):
+                        tpot.observe(per_token, labels=labels)
+            self._last_item_at = now
             return item
 
     def _reroute(self, e: Exception) -> bool:
@@ -563,6 +607,27 @@ class Router:
     # ----------------------------------------------------------- data plane
     def _acquire(self, deadline_s: Optional[float], model_id: str
                  ) -> Tuple[str, Any]:
+        """Admission wait, instrumented: the elapsed time is the
+        ``router.queue_wait`` stage — observed into the queue-wait
+        histogram always, and recorded as a span when the request is
+        traced (near-zero when a slot is free, the interesting tail
+        when every replica is saturated)."""
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        out = self._acquire_inner(deadline_s, model_id)
+        _serve_counters()["queue_wait"].observe(
+            time.perf_counter() - t0,
+            labels={"deployment": self.deployment_name, "where": "router"})
+        # Only under an active request span: with tracing enabled but no
+        # ambient span (bare handle calls), a root-less record here
+        # would mint one junk single-span trace per submission.
+        if tracing.current_context() is not None:
+            tracing.record_span("router.queue_wait", t0_wall,
+                                deployment=self.deployment_name)
+        return out
+
+    def _acquire_inner(self, deadline_s: Optional[float], model_id: str
+                       ) -> Tuple[str, Any]:
         """Admission: block until a replica has an in-flight slot, with
         capped exponential backoff between controller refreshes.
 
@@ -628,10 +693,11 @@ class Router:
                deadline_s: Optional[float] = None) -> DeploymentResponse:
         # A fresh submission stamps its deadline once; a retry passes
         # the original deadline through so the window never restarts.
+        t0 = time.perf_counter()
         if deadline_s is None:
             deadline_s = self._stamp_deadline(timeout_s)
         rid, handle = self._acquire(deadline_s, model_id)
-        ctx: Dict[str, Any] = {"deadline_s": deadline_s}
+        ctx = self._request_ctx(deadline_s)
         if model_id:
             with self._cond:
                 self._model_affinity.setdefault(model_id, set()).add(rid)
@@ -645,7 +711,20 @@ class Router:
         self._waiter_wake.set()
         return DeploymentResponse(self, rid, ref,
                                   (method_name, args, kwargs), model_id,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s, t0=t0)
+
+    def _request_ctx(self, deadline_s: Optional[float]) -> Dict[str, Any]:
+        """Request context that rides the wire to the replica: the
+        absolute deadline, the dispatch stamp (the replica measures its
+        queue-wait stage against it), and — when the caller is traced —
+        the wire trace context, so replica/batcher stage spans join the
+        request's trace."""
+        ctx: Dict[str, Any] = {"deadline_s": deadline_s,
+                               SUBMITTED_AT_KEY: time.time()}
+        tctx = tracing.current_context()
+        if tctx is not None:
+            ctx[TRACE_CTX_KEY] = tctx
+        return ctx
 
     def _submit_stream_raw(self, method_name: str, args: tuple, kwargs: dict,
                            deadline_s: Optional[float], model_id: str,
@@ -654,7 +733,7 @@ class Router:
         (rid, core streaming generator). Shared by first submission and
         the generator's retry-before-first-item re-routes."""
         rid, handle = self._acquire(deadline_s, model_id)
-        ctx: Dict[str, Any] = {"deadline_s": deadline_s}
+        ctx = self._request_ctx(deadline_s)
         if model_id:
             ctx["multiplexed_model_id"] = model_id
         if flatten_chunks:
@@ -674,6 +753,7 @@ class Router:
         loop — a stream has no single completion ref to wait on). The
         deadline bounds stream SETUP (time to first item); an
         already-flowing stream may outlive it."""
+        t0 = time.perf_counter()
         deadline_s = self._stamp_deadline(timeout_s)
         rid, gen = self._submit_stream_raw(
             method_name, args, kwargs, deadline_s=deadline_s,
@@ -681,7 +761,7 @@ class Router:
         return DeploymentResponseGenerator(
             self, rid, gen, call=(method_name, args, kwargs),
             model_id=model_id, flatten_chunks=flatten_chunks,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, t0=t0)
 
     def release(self, rid: str):
         """Return one in-flight slot (stream finished or abandoned)."""

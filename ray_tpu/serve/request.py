@@ -84,6 +84,28 @@ def get_request_deadline() -> Optional[float]:
     return _request_deadline.get()
 
 
+#: Name of the deployment handling the current request, set by the
+#: replica around user code. Nested layers with no deployment identity
+#: of their own — the @serve.batch flusher above all — read it to label
+#: their histograms and spans by deployment instead of guessing.
+_request_deployment: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("rt_serve_request_deployment", default=None)
+
+
+def get_request_deployment() -> Optional[str]:
+    """Deployment name of the request being handled on this thread
+    (None outside a replica's request scope)."""
+    return _request_deployment.get()
+
+
+#: Wire trace context of the current request's submission
+#: (``{"trace_id", "span_id"}``), stamped by the router next to the
+#: deadline and activated by the replica so stage spans recorded on
+#: foreign threads (the batcher) can join the request's trace.
+TRACE_CTX_KEY = "trace_ctx"
+SUBMITTED_AT_KEY = "submitted_at"
+
+
 @dataclass
 class Request:
     method: str = "GET"

@@ -75,11 +75,19 @@ class ServeApplicationSchema:
 
 @dataclass
 class ServeDeploySchema:
-    """Top-level config file (reference: ``ServeDeploySchema``)."""
+    """Top-level config file (reference: ``ServeDeploySchema``).
+
+    ``tracing: true`` turns on request tracing for the deploy: the
+    deploying process enables ``ray_tpu.util.tracing`` and the proxies
+    mirror the flag on start, so every request gets a span tree
+    (proxy.admission → router.queue_wait → replica.queue_wait →
+    user_code → batch.wait/decode.chunk) visible via
+    ``tracing.get_spans()`` and the chrome-trace timeline."""
 
     applications: List[ServeApplicationSchema]
     http_options: Optional[Dict[str, Any]] = None
     grpc_options: Optional[Dict[str, Any]] = None
+    tracing: Optional[bool] = None
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ServeDeploySchema":
@@ -180,6 +188,13 @@ def deploy_config(config: Dict[str, Any]) -> List[str]:
     from . import api as serve_api
 
     schema = ServeDeploySchema.from_dict(config)
+    if schema.tracing is not None:
+        from ..util import tracing as _tracing
+
+        # Before start(): serve.start mirrors the flag into the proxy
+        # fleet, so per-request server spans record from the first
+        # request after this deploy.
+        _tracing.enable() if schema.tracing else _tracing.disable()
     serve_api.start(http_options=schema.http_options,
                     grpc_options=schema.grpc_options)
     ctrl = serve_api._controller()

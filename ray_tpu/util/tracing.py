@@ -30,9 +30,10 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
+import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Union
 
 # (trace_id, span_id) of the active span in this thread/coroutine.
 _current: contextvars.ContextVar = contextvars.ContextVar(
@@ -42,6 +43,13 @@ _enabled = os.environ.get("RT_TRACING_ENABLED", "").lower() in (
     "1", "true", "yes", "on")
 # Finished spans waiting for a flush to the head.
 _buffer: deque = deque(maxlen=100_000)
+# Spans evicted at capacity (the deque drops silently; a trace missing
+# its middle is worse than an honest drop count). Guarded by _drop_lock;
+# reported to the head with every span flush and surfaced through
+# ``get_spans(with_meta=True)`` and the
+# ``tracing_spans_dropped_total`` counter.
+_dropped = 0
+_drop_lock = threading.Lock()
 
 
 def enable() -> None:
@@ -88,8 +96,60 @@ def _record(name: str, kind: str, trace_id: str, span_id: str,
     }
     if attrs:
         span["attrs"] = attrs
+    if len(_buffer) >= (_buffer.maxlen or 0) > 0:
+        _note_dropped(1)  # append below evicts the oldest span silently
     _buffer.append(span)
     return span
+
+
+_drop_counter = None
+
+
+def _note_dropped(n: int) -> None:
+    global _dropped, _drop_counter
+    if n <= 0:
+        return
+    with _drop_lock:
+        _dropped += n
+        # Lazy init under the same lock: a racing double-create would
+        # register two instruments and lose one side's increments.
+        if _drop_counter is None:
+            try:
+                from ray_tpu._private.metrics import Counter
+
+                _drop_counter = Counter(
+                    "tracing_spans_dropped_total",
+                    "Finished spans evicted from the per-process buffer "
+                    "at capacity before a flush")
+            except Exception:  # noqa: BLE001 - never break tracing
+                return
+    try:
+        _drop_counter.inc(n)
+    except Exception:  # noqa: BLE001 - accounting must never break tracing
+        pass
+
+
+def take_dropped() -> int:
+    """Drop count since the last take (shipped with each span flush)."""
+    global _dropped
+    with _drop_lock:
+        n, _dropped = _dropped, 0
+        return n
+
+
+def add_dropped(n: int) -> None:
+    """Return an unshipped drop count after a failed flush (the head
+    never saw it, so it must ride the next report)."""
+    global _dropped
+    if n > 0:
+        with _drop_lock:
+            _dropped += n
+
+
+def dropped_total() -> int:
+    """Drops counted in this process and not yet reported to the head."""
+    with _drop_lock:
+        return _dropped
 
 
 @contextlib.contextmanager
@@ -166,6 +226,51 @@ def manual_span(name: str, kind: str = "internal",
     return ManualSpan(name, kind, parent, attrs)
 
 
+def record_span(name: str, start: float, end: Optional[float] = None,
+                kind: str = "stage",
+                parent_ctx: Optional[Dict[str, str]] = None,
+                status: str = "ok", **attrs) -> Optional[dict]:
+    """Record an already-measured span (start/end are wall-clock
+    ``time.time()`` stamps) without touching the active context.
+
+    The serve data plane uses this for stage timings whose lifetime does
+    not match any ``with`` block: queue waits measured across a process
+    hop (``replica.queue_wait`` starts at the router's submission stamp),
+    batcher flush waits recorded on the flusher thread, and per-chunk
+    decode dispatches. Parents under ``parent_ctx`` (a wire context dict)
+    when given, else the caller's active span; no-op when neither exists
+    and tracing is off."""
+    parent = None
+    if parent_ctx is not None:
+        parent = (parent_ctx["trace_id"], parent_ctx["span_id"])
+    else:
+        parent = _current.get()
+    if parent is None and not _enabled:
+        return None
+    trace_id = parent[0] if parent else _new_id(16)
+    return _record(name, kind, trace_id, _new_id(8),
+                   parent[1] if parent else None, start,
+                   time.time() if end is None else end,
+                   attrs or None, status)
+
+
+@contextlib.contextmanager
+def activate_context(ctx: Optional[Dict[str, str]]):
+    """Make a wire context (``{"trace_id", "span_id"}``) the active span
+    on this thread for the duration of the block, so spans recorded and
+    tasks submitted inside parent under it. Used where a request crosses
+    an untraced thread hop — e.g. the batcher invoking the user handler
+    on its flusher thread. No-op for ``ctx=None``."""
+    if ctx is None:
+        yield None
+        return
+    token = _current.set((ctx["trace_id"], ctx["span_id"]))
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
 def on_submit(name: str) -> Optional[Dict[str, str]]:
     """Called by the core worker at task/actor-call submission. Records a
     point-in-time submit span (child of the caller's active span) and
@@ -230,6 +335,10 @@ def requeue(spans: List[dict]) -> None:
     """Return drained spans to the buffer after a failed flush (oldest
     first, so a healthy next flush preserves order; the deque bound
     drops the oldest if the head stays unreachable)."""
+    if _buffer.maxlen:
+        # extendleft on a bounded deque evicts from the RIGHT silently;
+        # count what cannot fit so the loss is visible.
+        _note_dropped(len(spans) + len(_buffer) - _buffer.maxlen)
     _buffer.extendleft(reversed(spans))
 
 
@@ -238,10 +347,18 @@ def local_spans() -> List[dict]:
     return list(_buffer)
 
 
-def get_spans(limit: int = 1000) -> List[dict]:
-    """Cluster-wide finished spans, from the head (flushes local first)."""
+def get_spans(limit: int = 1000,
+              with_meta: bool = False) -> Union[List[dict], Dict[str, Any]]:
+    """Cluster-wide finished spans, from the head (flushes local first).
+
+    ``with_meta=True`` returns ``{"spans": [...], "dropped_total": N}``
+    where ``dropped_total`` counts spans evicted from process buffers at
+    capacity cluster-wide — a non-zero value means traces may be missing
+    their middles."""
     from ray_tpu.core.worker import CoreWorker
 
     core = CoreWorker.current()
     core.flush_task_events()
-    return core.head_call("get_spans", {"limit": limit})
+    out = core.head_call("get_spans",
+                         {"limit": limit, "with_meta": with_meta})
+    return out

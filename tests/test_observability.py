@@ -174,6 +174,226 @@ def test_chrome_timeline(rt_cluster):
     assert ev["ph"] == "X" and ev["ts"] > 0 and ev["dur"] >= 0
 
 
+# --------------------------------------------------------------- ISSUE 4
+def _parse_exposition(text):
+    """Minimal exposition parser for round-trip assertions: returns
+    {metric_name: [(labels_dict, value)]}. Unescapes label values per
+    the spec (the inverse of render_prometheus's escaping)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_labels, _, value = line.rpartition(" ")
+        if "{" in name_labels:
+            name, _, rest = name_labels.partition("{")
+            raw = rest.rstrip("}")
+            labels = {}
+            i = 0
+            while i < len(raw):
+                eq = raw.index("=", i)
+                key = raw[i:eq]
+                assert raw[eq + 1] == '"'
+                j = eq + 2
+                buf = []
+                while raw[j] != '"':
+                    if raw[j] == "\\":
+                        nxt = raw[j + 1]
+                        buf.append({"n": "\n", "\\": "\\",
+                                    '"': '"'}[nxt])
+                        j += 2
+                    else:
+                        buf.append(raw[j])
+                        j += 1
+                labels[key] = "".join(buf)
+                i = j + 2  # past closing quote + comma
+            out.setdefault(name, []).append((labels, float(value)))
+        else:
+            out.setdefault(name_labels, []).append(({}, float(value)))
+    return out
+
+
+def test_label_escaping_roundtrip():
+    """Backslash, double-quote, and newline in a label value must
+    escape to valid exposition text and parse back verbatim."""
+    reg = m.MetricsRegistry()
+    c = m.Counter("escapes_total", "desc with\nnewline", registry=reg)
+    nasty = 'back\\slash "quoted"\nmultiline'
+    c.inc(3, labels={"path": nasty})
+    text = m.render_prometheus(m.merge_snapshots([reg.snapshot()]))
+    # Every physical line must be a single logical sample (the raw
+    # newline would have split one).
+    for line in text.splitlines():
+        if line.startswith("ray_tpu_escapes_total"):
+            assert line.endswith(" 3.0")
+    parsed = _parse_exposition(text)
+    ((labels, value),) = parsed["ray_tpu_escapes_total"]
+    assert labels["path"] == nasty
+    assert value == 3.0
+    # HELP text: the newline must be escaped onto one line.
+    help_lines = [l for l in text.splitlines() if l.startswith("# HELP")]
+    assert any("desc with\\nnewline" in l for l in help_lines)
+
+
+def test_histogram_bucket_cumulativity():
+    reg = m.MetricsRegistry()
+    h = m.Histogram("cumul_seconds", bounds=(0.1, 0.5, 1.0),
+                    registry=reg)
+    for v in (0.05, 0.05, 0.3, 0.7, 2.0, 5.0):
+        h.observe(v)
+    text = m.render_prometheus(m.merge_snapshots([reg.snapshot()]))
+    parsed = _parse_exposition(text)
+    buckets = sorted(parsed["ray_tpu_cumul_seconds_bucket"],
+                     key=lambda kv: float("inf")
+                     if kv[0]["le"] == "+Inf" else float(kv[0]["le"]))
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert counts == [2, 3, 4, 6]
+    ((_, total),) = parsed["ray_tpu_cumul_seconds_count"]
+    assert counts[-1] == total == 6
+
+
+def test_merge_snapshots_bounds_conflict():
+    """Two processes reporting different bounds for one histogram must
+    not be zip-truncated into corrupt counts: they merge as separate
+    series under a bounds_conflict note."""
+    r1, r2, r3 = (m.MetricsRegistry() for _ in range(3))
+    h1 = m.Histogram("conf_seconds", bounds=(0.1, 1.0), registry=r1)
+    h2 = m.Histogram("conf_seconds", bounds=(0.5, 2.0, 5.0), registry=r2)
+    h3 = m.Histogram("conf_seconds", bounds=(0.1, 1.0), registry=r3)
+    h1.observe(0.05)
+    h2.observe(3.0)
+    h2.observe(0.2)
+    h3.observe(0.5)
+    merged = m.merge_snapshots([r1.snapshot(), r2.snapshot(),
+                                r3.snapshot()])
+    ent = merged["conf_seconds"]
+    # Matching-bounds snapshots (r1, r3) merged element-wise...
+    assert ent["bounds"] == [0.1, 1.0]
+    ((key, vals),) = ent["values"].items()
+    assert vals[-1] == 2  # h1 + h3 observations
+    # ...the conflicting one kept separate with ALL its counts intact.
+    (sub,) = ent["bounds_conflict"]
+    assert sub["bounds"] == [0.5, 2.0, 5.0]
+    ((_, cvals),) = sub["values"].items()
+    assert cvals[-1] == 2 and cvals[-2] == 3.2
+    # Exposition renders both, disambiguated by a bounds_conflict label.
+    text = m.render_prometheus(merged)
+    parsed = _parse_exposition(text)
+    counts = parsed["ray_tpu_conf_seconds_count"]
+    assert sorted(v for _, v in counts) == [2.0, 2.0]
+    assert any(l.get("bounds_conflict") == "1" for l, _ in counts)
+
+
+def test_metric_name_lint():
+    """register() lints names: warn by default, raise in strict mode."""
+    strict = m.MetricsRegistry(strict=True)
+    with pytest.raises(ValueError, match="_total"):
+        m.Counter("requests", registry=strict)
+    with pytest.raises(ValueError, match="_seconds"):
+        m.Histogram("request_latency", registry=strict)
+    with pytest.raises(ValueError, match="naming regex"):
+        m.Gauge("bad-name", registry=strict)
+    # Conforming names register fine in strict mode.
+    m.Counter("good_total", registry=strict)
+    m.Histogram("req_latency_seconds", registry=strict)
+    m.Histogram("batch_size", registry=strict)  # not a duration
+    # Default mode: same problems warn instead of raising.
+    lax = m.MetricsRegistry(strict=False)
+    with pytest.warns(UserWarning, match="_total"):
+        m.Counter("requests", registry=lax)
+
+
+def test_tracing_span_drop_accounting():
+    """The span buffer counts what the bounded deque silently evicts
+    (satellite: tracing_spans_dropped_total + get_spans metadata)."""
+    import collections
+
+    from ray_tpu.util import tracing
+
+    saved_buf = tracing._buffer
+    tracing._buffer = collections.deque(maxlen=3)
+    tracing.take_dropped()  # reset
+    was_enabled = tracing.enabled()
+    tracing.enable()
+    try:
+        for i in range(5):
+            with tracing.span(f"s{i}"):
+                pass
+        assert len(tracing._buffer) == 3
+        assert tracing.dropped_total() == 2
+        # requeue past capacity also counts its evictions
+        tracing.requeue([{"name": f"r{i}"} for i in range(2)])
+        assert tracing.dropped_total() == 4
+        assert tracing.take_dropped() == 4
+        assert tracing.take_dropped() == 0
+        # ...and the counter instrument recorded every drop.
+        c = m.global_registry().get("tracing_spans_dropped_total")
+        assert c is not None and sum(v for _, v in c.collect()) >= 4
+    finally:
+        tracing._buffer = saved_buf
+        if not was_enabled:
+            tracing.disable()
+
+
+def test_serve_latency_histograms_stream(rt_cluster):
+    """A streamed request populates the serve TTFT/TPOT/e2e histograms
+    (observed caller-side by the router) and serve.status() surfaces a
+    per-deployment latency block computed from the buckets."""
+    from ray_tpu import serve
+    from ray_tpu._private.metrics import serve_metrics
+
+    serve.start(proxy=False)
+    try:
+        @serve.deployment
+        class Tok:
+            def __call__(self, n):
+                for i in range(n):
+                    time.sleep(0.005)
+                    yield [i, i]  # a 2-token chunk per arrival
+
+        h = serve.run(Tok.bind(), name="tokapp", route_prefix=None)
+
+        def series_count(hist, dep):
+            return sum(v[-1] for k, v in hist.collect()
+                       if ("deployment", dep) in k)
+
+        sm = serve_metrics()
+        before = series_count(sm["tpot"], "Tok")
+        assert list(h.options(stream=True).remote(5)) == \
+            [[i, i] for i in range(5)]
+        assert series_count(sm["ttft"], "Tok") >= 1
+        # 4 post-first arrivals x 2 tokens each
+        assert series_count(sm["tpot"], "Tok") - before >= 8
+        assert series_count(sm["e2e_latency"], "Tok") >= 1
+
+        # status() latency block: p50/p95/p99 from the head-merged
+        # buckets (the driver shares the head's registry in-process).
+        deadline = time.time() + 15
+        block = None
+        while time.time() < deadline:
+            st = serve.status()
+            block = st["applications"]["tokapp"]["deployments"]["Tok"] \
+                .get("latency")
+            if block and "ttft" in block and "e2e" in block:
+                break
+            time.sleep(0.5)
+        assert block, f"no latency block in status: {st}"
+        assert block["e2e"]["count"] >= 1
+        assert block["ttft"]["p50_s"] is not None
+        assert block["e2e"]["p99_s"] >= block["e2e"]["p50_s"]
+        # The exposition carries the histograms for /metrics scrapers.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            text = rt_cluster.metrics_text()
+            if "ray_tpu_serve_ttft_seconds_bucket" in text and \
+                    "ray_tpu_serve_tpot_seconds_bucket" in text:
+                break
+            time.sleep(0.25)
+        assert "ray_tpu_serve_ttft_seconds_bucket" in text
+    finally:
+        serve.shutdown()
+
+
 def test_cli_status_and_list(rt_cluster):
     rt = rt_cluster
     from ray_tpu.core.worker import CoreWorker

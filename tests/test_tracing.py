@@ -236,6 +236,84 @@ def test_serve_request_spans(traced_cluster):
         serve.shutdown()
 
 
+def test_serve_stage_span_tree(traced_cluster):
+    """ISSUE 4 tentpole: one HTTP request through a batched deployment
+    yields a single coherent span tree with every data-plane stage —
+    proxy.admission → router.queue_wait (proxy side), replica.queue_wait
+    → user_code → batch.wait (replica side) — correctly parented."""
+    import urllib.request
+
+    from ray_tpu import serve
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    try:
+        @serve.deployment
+        class Batched:
+            @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+            def score(self, items):
+                return [x * 2 for x in items]
+
+            def __call__(self, req):
+                return self.score(int(req.query_params.get("x", 1)))
+
+        serve.run(Batched.bind(), name="bt", route_prefix="/bt")
+        from ray_tpu.serve import api as serve_api
+
+        port = serve_api._client["http"]["port"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/bt?x=21", timeout=30) as resp:
+            assert resp.read() == b"42"
+
+        stages = {"proxy.admission", "router.queue_wait",
+                  "replica.queue_wait", "user_code", "batch.wait"}
+
+        def tree_complete(ss):
+            for s in ss:
+                if s["kind"] == "server" and "/bt" in s["name"]:
+                    names = {x["name"] for x in ss
+                             if x["trace_id"] == s["trace_id"]}
+                    if stages <= names:
+                        return True
+            return False
+
+        spans = _wait_spans(tree_complete, timeout=20.0)
+        server = next(s for s in spans if s["kind"] == "server"
+                      and "/bt" in s["name"])
+        mine = {s["span_id"]: s for s in spans
+                if s["trace_id"] == server["trace_id"]}
+        by_name = {s["name"]: s for s in mine.values()}
+
+        def parent(s):
+            return mine.get(s["parent_id"])
+
+        # proxy.admission under the server span; the router's admission
+        # wait nests inside it (the proxy process runs the router).
+        assert parent(by_name["proxy.admission"]) is server
+        assert parent(by_name["router.queue_wait"]) \
+            is by_name["proxy.admission"]
+        # replica.queue_wait parents under the submission-side span the
+        # router captured (proxy.admission), bridging the process hop.
+        assert parent(by_name["replica.queue_wait"]) \
+            is by_name["proxy.admission"]
+        # user_code nests under the replica's execute span, and the
+        # batcher's flush-time span under user_code — the batch wrapper
+        # captured the caller's context across the flusher-thread hop.
+        assert parent(by_name["user_code"])["kind"] == "execute"
+        assert parent(by_name["batch.wait"]) is by_name["user_code"]
+        assert by_name["batch.wait"]["attrs"]["batch_size"] >= 1
+        # Every stage span closed sane: end >= start, status ok.
+        for name in stages:
+            s = by_name[name]
+            assert s["end"] >= s["start"] and s["status"] == "ok"
+
+        # get_spans metadata surfaces the cluster-wide drop count.
+        meta = tracing.get_spans(with_meta=True)
+        assert set(meta) == {"spans", "dropped_total"}
+        assert meta["dropped_total"] == 0
+    finally:
+        serve.shutdown()
+
+
 def test_timeline_includes_spans(traced_cluster):
     @ray_tpu.remote
     def g():
