@@ -32,6 +32,18 @@ that request's span tree, asserts the stage timings sum to within 10%
 of the measured e2e latency, and verifies the serve latency histograms
 (`serve_request_e2e_seconds`, `serve_ttft_seconds`,
 `serve_tpot_seconds`) reached /metrics with non-zero counts.
+
+``--continuous`` (ISSUE 5) switches to the continuous-batching A/B:
+the SAME Poisson arrival schedule with mixed output lengths is driven
+twice at equal offered load — once through a static gang-scheduled
+``@serve.batch(stream=True)`` deployment (batch forms once, rides out
+the whole generation, mid-flight arrivals wait for the next gang) and
+once through the slot-pool ``DecodeEngine``
+(``@serve.batch(continuous=True)``: admission at chunk boundaries,
+slots freed per-request at EOS/max_new). Reports p50/p95 TTFT,
+completion latency, total decoded tok/s, and — continuous only — slot
+occupancy and dispatches/token from the engine's own accounting.
+``--smoke`` shrinks the load so the A/B runs inside tier-1 CI.
 """
 from __future__ import annotations
 
@@ -69,6 +81,21 @@ def main():
                              "request end to end, dump its span tree, "
                              "assert stage sums ≈ e2e, and check the "
                              "serve latency histograms on /metrics")
+    parser.add_argument("--continuous", action="store_true",
+                        help="continuous-batching A/B: static gang "
+                             "@serve.batch vs the slot-pool DecodeEngine "
+                             "under the same Poisson arrivals with mixed "
+                             "output lengths")
+    parser.add_argument("--smoke", action="store_true",
+                        help="with --continuous: shrunk load for tier-1 "
+                             "CI (fewer requests, shorter outputs)")
+    parser.add_argument("--slots", type=int, default=8,
+                        help="engine slot count == static max_batch_size")
+    parser.add_argument("--rate", type=float, default=0.0,
+                        help="Poisson arrival rate in req/s "
+                             "(0 = calibrate from a single warm stream)")
+    parser.add_argument("--requests", type=int, default=48,
+                        help="requests per continuous A/B mode")
     args = parser.parse_args()
     chunks = [int(c) for c in args.chunk.split(",") if c.strip()]
 
@@ -198,6 +225,11 @@ def main():
     # Cache sized for the worst chunk over-run: the last fused chunk may
     # execute up to (chunk - 1) steps past max_new before truncation.
     max_len = 16 + max_new + max(max(chunks), 8)
+    if args.continuous:
+        run_continuous_ab(args, serve, np, cfg_name, f"gpt_{cfg_name}")
+        serve.shutdown()
+        rt.shutdown()
+        return
     if args.trace:
         run_trace_mode(args, rt, serve, np, cfg_name, max(chunks),
                        f"gpt_{cfg_name}")
@@ -537,6 +569,294 @@ def run_trace_mode(args, rt, serve, np, cfg_name, chunk, model):
     print(json.dumps({
         "metric": f"serve_{model}_trace_histograms",
         "value": 1, "unit": "ok", "counts": counts}))
+
+
+def _mk_prompt(rid: int, plen: int, vocab: int):
+    """Deterministic per-request prompt, identical across A/B modes."""
+    import numpy as _np
+
+    return _np.random.default_rng(1000 + rid).integers(
+        0, vocab, (plen,)).astype(_np.int32)
+
+
+def make_continuous_deployments(serve, np, plen: int, slots: int):
+    """The two contenders, built on identical model weights.
+
+    - ``GPTStatic``: the PRE-engine architecture — gang-scheduled
+      ``@serve.batch(stream=True)`` with bucketed padding: a batch
+      forms once, allocates a fresh KV cache, prefills all lanes
+      together, and decodes in lockstep until the LONGEST lane
+      finishes (shorter lanes ride along emitting nothing). A request
+      arriving mid-generation waits for the next gang.
+    - ``GPTContinuous``: the slot-pool engine behind
+      ``@serve.batch(continuous=True)`` — persistent KV pool, per-slot
+      admission at chunk boundaries, per-slot freeing at max_new.
+    """
+    import jax
+
+    @serve.deployment(max_ongoing_requests=128)
+    class GPTStatic:
+        def __init__(self, cfg_name: str, max_len: int, chunk: int):
+            from ray_tpu.models import gpt, gpt_decode
+
+            self.cfg = gpt.CONFIGS[cfg_name]
+            self.gd = gpt_decode
+            self.params = gpt.init_params(jax.random.PRNGKey(0), self.cfg)
+            self.max_len = max_len
+            self.chunk = chunk
+            self._prefill = jax.jit(gpt_decode.prefill,
+                                    static_argnums=(2,))
+
+        @serve.batch(max_batch_size=slots, batch_wait_timeout_s=0.02,
+                     pad_to_bucket=True, buckets=(slots,),
+                     stream=True)
+        def decode_batch(self, requests):
+            import jax.numpy as jnp
+
+            B = len(requests)        # == slots after padding
+            prompts = np.stack([
+                _mk_prompt(int(r["rid"]), plen, self.cfg.vocab_size)
+                for r in requests])
+            mns = [int(r["max_new"]) for r in requests]
+            top = max(mns)
+            # Fresh per-gang cache: exactly the allocation the engine's
+            # persistent pool removes.
+            cache = self.gd.init_cache(self.cfg, B, self.max_len)
+            logits, cache = self._prefill(
+                self.params, jnp.asarray(prompts), self.cfg, cache)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            first = np.asarray(tok)
+            sent = [1] * B
+            yield [[int(first[i])] if mns[i] >= 1 else []
+                   for i in range(B)]
+            if top <= 1:
+                return
+            step = self.gd.jit_decode_chunk(self.cfg, self.chunk)
+            for slice_ in self.gd.decode_until(
+                    step, self.params, cache, tok, top - 1):
+                out = []
+                for i in range(B):
+                    take = slice_[i][:max(0, mns[i] - sent[i])]
+                    sent[i] += len(take)
+                    out.append([int(t) for t in take])
+                yield out
+
+        def warm(self, max_new: int = 2):
+            return "warm"
+
+        def __call__(self, request):
+            if hasattr(request, "json"):
+                request = request.json()
+            return self.decode_batch(request)
+
+    @serve.deployment(max_ongoing_requests=128)
+    class GPTContinuous:
+        def __init__(self, cfg_name: str, max_len: int, slots: int,
+                     chunk: int):
+            from ray_tpu.models import gpt
+            from ray_tpu.serve.engine import DecodeEngine
+
+            self.cfg = gpt.CONFIGS[cfg_name]
+            params = gpt.init_params(jax.random.PRNGKey(0), self.cfg)
+            self.engine = DecodeEngine(
+                params, self.cfg, slots=slots, chunk=chunk,
+                max_len=max_len, prompt_buckets=(plen,),
+                deployment="gpt_continuous")
+
+        @serve.batch(continuous=True)
+        def decode(self, request):
+            return self.engine, {
+                "prompt": _mk_prompt(int(request["rid"]), plen,
+                                     self.cfg.vocab_size),
+                "max_new": int(request["max_new"]),
+                "seed": int(request["rid"])}
+
+        def warm(self, max_new: int = 2):
+            list(self.engine.stream(_mk_prompt(0, plen,
+                                               self.cfg.vocab_size),
+                                    max_new))
+            return "warm"
+
+        def stats(self):
+            return self.engine.stats()
+
+        def __call__(self, request):
+            if hasattr(request, "json"):
+                request = request.json()
+            return self.decode(request)
+
+    return GPTStatic, GPTContinuous
+
+
+def run_continuous_ab(args, serve, np, cfg_name, model):
+    """ISSUE 5 acceptance A/B: identical Poisson arrivals + mixed output
+    lengths through the static gang and the slot engine; continuous mode
+    should beat static on BOTH p50 TTFT and total tok/s."""
+    import threading as _th
+
+    slots = max(2, args.slots if not args.smoke else min(args.slots, 4))
+    chunk = 8
+    plen = 16
+    n_req = args.requests if not args.smoke else min(args.requests, 12)
+    base = args.tokens if not args.smoke else min(args.tokens, 8)
+    # Wide output-length spread — the workload continuous batching
+    # exists for: the gang rides every batch out to its LONGEST lane,
+    # so its wasted lane-steps scale with max/mean of the mix.
+    mix = sorted({max(2, base // 4), base, 2 * base}) if not args.smoke \
+        else sorted({max(2, base // 4), max(3, base // 2), base})
+    max_len = plen + mix[-1] + chunk
+    sched = np.random.default_rng(42)
+    max_news = sched.choice(mix, size=n_req)
+    mean_new = float(np.mean(max_news))
+    GPTStatic, GPTContinuous = make_continuous_deployments(
+        serve, np, plen, slots)
+
+    def drive(handle, rate):
+        inter = np.random.default_rng(7).exponential(1.0 / rate,
+                                                     size=n_req)
+        arrivals = np.cumsum(inter)
+        ttfts = [None] * n_req
+        comps = [None] * n_req
+        toks = [0] * n_req
+        errs = [None] * n_req
+        start = time.perf_counter()
+
+        def one(i):
+            delay = start + arrivals[i] - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                gen = handle.options(stream=True, timeout_s=300).remote(
+                    {"rid": int(i), "max_new": int(max_news[i])})
+                first = None
+                n = 0
+                for item in gen:
+                    w = len(item)
+                    if w == 0:
+                        continue  # gang lane finished early: empty slices
+                    if first is None:
+                        first = time.perf_counter() - t0
+                    n += w
+            except Exception as e:  # noqa: BLE001 - report in the assert
+                errs[i] = repr(e)
+                return
+            ttfts[i] = first
+            comps[i] = time.perf_counter() - t0
+            toks[i] = n
+
+        threads = [_th.Thread(target=one, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        bad = [(i, toks[i], int(max_news[i]), errs[i])
+               for i in range(n_req) if toks[i] != max_news[i]]
+        assert not bad, f"short/failed streams (i, got, want, err): {bad}"
+        return ttfts, comps, wall, sum(toks)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(int(len(xs) * q), len(xs) - 1)]
+
+    # Both deployments stay up for the whole A/B and the drive passes
+    # INTERLEAVE (static, continuous, static, continuous): this box's
+    # throughput drifts minutes-to-minutes, so back-to-back passes keep
+    # the modes under the same machine conditions; best-of-N per mode
+    # then discards the contention-slowed passes (noise on a shared
+    # host is one-sided — it only ever slows a pass down).
+    passes = 1 if args.smoke else 2
+    handles = {}
+    for mode, app in (("static", GPTStatic.bind(cfg_name, max_len, chunk)),
+                      ("continuous",
+                       GPTContinuous.bind(cfg_name, max_len, slots,
+                                          chunk))):
+        handle = serve.run(app, name=f"gpt_{mode}",
+                           route_prefix=f"/{mode}")
+        handle.options(method_name="warm").remote(2).result(timeout=600)
+        # Compile the full-width programs before the clock starts.
+        warm_threads = [_th.Thread(target=lambda: list(
+            handle.options(stream=True).remote(
+                {"rid": 0, "max_new": 2}))) for _ in range(slots)]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join()
+        handles[mode] = handle
+    rate = args.rate
+    if rate <= 0:
+        # Calibrate offered load once, from a DEEP saturating burst
+        # through the static gang: 3x`slots` UNIFORM-length streams all
+        # queued at t=0, so every gang forms full-width (thread-start
+        # jitter can't split gangs — the backlog refills them) and has
+        # no ride-out waste. The aggregate rate approximates the ideal
+        # full-width decode rate at THIS moment on THIS machine (an
+        # UNDER-estimate when client-side overhead inflates elapsed
+        # time, so err high). Offer 2x of it: both modes run
+        # capacity-bound in every machine regime, so tok/s measures
+        # architecture (gang ride-out waste vs slot recycling), not the
+        # arrival schedule. Identical offered load for both modes.
+        n_cal = 3 * slots
+        t0 = time.perf_counter()
+        burst = [_th.Thread(target=lambda: list(
+            handles["static"].options(stream=True, timeout_s=300).remote(
+                {"rid": 0, "max_new": int(base)})))
+            for _ in range(n_cal)]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join()
+        ideal = n_cal * base / (time.perf_counter() - t0)
+        rate = max(2.0, 2.0 * ideal / mean_new)
+    runs = {"static": [], "continuous": []}
+    for _ in range(passes):
+        for mode in ("static", "continuous"):
+            runs[mode].append(drive(handles[mode], rate))
+    results = {}
+    for mode in ("static", "continuous"):
+        # Best pass by tok/s; its TTFT/completion percentiles ride along
+        # so each reported row is one coherent measurement.
+        ttfts, comps, wall, total = max(runs[mode],
+                                        key=lambda r: r[3] / r[2])
+        row = {
+            "metric": f"serve_{model}_{mode}_mode",
+            "value": round(total / wall, 1), "unit": "tokens/s",
+            "ttft_p50_ms": round(pct(ttfts, 0.50) * 1000, 2),
+            "ttft_p95_ms": round(pct(ttfts, 0.95) * 1000, 2),
+            "completion_p50_ms": round(pct(comps, 0.50) * 1000, 2),
+            "completion_p95_ms": round(pct(comps, 0.95) * 1000, 2),
+            "requests": n_req, "passes": passes,
+            "offered_rate_req_s": round(rate, 2),
+            "offered_tok_s": round(rate * mean_new, 1),
+            "tok_s_per_pass": [round(r[3] / r[2], 1) for r in runs[mode]],
+            "slots": slots, "chunk": chunk,
+            "output_len_mix": [int(m) for m in mix],
+        }
+        if mode == "continuous":
+            st = handles[mode].options(
+                method_name="stats").remote().result(timeout=60)
+            row["avg_slot_occupancy"] = round(st["avg_occupancy"], 3)
+            row["dispatches_per_token"] = round(
+                st["dispatches_per_token"], 4)
+            row["engine"] = {k: st[k] for k in
+                             ("admitted", "completed", "dispatches",
+                              "prefills", "tokens")}
+        print(json.dumps(row))
+        results[mode] = row
+        serve.delete(f"gpt_{mode}")
+    st, co = results["static"], results["continuous"]
+    print(json.dumps({
+        "metric": f"serve_{model}_continuous_ab",
+        "value": round(co["value"] / max(st["value"], 1e-9), 2),
+        "unit": "x_tokens_s_vs_static",
+        "ttft_p50_ratio": round(st["ttft_p50_ms"]
+                                / max(co["ttft_p50_ms"], 1e-9), 2),
+        "continuous_wins_ttft": co["ttft_p50_ms"] < st["ttft_p50_ms"],
+        "offered_rate_req_s": co["offered_rate_req_s"],
+        "smoke": bool(args.smoke),
+    }))
 
 
 def run_overload_ab(args, serve, GPTStream, cfg_name, max_len, chunks,

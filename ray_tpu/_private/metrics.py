@@ -404,6 +404,23 @@ def serve_metrics() -> dict:
                 "serve_batch_fill_ratio",
                 "Observed batch size / max_batch_size at flush",
                 bounds=_RATIO_BOUNDS),
+            # ---- continuous-batching engine (ISSUE 5). Observed on the
+            # engine driver thread, once per fused dispatch / admission.
+            engine_slot_occupancy=Histogram(
+                "serve_engine_slot_occupancy",
+                "Active-slot fraction of the continuous-batching decode "
+                "engine, observed per fused dispatch",
+                bounds=_RATIO_BOUNDS),
+            engine_admission_wait=Histogram(
+                "serve_engine_admission_wait_seconds",
+                "Time a request waited in the engine admission queue "
+                "before its slot prefill"),
+            engine_dispatches=Counter(
+                "serve_engine_dispatches_total",
+                "Fused decode dispatches issued by the slot engine"),
+            engine_tokens=Counter(
+                "serve_engine_tokens_total",
+                "Tokens emitted to engine stream lanes"),
         )
         return _serve
 

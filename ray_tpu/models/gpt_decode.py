@@ -44,6 +44,25 @@ Chunked-decode contract (the serve hot path):
 At ``temperature == 0`` the chunked path is asserted token-for-token
 identical to the per-token :func:`decode_step` loop (see
 ``tests/test_models_gpt_decode_chunk.py``).
+
+Slot-pool primitives (the continuous-batching engine's device half,
+ISSUE 5): :func:`init_slot_cache` allocates ONE long-lived cache
+``[L, B_slots, max_len, H, hd]`` whose ``pos`` is per-slot ``[B_slots]``
+instead of a batch-wide scalar, so every slot decodes at its own depth.
+:func:`prefill_into_slot` writes a (right-padded) prompt's K/V into one
+slot via ``lax.dynamic_update_slice`` — one compiled program per prompt
+bucket, with the TRUE prompt length traced dynamically, so any length
+within a bucket reuses the bucket's program. :func:`decode_chunk_slots`
+is the masked twin of :func:`decode_chunk`: k fused steps over the whole
+pool in one dispatch, with inactive slots' cache writes and position
+advances masked out (their rows compute garbage that the host ignores,
+which is cheaper than a dynamic-shape gather/compact on TPU). Per-slot
+PRNG lanes keep each stream's sampling chain independent of admission
+order. Right-padding is exact, not approximate: padded positions'
+K/V land beyond ``pos`` and every decode step overwrites position
+``pos`` BEFORE attention reads it, so pad keys are never attended —
+the engine's greedy output is asserted token-identical to
+:func:`generate_chunked` (see ``tests/test_serve_engine.py``).
 """
 from __future__ import annotations
 
@@ -344,3 +363,193 @@ def generate_chunked(params: Params, prompt: jax.Array, cfg: GPTConfig,
     yield from decode_until(step, params, cache, token,
                             max_new_tokens - 1, eos_token=eos_token,
                             rng=rng)
+
+
+# --------------------------------------------------------------- slot pool
+def init_slot_cache(cfg: GPTConfig, slots: int, max_len: int) -> Cache:
+    """Persistent pooled KV cache for the continuous-batching engine:
+    ``pos`` is per-slot ``[slots]`` so each lane decodes at its own
+    depth. Allocated ONCE per engine — slots are recycled by
+    re-prefilling, never by reallocating."""
+    shape = (cfg.n_layer, slots, max_len, cfg.n_head, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def prefill_into_slot(params: Params, cache: Cache, tokens: jax.Array,
+                      length: jax.Array, slot: jax.Array, rng: jax.Array,
+                      *, cfg: GPTConfig, temperature: float = 0.0
+                      ) -> Tuple[jax.Array, Cache, jax.Array]:
+    """Run one right-padded prompt and write its K/V into slot ``slot``
+    of the pool.
+
+    ``tokens`` is ``[1, S_bucket]`` (prompt right-padded to its bucket;
+    the bucket size is the only shape XLA sees, so one program per
+    bucket serves every length within it); ``length`` is the TRUE prompt
+    length (traced scalar); ``slot`` is the target slot index (traced).
+    Returns ``(first_token, cache', rng')`` where ``first_token`` is the
+    prompt's next-token sample (the TTFT token — sampling is fused into
+    the prefill program so admission is one dispatch).
+
+    Padding is exact: positions ``< length`` attend only causally to
+    true prompt tokens, the last-token logits are sliced at
+    ``length - 1``, and the pad positions' K/V are overwritten by decode
+    steps before ``pos`` ever reaches them (decode writes position
+    ``pos`` before attending over ``<= pos``)."""
+    B, S = tokens.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
+    x = x + params["pos_embed"][:S].astype(cfg.dtype)[None]
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    def body(carry, layer):
+        x = carry
+        p = layer
+        q, k, v = _block_kv(x, p, cfg)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        return x, (k, v)
+
+    x, (k_new, v_new) = lax.scan(body, x, params["block"])
+    x = _rmsnorm(x, params["ln_f_scale"])
+    x_last = lax.dynamic_slice(x, (0, length - 1, 0), (1, 1, cfg.d_model))
+    logits = _project_vocab(x_last, params["embed"]["kernel"], cfg)
+    token, rng = _sample(logits[:, 0], temperature, rng)
+    kp = lax.dynamic_update_slice(cache["k"], k_new, (0, slot, 0, 0, 0))
+    vp = lax.dynamic_update_slice(cache["v"], v_new, (0, slot, 0, 0, 0))
+    pos = lax.dynamic_update_slice(cache["pos"],
+                                   jnp.reshape(length, (1,)), (slot,))
+    return token[0], {"k": kp, "v": vp, "pos": pos}, rng
+
+
+def _slot_decode_step(params: Params, cache: Cache, token: jax.Array,
+                      active: jax.Array, cfg: GPTConfig
+                      ) -> Tuple[jax.Array, Cache]:
+    """One masked decode step over the whole slot pool: each slot writes
+    its new K/V at ITS OWN ``pos[b]`` (one-hot select — positions differ
+    per slot, so a single ``dynamic_update_slice`` can't express the
+    scatter) and attends over ``<= pos[b]``. Inactive slots neither
+    write nor advance; their logits rows are garbage the host must
+    ignore."""
+    B = token.shape[0]
+    max_len = cache["k"].shape[2]
+    pos = cache["pos"]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    x = params["embed"]["kernel"].astype(cfg.dtype)[token][:, None]
+    x = x + jnp.take(params["pos_embed"], pos, axis=0
+                     ).astype(cfg.dtype)[:, None]
+    ar = jnp.arange(max_len)
+    valid = (ar[None, :] <= pos[:, None])[:, None, None, :]
+    write = (active[:, None] & (ar[None, :] == pos[:, None])
+             )[:, :, None, None]
+
+    def body(carry, layer):
+        x = carry
+        p, kc, vc = layer
+        q, k, v = _block_kv(x, p, cfg)   # [B, 1, H, hd]
+        kc = jnp.where(write, k, kc)
+        vc = jnp.where(write, v, vc)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vc,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, 1, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["block"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = _project_vocab(x, params["embed"]["kernel"], cfg)
+    return logits[:, 0], {"k": k_new, "v": v_new,
+                          "pos": pos + active.astype(jnp.int32)}
+
+
+def _sample_slots(logits, temperature: float, keys):
+    """Per-slot sampling with independent PRNG lanes: each slot's key
+    chain splits exactly like :func:`_sample`'s, so a slot's stream is
+    reproducible from its seed regardless of which other slots share the
+    pool or when it was admitted."""
+    if temperature > 0.0:
+        split = jax.vmap(jax.random.split)(keys)   # [B, 2, 2]
+        keys, subs = split[:, 0], split[:, 1]
+        token = jax.vmap(lambda s, lg: jax.random.categorical(
+            s, lg / temperature, axis=-1))(subs, logits).astype(jnp.int32)
+    else:
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return token, keys
+
+
+def decode_chunk_slots(params: Params, cache: Cache, token: jax.Array,
+                       rngs: jax.Array, active: jax.Array, *,
+                       cfg: GPTConfig, k: int, temperature: float = 0.0,
+                       eos_token: int = -1):
+    """Masked twin of :func:`decode_chunk` over a slot pool: k fused
+    steps in ONE program, decoding only slots where ``active`` is set.
+
+    ``token`` ``[B_slots]`` is each slot's last emitted token, ``rngs``
+    ``[B_slots, 2]`` its PRNG lane, ``active`` ``[B_slots]`` the
+    chunk-static admission mask (admission happens at chunk boundaries,
+    so the mask never changes inside a dispatch). Returns
+    ``(tokens [B_slots, k], cache', done [B_slots], rngs')``; rows of
+    inactive slots are garbage. EOS lanes mask-and-carry exactly like
+    :func:`decode_chunk` — the ENGINE frees the slot at the chunk
+    boundary, which is what turns mask-and-carry into slot reuse."""
+    B = token.shape[0]
+    eos = jnp.asarray(eos_token, jnp.int32)
+    done0 = (active & (token == eos)) if eos_token >= 0 \
+        else jnp.zeros((B,), jnp.bool_)
+
+    def body(carry, _):
+        cache, tok, done, keys = carry
+        logits, cache = _slot_decode_step(params, cache, tok, active, cfg)
+        nxt, keys = _sample_slots(logits, temperature, keys)
+        if eos_token >= 0:
+            nxt = jnp.where(done, eos, nxt)
+            done = done | (active & (nxt == eos))
+        return (cache, nxt, done, keys), nxt
+
+    (cache, _, done, rngs), toks = lax.scan(
+        body, (cache, token, done0, rngs), None, length=k)
+    return jnp.moveaxis(toks, 0, 1), cache, done, rngs
+
+
+@functools.lru_cache(maxsize=64)
+def jit_prefill_into_slot(cfg: GPTConfig, temperature: float = 0.0):
+    """Jitted :func:`prefill_into_slot`; retraces once per padded-prompt
+    SHAPE, so the compiled-program count equals the engine's prompt
+    bucket count. Cached on the static knobs so every engine for the
+    same (cfg, temperature) shares one wrapper (and its trace cache).
+    The pool cache is donated: the engine holds the only reference and
+    immediately rebinds the returned cache, so on TPU the update is
+    in-place instead of a full-pool copy (CPU ignores donation)."""
+    return jax.jit(functools.partial(prefill_into_slot, cfg=cfg,
+                                     temperature=temperature),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=64)
+def jit_decode_chunk_slots(cfg: GPTConfig, k: int,
+                           temperature: float = 0.0, eos_token: int = -1):
+    """Jitted :func:`decode_chunk_slots`: ONE compiled program per
+    (pool shape, k) — admission patterns, per-request max_new, and slot
+    choice are all runtime values, never retrace triggers (pinned by the
+    recompile-guard test). The pool cache is donated (see
+    :func:`jit_prefill_into_slot`)."""
+    return jax.jit(functools.partial(decode_chunk_slots, cfg=cfg, k=k,
+                                     temperature=temperature,
+                                     eos_token=eos_token),
+                   donate_argnums=(1,))

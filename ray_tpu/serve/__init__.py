@@ -11,6 +11,7 @@ from .api import (Application, Deployment, delete, deployment,
                   start, status)
 from .batching import batch, default_buckets, pad_to_bucket
 from .config import (AutoscalingConfig, DeploymentConfig, HTTPOptions, gRPCOptions)
+from .engine import DecodeEngine, EngineShutdownError
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -19,8 +20,9 @@ from .request import (BackPressureError, ReplicaOverloadedError, Request,
                       get_request_deadline)
 
 __all__ = [
-    "Application", "AutoscalingConfig", "BackPressureError", "Deployment",
-    "DeploymentConfig",
+    "Application", "AutoscalingConfig", "BackPressureError", "DecodeEngine",
+    "Deployment",
+    "DeploymentConfig", "EngineShutdownError",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "HTTPOptions", "gRPCOptions", "ReplicaOverloadedError", "Request",
     "RequestDeadlineExceeded",

@@ -219,8 +219,15 @@ class Replica:
             with tracing.span("user_code", kind="stage",
                               deployment=self.deployment_name,
                               method=method_name):
-                items = self._traced_items(
-                    self._user_stream(method_name, args, kwargs))
+                out = self._invoke_user(method_name, args, kwargs)
+                # Continuous-engine streams (@serve.batch(continuous=
+                # True)) carry their own per-dispatch decode.chunk spans
+                # with real device timing — recording pull-wait spans
+                # here too would double-count the stage.
+                engine_fed = bool(getattr(out, "__rt_engine_stream__",
+                                          False))
+                items = self._traced_items(self._normalize_stream(out),
+                                           engine_fed=engine_fed)
                 if ctx and ctx.get("flatten_chunks"):
                     for item in items:
                         if isinstance(item, (list, tuple)):
@@ -246,17 +253,20 @@ class Replica:
                 self._ongoing -= 1
 
     @staticmethod
-    def _traced_items(items):
+    def _traced_items(items, engine_fed: bool = False):
         """Pass-through iterator that records one stage span per stream
         item when the request is traced: ``decode.chunk`` for chunk
         slices (list/tuple/array — one fused device dispatch each),
         ``stream.item`` for scalar items. The span covers the time this
         replica spent PRODUCING the item (the pull from the user
-        generator), which for chunked decode is exactly one dispatch."""
+        generator), which for chunked decode is exactly one dispatch.
+        ``engine_fed`` streams skip span recording entirely: the decode
+        engine records one authoritative ``decode.chunk`` span per fused
+        dispatch on its driver thread."""
         from ..util.tracing import current_context, record_span
 
-        if current_context() is None:
-            yield from items  # untraced: zero per-item overhead
+        if engine_fed or current_context() is None:
+            yield from items  # untraced / engine-traced: no overhead
             return
         idx = 0
         while True:
@@ -281,15 +291,18 @@ class Replica:
             idx += 1
             yield item
 
-    def _user_stream(self, method_name: str, args: tuple, kwargs: dict):
-        """Invoke the user callable and normalize every handler shape
-        (sync/async generator, coroutine, plain value) into one sync
-        iterator."""
+    def _invoke_user(self, method_name: str, args: tuple, kwargs: dict):
+        """Call the user callable and return its RAW result (generator,
+        coroutine, engine stream, plain value) without starting any
+        iteration — the caller inspects it before normalization."""
         if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
             method = self._user
         else:
             method = getattr(self._user, method_name)
-        out = method(*args, **kwargs)
+        return method(*args, **kwargs)
+
+    def _normalize_stream(self, out):
+        """Normalize one raw handler result into a sync iterator."""
         if inspect.isasyncgen(out):
             # Drain the async generator on a private loop; the
             # replica's concurrency model is threads, not one loop.

@@ -135,6 +135,8 @@ class _BatchQueue:
         while queued are failed out of the batch instead of padding it —
         the device dispatch never spends cycles on answers whose callers
         already gave up. Returns the still-live entries."""
+        from .._private.metrics import serve_metrics
+
         live = []
         for entry in batch:
             item, fut, dl = entry[0], entry[1], entry[2]
@@ -142,8 +144,6 @@ class _BatchQueue:
                 if not fut.done():
                     fut.set_exception(RequestDeadlineExceeded(
                         "request expired while queued for batching"))
-                from .._private.metrics import serve_metrics
-
                 serve_metrics()["requests_expired"].inc(
                     labels={"where": "batcher",
                             "deployment": entry[5] or ""})
@@ -297,9 +297,38 @@ def _drain_stream(lane: _StreamLane):
         lane.closed = True
 
 
+class _EngineStream:
+    """Iterator over one continuous-engine lane. A real class (not a
+    generator) so it can carry ``__rt_engine_stream__`` — the replica's
+    tracing reads that marker to skip recording its own per-item
+    ``decode.chunk`` spans, deferring to the engine's per-dispatch spans
+    (which carry real device timing instead of pull-wait timing) — and
+    so ``close()`` marks the lane abandoned even before the first pull
+    (closing an UNSTARTED generator skips its ``finally``, so
+    ``_drain_stream`` alone would never flag a consumer that walked
+    away while still queued for admission)."""
+
+    __rt_engine_stream__ = True
+
+    def __init__(self, lane: _StreamLane):
+        self._lane = lane
+        self._it = _drain_stream(lane)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
+
+    def close(self):
+        self._lane.closed = True
+        self._it.close()
+
+
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01, pad_to_bucket: bool = False,
-          buckets: Optional[Sequence[int]] = None, stream: bool = False):
+          buckets: Optional[Sequence[int]] = None, stream: bool = False,
+          continuous: bool = False):
     """Decorator: turn a ``List[T] -> List[R]`` handler into a ``T -> R``
     callable that transparently batches concurrent callers.
 
@@ -317,10 +346,48 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
     slices (one element per batched caller) and each call returns an
     iterator of that caller's elements — see the module docstring for
     the fused-decode shape.
+
+    With ``continuous=True`` the batching moves OFF the flusher entirely
+    and into a :class:`~.engine.DecodeEngine` slot pool: the handler is
+    called once per request and returns ``(engine, submit_kwargs)`` —
+    the wrapper forwards the request's deadline and trace context into
+    ``engine.submit`` and hands back the request's own chunk-slice
+    stream. No batch queue forms; admission happens at the engine's
+    chunk boundaries, so a request arriving mid-generation joins the
+    running pool as soon as a slot frees instead of waiting for the
+    next gang batch::
+
+        @serve.batch(continuous=True)
+        def decode(self, request):
+            return self.engine, {"prompt": request["prompt"],
+                                 "max_new": request["max_new"]}
+
+        def __call__(self, request):
+            return self.decode(request)       # iterator of [j] slices
     """
+    if continuous and (stream or pad_to_bucket or buckets is not None):
+        raise ValueError(
+            "continuous=True replaces the flusher with an engine slot "
+            "pool; stream/pad_to_bucket/buckets do not apply")
+    if buckets is not None:
+        bs = sorted(int(b) for b in buckets)
+        if not bs or bs[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got "
+                             f"{list(buckets)}")
+        if bs[-1] < max_batch_size:
+            # Without this, pad_to_bucket silently returns buckets[-1]
+            # for a full batch and the "pad" becomes a negative-count
+            # no-op — the jitted callee then sees unpadded sizes.
+            raise ValueError(
+                f"buckets {list(buckets)} do not cover "
+                f"max_batch_size={max_batch_size}; add a bucket >= "
+                f"{max_batch_size} (a full batch cannot be padded DOWN "
+                f"to {bs[-1]})")
 
     def decorate(fn):
         is_method = _looks_like_method(fn)
+        if continuous:
+            return _decorate_continuous(fn)
         cfg = (max_batch_size, batch_wait_timeout_s, pad_to_bucket,
                tuple(buckets) if buckets else None, stream)
         key = (getattr(fn, "__module__", ""), getattr(fn, "__qualname__", ""))
@@ -351,6 +418,34 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
     if _fn is not None and callable(_fn):
         return decorate(_fn)
     return decorate
+
+
+def _decorate_continuous(fn):
+    """Engine-backed admission path: per request, the handler maps the
+    item to ``(engine, submit_kwargs)`` and the wrapper feeds the
+    engine's admission queue, inheriting the request's deadline (so the
+    engine can drop it unstarted or free its slot mid-generation) and
+    trace context (so ``engine.admission`` / per-dispatch
+    ``decode.chunk`` spans join the request's trace)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        out = fn(*args)
+        try:
+            engine, kw = out
+            kw = dict(kw)
+        except (TypeError, ValueError):
+            raise TypeError(
+                f"@serve.batch(continuous=True) handler "
+                f"{fn.__qualname__} must return (engine, submit_kwargs),"
+                f" got {type(out).__name__}") from None
+        lane = engine.submit(deadline_s=get_request_deadline(),
+                             trace_ctx=tracing.current_context(), **kw)
+        return _EngineStream(lane)
+
+    wrapper.__rt_is_batched__ = True
+    wrapper.__rt_continuous__ = True
+    return wrapper
 
 
 def _looks_like_method(fn) -> bool:

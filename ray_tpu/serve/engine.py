@@ -1,0 +1,405 @@
+"""Continuous-batching decode engine: one driver thread, one persistent
+slot pool (ISSUE 5 tentpole).
+
+``@serve.batch(stream=True)`` gang-schedules: a batch forms once, runs
+its whole generation off a freshly allocated KV cache, and a request
+arriving mid-generation waits for the NEXT batch (or spawns a competing
+per-batch stream thread that contends for the one device). The engine
+replaces gang scheduling with **slot scheduling** — the standard
+continuous-batching design of production inference stacks, mapped onto
+TPU-friendly static shapes:
+
+- ONE long-lived pooled KV cache (``[L, B_slots, max_len, H, hd]``,
+  :func:`~ray_tpu.models.gpt_decode.init_slot_cache`) allocated at
+  construction. No per-request ``init_cache``; slots are recycled by
+  re-prefilling in place.
+- A single driver thread owns every device dispatch, so concurrent
+  requests never contend for the device — request threads only enqueue
+  (device-concurrency discipline per the TPU concurrency study in
+  PAPERS.md).
+- Admission happens at **chunk boundaries**:
+  :func:`~ray_tpu.models.gpt_decode.prefill_into_slot` writes the
+  prompt's K/V into a free slot (one compiled program per prompt
+  bucket; the TRUE length is traced, so any length within a bucket
+  shares the program) and the first sampled token streams out
+  immediately — TTFT is one prefill dispatch away from admission, not
+  one full gang generation.
+- :func:`~ray_tpu.models.gpt_decode.decode_chunk_slots` then decodes
+  ALL active slots in one fused k-step dispatch; a slot frees the
+  moment its lane samples EOS, exhausts ``max_new``, passes its
+  deadline, or its consumer walks away — instead of riding out the
+  batch.
+
+Static-shape discipline: the compiled-program set is exactly
+``len(prompt_buckets)`` prefill programs + 1 chunk program, bounded for
+ANY admission pattern (see the recompile guard in
+``tests/test_serve_engine.py``).
+
+Results stream back through the same :class:`~.batching._StreamLane`
+queues the batched streaming path uses, so replicas, handles, and the
+HTTP proxy need no new transport: ``engine.submit(...)`` returns a lane,
+``engine.stream(...)`` an iterator of per-chunk ``np.int32[j]`` slices.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..util import tracing
+from .batching import (_STREAM_END, _EngineStream, _StreamLane,
+                       default_buckets)
+from .request import RequestDeadlineExceeded, deadline_expired
+
+
+def default_prompt_buckets(max_len: int) -> List[int]:
+    """Powers of two from 8 up to (and including) max_len."""
+    return sorted(b for b in default_buckets(max_len) if b >= 8) \
+        or [max_len]
+
+
+@dataclass
+class _EngineRequest:
+    """One queued admission: everything the driver needs to prefill a
+    slot and route its stream."""
+
+    prompt: np.ndarray            # [S] int32
+    bucket: int                   # padded prompt length (compile shape)
+    max_new: int
+    lane: _StreamLane
+    deadline_s: Optional[float]
+    trace_ctx: Optional[dict]
+    seed: int
+    enq_t: float
+
+
+@dataclass
+class _Slot:
+    """Host-side state of one occupied slot between chunk boundaries."""
+
+    lane: _StreamLane
+    remaining: int                # tokens still owed to the caller
+    deadline_s: Optional[float]
+    trace_ctx: Optional[dict]
+    emitted: int = 1              # the prefill-derived token
+    admitted_t: float = field(default_factory=time.time)
+
+
+class EngineShutdownError(RuntimeError):
+    """The engine stopped while this request was queued or decoding."""
+
+
+class DecodeEngine:
+    """Slot-based continuous-batching engine for the chunked GPT decode
+    path.
+
+    Usage (inside a deployment; or see ``@serve.batch(continuous=True)``
+    for the decorator form)::
+
+        engine = DecodeEngine(params, cfg, slots=8, chunk=8,
+                              max_len=256, eos_token=eos)
+        for slice_ in engine.stream(prompt_ids, max_new=64):
+            ...                       # np.int32 [j] per chunk, first j=1
+
+    All device work runs on the engine's single driver thread;
+    ``submit``/``stream`` only enqueue and are safe from any thread.
+    At ``temperature == 0`` each stream is token-identical to
+    :func:`~ray_tpu.models.gpt_decode.generate_chunked` for the same
+    prompt (asserted in ``tests/test_serve_engine.py``).
+    """
+
+    def __init__(self, params, cfg, *, slots: int = 4, chunk: int = 8,
+                 max_len: int = 0, temperature: float = 0.0,
+                 eos_token: int = -1,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 deployment: str = "", auto_start: bool = True):
+        from ..models import gpt_decode
+
+        self.params = params
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.chunk = int(chunk)
+        self.max_len = int(max_len or cfg.max_seq)
+        self.temperature = float(temperature)
+        self.eos_token = int(eos_token)
+        self.deployment = deployment or "engine"
+        if self.slots < 1 or self.chunk < 1:
+            raise ValueError("slots and chunk must be >= 1")
+        if self.max_len > cfg.max_seq:
+            raise ValueError(f"max_len {self.max_len} exceeds model "
+                             f"max_seq {cfg.max_seq}")
+        buckets = sorted(set(int(b) for b in (
+            prompt_buckets or default_prompt_buckets(self.max_len))))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"invalid prompt_buckets {buckets}")
+        if buckets[-1] > self.max_len:
+            raise ValueError(
+                f"largest prompt bucket {buckets[-1]} exceeds cache "
+                f"length {self.max_len}")
+        self.prompt_buckets = buckets
+        self._gd = gpt_decode
+        self._prefill = gpt_decode.jit_prefill_into_slot(
+            cfg, self.temperature)
+        self._step = gpt_decode.jit_decode_chunk_slots(
+            cfg, self.chunk, self.temperature, self.eos_token)
+        # THE persistent pool: allocated once, recycled forever.
+        self._cache = gpt_decode.init_slot_cache(cfg, self.slots,
+                                                 self.max_len)
+        # Per-slot host state; index i mirrors pool row i. ``_token`` /
+        # ``_rngs`` are the host copies uploaded with each dispatch
+        # (tiny against the chunk compute; keeping them host-side avoids
+        # per-admission scatter programs).
+        self._state: List[Optional[_Slot]] = [None] * self.slots
+        self._token = np.zeros((self.slots,), np.int32)
+        self._rngs = np.zeros((self.slots, 2), np.uint32)
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        # Guards the put-vs-final-drain race: once _fail_all flips
+        # _draining under this lock, no new submission can land in a
+        # queue nobody will ever read again.
+        self._admit_lock = threading.Lock()
+        self._draining = False
+        self._stats_lock = threading.Lock()
+        self._stats = {"admitted": 0, "completed": 0, "expired": 0,
+                       "abandoned": 0, "prefills": 0, "dispatches": 0,
+                       "tokens": 0, "occupancy_sum": 0.0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self.start()
+
+    # ------------------------------------------------------------- admission
+    def submit(self, prompt, max_new: int, *,
+               deadline_s: Optional[float] = None,
+               trace_ctx: Optional[dict] = None,
+               seed: int = 0) -> _StreamLane:
+        """Enqueue one request; returns its stream lane immediately. The
+        driver admits it at the next chunk boundary with a free slot.
+        Safe from any thread."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        S = prompt.shape[0]
+        if S < 1:
+            raise ValueError("empty prompt")
+        bucket = next((b for b in self.prompt_buckets if b >= S), None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {S} exceeds largest prompt bucket "
+                f"{self.prompt_buckets[-1]}")
+        if S + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({S}) + max_new ({max_new}) exceeds cache "
+                f"length {self.max_len}")
+        if self._thread is None or not self._thread.is_alive():
+            raise EngineShutdownError("engine is not running")
+        lane = _StreamLane()
+        if max_new <= 0:
+            lane.q.put((_STREAM_END, None))
+            return lane
+        with self._admit_lock:
+            if self._draining:
+                raise EngineShutdownError("engine is not running")
+            self._queue.put(_EngineRequest(
+                prompt=prompt, bucket=bucket, max_new=int(max_new),
+                lane=lane, deadline_s=deadline_s, trace_ctx=trace_ctx,
+                seed=int(seed), enq_t=time.time()))
+        return lane
+
+    def stream(self, prompt, max_new: int, **kw):
+        """``submit`` + drain: an iterator of np.int32 ``[j]`` chunk
+        slices (first slice is the prefill token alone). ``close()``
+        marks the lane abandoned even before the first pull."""
+        return _EngineStream(self.submit(prompt, max_new, **kw))
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._admit_lock:
+            self._draining = False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"rt-serve-engine-{self.deployment}")
+        self._thread.start()
+
+    def shutdown(self, timeout_s: float = 5.0):
+        """Stop the driver; queued and in-flight lanes fail with
+        :class:`EngineShutdownError`."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["active_slots"] = sum(s is not None for s in self._state)
+        out["slots"] = self.slots
+        out["queued"] = self._queue.qsize()
+        d = max(out["dispatches"], 1)
+        out["avg_occupancy"] = out.pop("occupancy_sum") / d
+        out["dispatches_per_token"] = (
+            (out["dispatches"] + out["prefills"]) / max(out["tokens"], 1))
+        return out
+
+    def _count(self, **deltas):
+        with self._stats_lock:
+            for k, v in deltas.items():
+                self._stats[k] += v
+
+    # ---------------------------------------------------------- driver loop
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                self._admit_pending()
+                if not any(s is not None for s in self._state):
+                    # Idle: block briefly for the next arrival instead
+                    # of spinning; the timeout bounds shutdown latency.
+                    try:
+                        req = self._queue.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    self._admit_one(req)
+                    continue  # boundary: drain more arrivals first
+                self._dispatch_chunk()
+            self._fail_all(EngineShutdownError("engine shut down"))
+        except BaseException as e:  # noqa: BLE001 - driver died: fan out
+            self._fail_all(e)
+            raise
+
+    def _fail_all(self, exc: BaseException):
+        with self._admit_lock:
+            self._draining = True    # no put can land past this point
+        for i, st in enumerate(self._state):
+            if st is not None:
+                st.lane.q.put(("err", exc))
+                self._state[i] = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.lane.q.put(("err", exc))
+
+    def _admit_pending(self):
+        """Chunk-boundary admission: fill every free slot from the FIFO
+        queue. Expired / abandoned requests are failed out without
+        spending a prefill."""
+        while any(s is None for s in self._state):
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._admit_one(req)
+
+    def _admit_one(self, req: _EngineRequest):
+        from .._private.metrics import serve_metrics
+
+        if req.lane.closed:
+            self._count(abandoned=1)
+            return
+        if deadline_expired(req.deadline_s):
+            self._count(expired=1)
+            serve_metrics()["requests_expired"].inc(
+                labels={"where": "engine", "deployment": self.deployment})
+            req.lane.q.put(("err", RequestDeadlineExceeded(
+                "request expired while queued for engine admission")))
+            return
+        slot = next(i for i, s in enumerate(self._state) if s is None)
+        now = time.time()
+        serve_metrics()["engine_admission_wait"].observe(
+            max(now - req.enq_t, 0.0),
+            labels={"deployment": self.deployment})
+        if req.trace_ctx is not None:
+            tracing.record_span("engine.admission", req.enq_t, now,
+                                parent_ctx=req.trace_ctx, slot=slot,
+                                deployment=self.deployment)
+        import jax
+
+        padded = np.zeros((1, req.bucket), np.int32)
+        padded[0, :req.prompt.shape[0]] = req.prompt
+        tok, self._cache, key = self._prefill(
+            self.params, self._cache, padded,
+            np.int32(req.prompt.shape[0]), np.int32(slot),
+            jax.random.PRNGKey(req.seed))
+        first = int(np.asarray(tok))
+        self._count(prefills=1, admitted=1, tokens=1)
+        serve_metrics()["engine_tokens"].inc(
+            labels={"deployment": self.deployment})
+        self._token[slot] = first
+        self._rngs[slot] = np.asarray(key)
+        req.lane.q.put(("item", np.asarray([first], np.int32)))
+        if req.max_new <= 1 or (self.eos_token >= 0
+                                and first == self.eos_token):
+            req.lane.q.put((_STREAM_END, None))
+            self._count(completed=1)
+            return
+        self._state[slot] = _Slot(
+            lane=req.lane, remaining=req.max_new - 1,
+            deadline_s=req.deadline_s, trace_ctx=req.trace_ctx)
+
+    def _dispatch_chunk(self):
+        """ONE fused device dispatch decoding every active slot, then
+        per-slot routing/trimming and boundary frees."""
+        from .._private.metrics import serve_metrics
+
+        active = np.array([s is not None for s in self._state], bool)
+        n_active = int(active.sum())
+        t0 = time.time()
+        toks, self._cache, _done, rngs = self._step(
+            self.params, self._cache, self._token, self._rngs, active)
+        toks_np = np.asarray(toks)        # ONE transfer per chunk
+        rngs_np = np.asarray(rngs)
+        t1 = time.time()
+        sm = serve_metrics()
+        sm["engine_slot_occupancy"].observe(
+            n_active / self.slots, labels={"deployment": self.deployment})
+        sm["engine_dispatches"].inc(
+            labels={"deployment": self.deployment})
+        self._count(dispatches=1, occupancy_sum=n_active / self.slots)
+        emitted = 0
+        for i, st in enumerate(self._state):
+            if st is None:
+                continue
+            self._token[i] = toks_np[i, -1]
+            self._rngs[i] = rngs_np[i]
+            if st.lane.closed:               # consumer left: free now
+                self._state[i] = None
+                self._count(abandoned=1)
+                continue
+            if deadline_expired(st.deadline_s):
+                st.lane.q.put(("err", RequestDeadlineExceeded(
+                    "request deadline passed mid-generation")))
+                self._state[i] = None
+                self._count(expired=1)
+                sm["requests_expired"].inc(
+                    labels={"where": "engine",
+                            "deployment": self.deployment})
+                continue
+            row = toks_np[i]
+            j = min(self.chunk, st.remaining)
+            finished = st.remaining <= self.chunk
+            if self.eos_token >= 0:
+                hits = np.flatnonzero(row[:j] == self.eos_token)
+                if hits.size:                # free at the EOS, not the
+                    j = int(hits[0]) + 1     # end of the gang batch
+                    finished = True
+            if st.trace_ctx is not None:
+                tracing.record_span("decode.chunk", t0, t1,
+                                    parent_ctx=st.trace_ctx, slot=i,
+                                    active_slots=n_active, tokens=j,
+                                    deployment=self.deployment)
+            st.lane.q.put(("item", row[:j].copy()))
+            st.remaining -= j
+            st.emitted += j
+            emitted += j
+            if finished:
+                st.lane.q.put((_STREAM_END, None))
+                self._state[i] = None
+                self._count(completed=1)
+        if emitted:
+            sm["engine_tokens"].inc(
+                emitted, labels={"deployment": self.deployment})
+            self._count(tokens=emitted)

@@ -100,6 +100,59 @@ def test_eos_masks_finished_stream_in_batch(nano, nano_params):
     assert (got[1] == ref[1, :n]).all()
 
 
+def test_eos_on_prefill_token_ends_stream(nano, nano_params):
+    """EOS sampled as the very FIRST (prefill-derived) token: the stream
+    is exactly one [B, 1] slice holding the eos — no decode chunk ever
+    dispatches. Pins the prefill-edge semantics the engine's
+    admit-then-free-immediately path mirrors."""
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, nano.vocab_size, (1, 8)).astype(np.int32)
+    ref = _per_token(nano_params, prompt, nano, 4, max_len=32)
+    eos = int(ref[0, 0])
+    slices, got = _chunked(nano_params, prompt, nano, 8, chunk=4,
+                           max_len=32, eos_token=eos)
+    assert len(slices) == 1 and slices[0].shape == (1, 1)
+    assert got.tolist() == [[eos]]
+
+
+def test_decode_until_lane_done_at_entry(nano, nano_params):
+    """decode_until's two-layer EOS contract, pinned per lane: a lane
+    whose ENTRY token is already eos stays masked (emits eos padding
+    only, its done flag honored from the first chunk) while the other
+    lane decodes its full reference stream; trimming cuts at the first
+    position where ALL lanes are done — never earlier. The engine's
+    per-slot freeing must preserve exactly these stream contents."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt_decode
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, nano.vocab_size, (2, 8)).astype(np.int32)
+    ref = _per_token(nano_params, prompt, nano, 10, max_len=32)
+    # EOS = lane 0's prefill-derived token; require lane 1 to avoid it
+    # through its window so only max_new ends the batch.
+    eos = int(ref[0, 0])
+    assert not (ref[1] == eos).any(), \
+        "seed produced overlapping EOS; adjust the test seed"
+    cache = gpt_decode.init_cache(nano, 2, 32)
+    logits, cache = gpt_decode._jitted_prefill()(
+        nano_params, jnp.asarray(prompt), nano, cache)
+    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    assert int(token[0]) == eos  # lane 0 enters decode_until done
+    step = gpt_decode.jit_decode_chunk(nano, 4, 0.0, eos)
+    slices = list(gpt_decode.decode_until(
+        step, nano_params, cache, token, 9, eos_token=eos))
+    got = np.concatenate(slices, axis=1)
+    # ALL-lanes trimming: lane 1 alive => full 9 tokens stream.
+    assert got.shape == (2, 9)
+    assert (got[0] == eos).all()               # masked lane: eos padding
+    assert (got[1] == ref[1, 1:]).all()        # live lane: untouched
+    # and when BOTH lanes enter done, not a single chunk is emitted
+    token_done = jnp.asarray([eos, eos], jnp.int32)
+    assert list(gpt_decode.decode_until(
+        step, nano_params, cache, token_done, 9, eos_token=eos)) == []
+
+
 def test_temperature_sampling_deterministic(nano, nano_params):
     """temperature>0 threads the PRNG key through the scan carry: same
     seed → same tokens, different seed → (almost surely) different."""
