@@ -44,6 +44,18 @@ slots freed per-request at EOS/max_new). Reports p50/p95 TTFT,
 completion latency, total decoded tok/s, and — continuous only — slot
 occupancy and dispatches/token from the engine's own accounting.
 ``--smoke`` shrinks the load so the A/B runs inside tier-1 CI.
+
+``--paged`` (ISSUE 6) switches to the paged-KV A/B: a flat slot pool
+and a paged pool built from the SAME KV-byte budget (``n_pages *
+page_size == flat_slots * max_len`` positions) are driven with an
+identical saturating burst of mixed-length requests sharing a common
+system prompt. Reports, per pool: decoded tok/s, TTFT/completion
+percentiles, and PEAK CONCURRENT SLOTS (the paged pool runs ~3x the
+lanes on the same bytes because real sequences are shorter than
+max_len); then a shared-prefix TTFT probe — median TTFT of a request
+whose system prompt is prefix-cached (page-table copy + short-suffix
+prefill) vs the flat pool's full prefill. ``--smoke`` shrinks it for
+tier-1 CI.
 """
 from __future__ import annotations
 
@@ -86,9 +98,16 @@ def main():
                              "@serve.batch vs the slot-pool DecodeEngine "
                              "under the same Poisson arrivals with mixed "
                              "output lengths")
+    parser.add_argument("--paged", action="store_true",
+                        help="paged-KV A/B: flat slot pool vs paged "
+                             "pool at the SAME KV-byte budget, plus a "
+                             "shared-prefix TTFT probe (direct engine "
+                             "drive, no serve stack)")
+    parser.add_argument("--page-size", type=int, default=8)
     parser.add_argument("--smoke", action="store_true",
-                        help="with --continuous: shrunk load for tier-1 "
-                             "CI (fewer requests, shorter outputs)")
+                        help="with --continuous/--paged: shrunk load "
+                             "for tier-1 CI (fewer requests, shorter "
+                             "outputs)")
     parser.add_argument("--slots", type=int, default=8,
                         help="engine slot count == static max_batch_size")
     parser.add_argument("--rate", type=float, default=0.0,
@@ -100,6 +119,16 @@ def main():
     chunks = [int(c) for c in args.chunk.split(",") if c.strip()]
 
     import numpy as np
+
+    if args.paged:
+        # Direct engine drive: the A/B isolates the pool architecture
+        # (flat reservation vs pages) from the serve transport.
+        import jax as _jax
+
+        cfg_name = args.config or (
+            "small" if _jax.devices()[0].platform == "tpu" else "nano")
+        run_paged_ab(args, np, cfg_name, f"gpt_{cfg_name}")
+        return
 
     import ray_tpu as rt
     from ray_tpu import serve
@@ -855,6 +884,177 @@ def run_continuous_ab(args, serve, np, cfg_name, model):
                                 / max(co["ttft_p50_ms"], 1e-9), 2),
         "continuous_wins_ttft": co["ttft_p50_ms"] < st["ttft_p50_ms"],
         "offered_rate_req_s": co["offered_rate_req_s"],
+        "smoke": bool(args.smoke),
+    }))
+
+
+def run_paged_ab(args, np, cfg_name, model):
+    """ISSUE 6 acceptance A/B: flat slot pool vs paged pool on the SAME
+    KV-byte budget (``n_pages * page_size == flat_slots * max_len``
+    cache positions), identical burst workload with a shared system
+    prompt; then a shared-prefix TTFT probe (prefix-cached admission vs
+    full prefill). Drives the engines directly — no serve stack — so
+    the rows measure pool architecture, not transport."""
+    import threading as _th
+
+    import jax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import DecodeEngine
+
+    cfg = gpt.CONFIGS[cfg_name]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    ps = args.page_size
+    chunk = 8
+    flat_slots = 4 if args.smoke else max(4, args.slots // 2)
+    max_len = 96 if args.smoke else min(192, cfg.max_seq)
+    if ps < 1 or max_len % ps:
+        sys.exit(f"--page-size {ps} must be a positive divisor of "
+                 f"max_len={max_len} so the flat and paged pools can "
+                 f"hold the same KV bytes (try one of "
+                 f"{[d for d in (4, 8, 12, 16, 24, 32, 48) if max_len % d == 0]})")
+    lanes = 3 * flat_slots            # paged lane count, same KV bytes
+    n_pages = flat_slots * (max_len // ps)
+    sys_len = 16 if args.smoke else 64
+    tail_len = 8
+    plen = sys_len + tail_len
+    mix = [8, 16, 24] if args.smoke else [16, 32, 48]
+    n_req = 4 * flat_slots if args.smoke else 6 * flat_slots
+    buckets = tuple(b for b in (8, 16, 32, 64, 128)
+                    if b <= max_len and b >= tail_len) or (max_len,)
+    buckets = tuple(sorted(set(buckets) | {
+        next(b for b in (8, 16, 32, 64, 128, max_len) if b >= plen)}))
+    kv_positions = flat_slots * max_len
+    assert n_pages * ps == kv_positions, "budgets must match"
+
+    rng = np.random.default_rng(42)
+    sysp = rng.integers(0, cfg.vocab_size, (sys_len,)).astype(np.int32)
+
+    def mk_prompt(rid):
+        tail = np.random.default_rng(500 + rid).integers(
+            0, cfg.vocab_size, (tail_len,)).astype(np.int32)
+        return np.concatenate([sysp, tail])
+
+    max_news = np.random.default_rng(7).choice(mix, size=n_req)
+
+    def build(paged):
+        if paged:
+            return DecodeEngine(
+                params, cfg, slots=lanes, chunk=chunk, max_len=max_len,
+                prompt_buckets=buckets, paged=True, page_size=ps,
+                n_pages=n_pages, prefix_cache=True,
+                deployment="paged_bench")
+        return DecodeEngine(params, cfg, slots=flat_slots, chunk=chunk,
+                            max_len=max_len, prompt_buckets=buckets,
+                            deployment="flat_bench")
+
+    def drive(eng):
+        """Saturating burst: all n_req requests queued at t=0."""
+        ttfts = [None] * n_req
+        comps = [None] * n_req
+        toks = [0] * n_req
+
+        def one(i):
+            t0 = time.perf_counter()
+            first = None
+            n = 0
+            for s in eng.stream(mk_prompt(i), int(max_news[i]), seed=i):
+                if first is None:
+                    first = time.perf_counter() - t0
+                n += s.shape[0]
+            ttfts[i] = first
+            comps[i] = time.perf_counter() - t0
+            toks[i] = n
+
+        threads = [_th.Thread(target=one, args=(i,))
+                   for i in range(n_req)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        bad = [(i, toks[i], int(max_news[i]))
+               for i in range(n_req) if toks[i] != max_news[i]]
+        assert not bad, f"short streams (i, got, want): {bad}"
+        return ttfts, comps, wall, sum(toks)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(int(len(xs) * q), len(xs) - 1)]
+
+    def ttft_probe(eng, repeats=7):
+        """Median TTFT of a lone request on an idle engine (the paged
+        engine's prefix cache is warm by now: admission is a page-table
+        copy + tail-bucket prefill instead of a full-prompt prefill)."""
+        outs = []
+        for r in range(repeats):
+            t0 = time.perf_counter()
+            it = eng.stream(mk_prompt(1000 + r), 2, seed=r)
+            next(iter(it))
+            outs.append(time.perf_counter() - t0)
+            list(it)
+        return pct(outs, 0.5)
+
+    results = {}
+    for mode in ("flat", "paged"):
+        eng = build(mode == "paged")
+        try:
+            # Warm every compile path (and, paged, the prefix cache)
+            # before the clock starts.
+            for r in range(2):
+                list(eng.stream(mk_prompt(0), max(mix), seed=0))
+            ttfts, comps, wall, total = drive(eng)
+            st = eng.stats()
+            probe_ms = ttft_probe(eng) * 1000
+            row = {
+                "metric": f"serve_{model}_paged_{mode}_mode",
+                "value": round(total / wall, 1), "unit": "tokens/s",
+                "ttft_p50_ms": round(pct(ttfts, 0.5) * 1000, 2),
+                "ttft_p95_ms": round(pct(ttfts, 0.95) * 1000, 2),
+                "completion_p50_ms": round(pct(comps, 0.5) * 1000, 2),
+                "completion_p95_ms": round(pct(comps, 0.95) * 1000, 2),
+                "lone_ttft_p50_ms": round(probe_ms, 2),
+                "slots_configured": st["slots"],
+                "peak_concurrent_slots": st["peak_active"],
+                "avg_occupancy": round(st["avg_occupancy"], 3),
+                "dispatches_per_token": round(
+                    st["dispatches_per_token"], 4),
+                "kv_budget_positions": kv_positions,
+                "requests": n_req, "chunk": chunk,
+                "output_len_mix": [int(m) for m in mix],
+                "prompt_len": plen, "shared_prefix_len": sys_len,
+            }
+            if mode == "paged":
+                row.update({
+                    "page_size": ps, "n_pages": n_pages,
+                    "prefix_hits": st["prefix_hits"],
+                    "prefix_tokens_reused": st["prefix_tokens_reused"],
+                    "cow_copies": st["cow_copies"],
+                    "lane_parks": st["lane_parks"],
+                    "admissions_deferred": st["admissions_deferred"],
+                    "preempted": st["preempted"],
+                    "pages_free": st["pages_free"],
+                })
+            print(json.dumps(row))
+            results[mode] = row
+        finally:
+            eng.shutdown()
+    fl, pg = results["flat"], results["paged"]
+    print(json.dumps({
+        "metric": f"serve_{model}_paged_ab",
+        "value": round(pg["peak_concurrent_slots"]
+                       / max(fl["slots_configured"], 1), 2),
+        "unit": "x_concurrent_slots_equal_kv_bytes",
+        "tok_s_ratio": round(pg["value"] / max(fl["value"], 1e-9), 2),
+        "ttft_p50_ratio": round(fl["ttft_p50_ms"]
+                                / max(pg["ttft_p50_ms"], 1e-9), 2),
+        "prefix_hit_ttft_ms": pg["lone_ttft_p50_ms"],
+        "full_prefill_ttft_ms": fl["lone_ttft_p50_ms"],
+        "prefix_ttft_speedup": round(
+            fl["lone_ttft_p50_ms"]
+            / max(pg["lone_ttft_p50_ms"], 1e-9), 2),
+        "kv_budget_positions": kv_positions,
         "smoke": bool(args.smoke),
     }))
 

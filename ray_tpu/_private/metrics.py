@@ -421,6 +421,22 @@ def serve_metrics() -> dict:
             engine_tokens=Counter(
                 "serve_engine_tokens_total",
                 "Tokens emitted to engine stream lanes"),
+            # ---- paged KV pool (ISSUE 6). Set/incremented on the
+            # engine driver thread as the allocator hands pages out.
+            engine_pages_free=Gauge(
+                "serve_engine_pages_free",
+                "KV pages on the paged engine's free list"),
+            engine_pages_used=Gauge(
+                "serve_engine_pages_used",
+                "KV pages held by live lanes or the prefix cache"),
+            engine_prefix_hits=Counter(
+                "serve_engine_prefix_hits_total",
+                "Admissions that mapped a cached prompt prefix instead "
+                "of prefilling it"),
+            engine_cow_copies=Counter(
+                "serve_engine_cow_copies_total",
+                "Copy-on-write page forks (cached prefix ended "
+                "mid-page)"),
         )
         return _serve
 

@@ -63,6 +63,39 @@ K/V land beyond ``pos`` and every decode step overwrites position
 ``pos`` BEFORE attention reads it, so pad keys are never attended —
 the engine's greedy output is asserted token-identical to
 :func:`generate_chunked` (see ``tests/test_serve_engine.py``).
+
+Paged-pool primitives (ISSUE 6): the flat slot pool reserves
+``max_len`` KV per slot up front, so slot count is capped by the
+worst-case sequence. The paged twin replaces the per-slot reservation
+with a pool of fixed-size pages ``[L, n_pages, page_size, H, hd]``
+(:func:`init_paged_cache`) plus a per-slot **page table** — a
+``[max_pages]`` int32 row of physical page indices, padded with
+:data:`PT_SENTINEL`. The page table is *traced data*, never a shape:
+:func:`prefill_into_slot_paged` and :func:`_slot_decode_step_paged`
+gather K/V through it (``pool[clip(pt)]`` → a virtual
+``[max_pages * page_size]`` sequence; sentinel entries clamp to an
+arbitrary real page whose garbage the ``<= pos`` mask hides) and write
+new tokens by scatter at ``(pt[pos // page_size], pos % page_size)``
+with out-of-bounds **drop** semantics — a sentinel write target (a
+position the host never mapped a page for) is silently discarded, never
+clamped into another slot's page. The compiled-program set therefore
+stays exactly as flat: one prefill program per (suffix) prompt bucket +
+one chunk program, for ANY page-table contents.
+
+Shared-prefix reuse rides the same machinery: a prompt whose prefix is
+already resident (the engine's prefix cache) maps the cached pages into
+its page table and prefills only the **suffix** — ``hist_len`` is a
+traced scalar, the suffix attends over history K/V read through the
+page table, and the one copy-on-write fork a lane may need (when the
+cached prefix ends mid-page) is fused into the same prefill program as
+a masked page copy, so prefix hits add ZERO compiled programs.
+
+Token identity with the flat pool holds bitwise on CPU: the gathered
+virtual sequence contains the same K/V values at the same virtual
+positions, extra masked positions contribute exact zeros to the softmax
+(``exp(-1e30 - max)`` underflows to 0.0), and the per-slot PRNG lanes
+are untouched — asserted at temperature 0 AND seeded temperature > 0 in
+``tests/test_serve_engine_paged.py``.
 """
 from __future__ import annotations
 
@@ -550,6 +583,251 @@ def jit_decode_chunk_slots(cfg: GPTConfig, k: int,
     recompile-guard test). The pool cache is donated (see
     :func:`jit_prefill_into_slot`)."""
     return jax.jit(functools.partial(decode_chunk_slots, cfg=cfg, k=k,
+                                     temperature=temperature,
+                                     eos_token=eos_token),
+                   donate_argnums=(1,))
+
+
+# -------------------------------------------------------------- paged pool
+#: Page-table padding value. Positive and far beyond any real pool size,
+#: so a sentinel is out-of-bounds for scatter (write DROPPED, never
+#: clamped into someone else's page) while reads clip it to a real page
+#: whose garbage the attention mask hides. Never use a negative
+#: sentinel: traced negative indices WRAP in jnp indexing.
+PT_SENTINEL = 2 ** 30
+
+
+def init_paged_cache(cfg: GPTConfig, slots: int, n_pages: int,
+                     page_size: int) -> Cache:
+    """Paged KV pool for the continuous-batching engine: physical
+    storage is page-granular (``[L, n_pages, page_size, H, hd]``), a
+    slot's sequence lives wherever its page table points. ``pos`` stays
+    per-slot ``[slots]`` (virtual position, exactly as flat)."""
+    shape = (cfg.n_layer, n_pages, page_size, cfg.n_head, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def prefill_into_slot_paged(params: Params, cache: Cache,
+                            tokens: jax.Array, length: jax.Array,
+                            hist_len: jax.Array, pt_row: jax.Array,
+                            cow_src: jax.Array, slot: jax.Array,
+                            rng: jax.Array, *, cfg: GPTConfig,
+                            page_size: int, temperature: float = 0.0
+                            ) -> Tuple[jax.Array, Cache, jax.Array]:
+    """Prefill one prompt **suffix** into its page-table pages, fused
+    with an optional copy-on-write fork and the first-token sample.
+
+    ``tokens`` is ``[1, S_bucket]`` — the prompt MINUS the cached
+    prefix, right-padded to its bucket (the bucket is the only shape XLA
+    sees; ``hist_len`` and ``length`` are traced, so a prefix hit of any
+    depth reuses the suffix-bucket's program). ``pt_row`` ``[max_pages]``
+    maps the slot's virtual pages (shared-prefix pages first, then fresh
+    ones; :data:`PT_SENTINEL` beyond). ``cow_src`` is the physical page
+    to fork into ``pt_row[hist_len // page_size]`` before writing (a
+    cached prefix that ends mid-page; pass :data:`PT_SENTINEL` for
+    none): the copy is a masked in-program page copy, so COW costs zero
+    extra compiled programs.
+
+    Suffix tokens sit at absolute positions ``hist_len + i`` and attend
+    over (a) the history read through the page table, valid where the
+    virtual position ``< hist_len``, and (b) themselves, causally. With
+    ``hist_len == 0`` the history lanes are fully masked and the math
+    reduces bitwise to :func:`prefill_into_slot` (masked keys contribute
+    exact zeros). Returns ``(first_token, cache', rng')``; pad-position
+    writes are dropped, not written."""
+    B, S = tokens.shape
+    L = cfg.n_layer
+    H, hd = cfg.n_head, cfg.head_dim
+    n_pages = cache["k"].shape[1]
+    ps = page_size
+    max_pages = pt_row.shape[0]
+    V = max_pages * ps
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    positions = hist_len + jnp.arange(S)
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
+    x = x + jnp.take(params["pos_embed"],
+                     jnp.clip(positions, 0,
+                              params["pos_embed"].shape[0] - 1),
+                     axis=0).astype(cfg.dtype)[None]
+
+    # COW fork first: dst (the page holding position hist_len) takes
+    # src's contents across every layer; no-fork runs the same copy at
+    # an out-of-bounds dst and drops it.
+    dst = pt_row[jnp.clip(hist_len // ps, 0, max_pages - 1)]
+    dst_w = jnp.where(cow_src < n_pages, dst, jnp.int32(PT_SENTINEL))
+    src_c = jnp.clip(cow_src, 0, n_pages - 1)
+    kpool = cache["k"].at[:, dst_w].set(cache["k"][:, src_c],
+                                        mode="drop")
+    vpool = cache["v"].at[:, dst_w].set(cache["v"][:, src_c],
+                                        mode="drop")
+
+    # History view through the page table: [L, V, H, hd] in virtual
+    # order. Sentinel entries clip to page n_pages-1; their positions
+    # are >= hist_len and masked below.
+    ptc = jnp.clip(pt_row, 0, n_pages - 1)
+    hk = kpool[:, ptc].reshape(L, V, H, hd)
+    hv = vpool[:, ptc].reshape(L, V, H, hd)
+    hist_valid = (jnp.arange(V) < hist_len)[None, None, None, :]
+    self_mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+
+    def body(carry, layer):
+        x = carry
+        p, hk_l, hv_l = layer
+        q, k, v = _block_kv(x, p, cfg)          # [1, S, H, hd]
+        lg_h = jnp.einsum("bqhd,khd->bhqk", q, hk_l,
+                          preferred_element_type=jnp.float32) * scale
+        lg_h = jnp.where(hist_valid, lg_h, -1e30)
+        lg_s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                          preferred_element_type=jnp.float32) * scale
+        lg_s = jnp.where(self_mask, lg_s, -1e30)
+        logits = jnp.concatenate([lg_h, lg_s], axis=-1)  # [1,H,S,V+S]
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        vv = jnp.concatenate([hv_l[None].astype(q.dtype), v], axis=1)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vv,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        return x, (k[0], v[0])
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["block"], hk, hv))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    x_last = lax.dynamic_slice(x, (0, length - 1, 0), (1, 1, cfg.d_model))
+    logits = _project_vocab(x_last, params["embed"]["kernel"], cfg)
+    token, rng = _sample(logits[:, 0], temperature, rng)
+
+    # Suffix K/V writes, scattered page-wise: token i lands at virtual
+    # position hist_len + i → (pt_row[vpos // ps], vpos % ps). Pad
+    # positions (i >= length) target the sentinel and are dropped.
+    wpos = hist_len + jnp.arange(S)
+    vp = wpos // ps
+    page_idx = pt_row[jnp.clip(vp, 0, max_pages - 1)]
+    ok = (jnp.arange(S) < length) & (vp < max_pages)
+    page_w = jnp.where(ok, page_idx, jnp.int32(PT_SENTINEL))
+    off = wpos % ps
+    kpool = kpool.at[:, page_w, off].set(k_new, mode="drop")
+    vpool = vpool.at[:, page_w, off].set(v_new, mode="drop")
+    pos = lax.dynamic_update_slice(
+        cache["pos"], jnp.reshape(hist_len + length, (1,)), (slot,))
+    return token[0], {"k": kpool, "v": vpool, "pos": pos}, rng
+
+
+def _slot_decode_step_paged(params: Params, cache: Cache,
+                            token: jax.Array, active: jax.Array,
+                            pt: jax.Array, cfg: GPTConfig,
+                            page_size: int) -> Tuple[jax.Array, Cache]:
+    """Paged twin of :func:`_slot_decode_step`: each active slot writes
+    its new K/V at ``(pt[b, pos[b] // ps], pos[b] % ps)`` (scatter with
+    drop semantics — an unmapped write target is discarded, never
+    clamped into another slot's page) and attends over its virtual
+    sequence gathered through its page-table row, valid ``<= pos[b]``.
+    Inactive slots neither write nor advance."""
+    B = token.shape[0]
+    H, hd = cfg.n_head, cfg.head_dim
+    n_pages = cache["k"].shape[1]
+    ps = page_size
+    max_pages = pt.shape[1]
+    V = max_pages * ps
+    pos = cache["pos"]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    x = params["embed"]["kernel"].astype(cfg.dtype)[token][:, None]
+    x = x + jnp.take(params["pos_embed"],
+                     jnp.clip(pos, 0, params["pos_embed"].shape[0] - 1),
+                     axis=0).astype(cfg.dtype)[:, None]
+    ar = jnp.arange(V)
+    valid = (ar[None, :] <= pos[:, None])[:, None, None, :]
+    vp = pos // ps
+    page_idx = jnp.take_along_axis(
+        pt, jnp.clip(vp, 0, max_pages - 1)[:, None], axis=1)[:, 0]
+    page_w = jnp.where(active & (vp < max_pages), page_idx,
+                       jnp.int32(PT_SENTINEL))
+    off = pos % ps
+    ptc = jnp.clip(pt, 0, n_pages - 1)       # [B, max_pages]
+
+    def body(carry, layer):
+        x = carry
+        p, kc, vc = layer                    # [n_pages, ps, H, hd]
+        q, k, v = _block_kv(x, p, cfg)       # [B, 1, H, hd]
+        kc = kc.at[page_w, off].set(k[:, 0], mode="drop")
+        vc = vc.at[page_w, off].set(v[:, 0], mode="drop")
+        hk = kc[ptc].reshape(B, V, H, hd)
+        hv = vc[ptc].reshape(B, V, H, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, hk,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, hv,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, 1, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["block"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = _project_vocab(x, params["embed"]["kernel"], cfg)
+    return logits[:, 0], {"k": k_new, "v": v_new,
+                          "pos": pos + active.astype(jnp.int32)}
+
+
+def decode_chunk_slots_paged(params: Params, cache: Cache,
+                             token: jax.Array, rngs: jax.Array,
+                             active: jax.Array, pt: jax.Array, *,
+                             cfg: GPTConfig, k: int, page_size: int,
+                             temperature: float = 0.0,
+                             eos_token: int = -1):
+    """Paged twin of :func:`decode_chunk_slots`: k fused steps in ONE
+    program with the page table held constant through the chunk (the
+    engine maps pages covering ``pos + k`` before dispatching — a slot
+    that cannot be covered is parked out of ``active`` instead). EOS
+    mask-and-carry and per-slot PRNG lanes are identical to flat."""
+    B = token.shape[0]
+    eos = jnp.asarray(eos_token, jnp.int32)
+    done0 = (active & (token == eos)) if eos_token >= 0 \
+        else jnp.zeros((B,), jnp.bool_)
+
+    def body(carry, _):
+        cache, tok, done, keys = carry
+        logits, cache = _slot_decode_step_paged(params, cache, tok,
+                                                active, pt, cfg,
+                                                page_size)
+        nxt, keys = _sample_slots(logits, temperature, keys)
+        if eos_token >= 0:
+            nxt = jnp.where(done, eos, nxt)
+            done = done | (active & (nxt == eos))
+        return (cache, nxt, done, keys), nxt
+
+    (cache, _, done, rngs), toks = lax.scan(
+        body, (cache, token, done0, rngs), None, length=k)
+    return jnp.moveaxis(toks, 0, 1), cache, done, rngs
+
+
+@functools.lru_cache(maxsize=64)
+def jit_prefill_into_slot_paged(cfg: GPTConfig, page_size: int,
+                                temperature: float = 0.0):
+    """Jitted :func:`prefill_into_slot_paged`; one compiled program per
+    SUFFIX bucket — prefix-hit depth (``hist_len``), page-table
+    contents, and COW source are all traced, so shared-prefix admission
+    never retraces. Pool donated as in :func:`jit_prefill_into_slot`."""
+    return jax.jit(functools.partial(prefill_into_slot_paged, cfg=cfg,
+                                     page_size=page_size,
+                                     temperature=temperature),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=64)
+def jit_decode_chunk_slots_paged(cfg: GPTConfig, k: int, page_size: int,
+                                 temperature: float = 0.0,
+                                 eos_token: int = -1):
+    """Jitted :func:`decode_chunk_slots_paged`: ONE program per (pool
+    shape, k, page_size) — the page table is data. Pool donated."""
+    return jax.jit(functools.partial(decode_chunk_slots_paged, cfg=cfg,
+                                     k=k, page_size=page_size,
                                      temperature=temperature,
                                      eos_token=eos_token),
                    donate_argnums=(1,))

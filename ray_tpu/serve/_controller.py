@@ -191,6 +191,10 @@ class ServeController:
                                           {"expired": 0, "overloaded": 0,
                                            "total": 0}),
                     }
+                    # Paged decode-engine visibility (pages free/used,
+                    # prefix hits, COW forks), same health-pass ride.
+                    if d.get("engine"):
+                        deps[dname]["engine"] = dict(d["engine"])
                 apps[name] = {"route_prefix": app["route_prefix"],
                               "ingress": app["ingress"],
                               "deployments": deps}
@@ -330,6 +334,9 @@ class ServeController:
         # Live-replica lifecycle totals (expired / overloaded / served),
         # piggybacked on the health pass and surfaced via status().
         life = {"expired": 0, "overloaded": 0, "total": 0}
+        # Engine page/prefix totals (paged decode engines only),
+        # summed across replicas, same piggyback.
+        engine: dict = {}
         for rid, ref, mref in probes:
             try:
                 ok = rt.get(ref, timeout=5)
@@ -348,10 +355,22 @@ class ServeController:
                 life["expired"] += int(m.get("expired", 0))
                 life["overloaded"] += int(m.get("overloaded", 0))
                 life["total"] += int(m.get("total", 0))
+                for est in m.get("engines") or []:
+                    for key in ("pages_free", "pages_used",
+                                "prefix_hits", "cow_copies",
+                                "admissions_deferred", "lane_parks",
+                                "preempted", "prefix_tokens_reused",
+                                "active_slots", "slots"):
+                        if key in est:
+                            engine[key] = engine.get(key, 0) + est[key]
+                    engine["paged"] = engine.get("paged", False) \
+                        or bool(est.get("paged"))
             except Exception:  # noqa: BLE001 - totals dip this round
                 pass
         if probes:
             d["lifecycle"] = life
+            if engine:
+                d["engine"] = engine
         if dead:
             with self._lock:
                 for rid in dead:
@@ -465,7 +484,8 @@ class ServeController:
         # the authoritative one.
         handle = actor_cls.remote(app_name, dname, rid, d["payload"],
                                   cfg.user_config,
-                                  cfg.max_ongoing_requests)
+                                  cfg.max_ongoing_requests,
+                                  getattr(cfg, "engine_config", None))
         return rid, handle
 
     # ------------------------------------------------------------- proxies

@@ -43,7 +43,8 @@ class Replica:
 
     def __init__(self, app_name: str, deployment_name: str, replica_id: str,
                  payload: bytes, user_config: Any = None,
-                 max_ongoing_requests: int = 0):
+                 max_ongoing_requests: int = 0,
+                 engine_config: Optional[dict] = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.replica_id = replica_id
@@ -54,6 +55,8 @@ class Replica:
             self._user = callable_def(*init_args, **init_kwargs)
         else:
             self._user = callable_def  # plain function deployment
+        if engine_config:
+            self._apply_engine_config(engine_config)
         self._lock = threading.Lock()
         self._ongoing = 0
         self._total = 0
@@ -333,13 +336,36 @@ class Replica:
             yield out
 
     # ---------------------------------------------------------- control plane
+    def _apply_engine_config(self, engine_config: dict):
+        """Push the deployment schema's ``engine:`` block (paged KV
+        knobs) into every DecodeEngine the user callable constructed —
+        applied right after ``__init__``, before any traffic, which is
+        the only window an engine may be repaged in."""
+        from .engine import DecodeEngine
+
+        for v in vars(self._user).values() \
+                if hasattr(self._user, "__dict__") else []:
+            if isinstance(v, DecodeEngine):
+                v.ensure_paging(**engine_config)
+
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:
-            return {"replica_id": self.replica_id, "ongoing": self._ongoing,
-                    "total": self._total,
-                    "expired": self._expired,
-                    "overloaded": self._overloaded,
-                    "uptime": time.time() - self._start_time}
+            out = {"replica_id": self.replica_id, "ongoing": self._ongoing,
+                   "total": self._total,
+                   "expired": self._expired,
+                   "overloaded": self._overloaded,
+                   "uptime": time.time() - self._start_time}
+        try:
+            from .engine import DecodeEngine
+
+            engines = [v for v in vars(self._user).values()
+                       if isinstance(v, DecodeEngine)] \
+                if hasattr(self._user, "__dict__") else []
+            if engines:
+                out["engines"] = [e.stats() for e in engines]
+        except Exception:  # noqa: BLE001 - metrics stay useful without it
+            pass
+        return out
 
     def set_fault_injection(self, latency_s: float = 0.0,
                             error_rate: float = 0.0) -> bool:

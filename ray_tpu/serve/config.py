@@ -52,6 +52,12 @@ class DeploymentConfig:
     max_queued_requests: int = 64
     autoscaling_config: Optional[AutoscalingConfig] = None
     user_config: Any = None
+    #: Paged-KV engine knobs (``page_size``, ``prefix_cache``,
+    #: ``n_pages``), applied by the replica to every
+    #: :class:`~ray_tpu.serve.engine.DecodeEngine` the user callable
+    #: constructs — the declarative twin of
+    #: ``@serve.batch(continuous=True, page_size=...)``.
+    engine_config: Optional[Dict[str, Any]] = None
     health_check_period_s: float = 2.0
     graceful_shutdown_timeout_s: float = 5.0
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)

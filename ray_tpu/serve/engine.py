@@ -39,14 +39,50 @@ Results stream back through the same :class:`~.batching._StreamLane`
 queues the batched streaming path uses, so replicas, handles, and the
 HTTP proxy need no new transport: ``engine.submit(...)`` returns a lane,
 ``engine.stream(...)`` an iterator of per-chunk ``np.int32[j]`` slices.
+
+**Paged KV cache + shared-prefix reuse** (ISSUE 6 tentpole,
+``paged=True``): the flat pool reserves ``max_len`` KV per slot up
+front, so concurrency is capped by the WORST-CASE sequence even when
+every live request is short. Paged mode splits the same byte budget
+into fixed-size pages (``[L, n_pages, page_size, H, hd]``) handed out
+by a host-side allocator:
+
+- Each slot carries a page-table row (``[max_pages]`` int32, sentinel
+  padded) that the device programs gather/scatter through — the table
+  is traced DATA, so any mapping runs the same compiled programs.
+- Pages are allocated **on advance**: a slot takes its next page only
+  when ``pos`` is about to cross a page boundary (checked at chunk
+  boundaries, where admission already happens). Out of pages is a
+  *defined* backpressure path: admission defers (FIFO kept, and freed
+  pages flow to parked lanes BEFORE new admissions) and a running slot
+  parks out of the dispatch mask until a page frees — never a silent
+  clamped write into someone else's page. If EVERY occupied slot is
+  parked (allocation deadlock), the youngest lane is preempted **by
+  recompute**: its pages free, its request requeues at the head, and on
+  re-admission the deterministic per-request PRNG lane replays the
+  exact same tokens with the already-delivered prefix suppressed — the
+  consumer sees a stall, never an error or a duplicate token.
+- A **prefix cache** (``prefix_cache=True``) hashes prompt prefixes at
+  page granularity: a request whose prompt prefix is already resident
+  maps the cached pages into its table (refcounted), prefills only the
+  suffix, and — when the cached prefix ends mid-page — forks that one
+  page copy-on-write inside the same prefill program. TTFT for a
+  cached system prompt becomes a page-table copy plus a short-suffix
+  prefill. Cache entries are evicted LRU when the allocator runs dry.
+
+Flat slots remain the default; paged engines are asserted
+token-identical to flat (temp 0 AND seeded temp > 0) in
+``tests/test_serve_engine_paged.py``.
 """
 from __future__ import annotations
 
+import collections
+import hashlib
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -75,6 +111,10 @@ class _EngineRequest:
     trace_ctx: Optional[dict]
     seed: int
     enq_t: float
+    #: Tokens already delivered before a recompute preemption: the
+    #: replay regenerates them (identical — the per-request PRNG lane
+    #: is deterministic) and suppresses this many from the stream.
+    skip: int = 0
 
 
 @dataclass
@@ -85,12 +125,156 @@ class _Slot:
     remaining: int                # tokens still owed to the caller
     deadline_s: Optional[float]
     trace_ctx: Optional[dict]
-    emitted: int = 1              # the prefill-derived token
+    req: Optional[_EngineRequest] = None   # for recompute preemption
+    emitted: int = 1              # tokens DELIVERED to the lane
     admitted_t: float = field(default_factory=time.time)
+    # -------- paged-mode bookkeeping (empty/ignored for flat pools)
+    pos: int = 0                  # virtual write position (mirrors device)
+    pages: List[int] = field(default_factory=list)
+    parked: bool = False          # out of pages: excluded from dispatch
+    skip: int = 0                 # replay tokens left to suppress
 
 
 class EngineShutdownError(RuntimeError):
     """The engine stopped while this request was queued or decoding."""
+
+
+class _PagePool:
+    """Host-side page allocator: a free list plus per-page refcounts.
+    Shared-prefix pages are mapped into several page tables at once (and
+    pinned by prefix-cache entries); a page returns to the free list
+    when its LAST reference drops. Driver-thread only — no locking."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = n_pages
+        self.refs = [0] * n_pages
+        # Pop from the end → low page indices hand out first (stable
+        # layouts in tests/benchmarks).
+        self.free = list(range(n_pages - 1, -1, -1))
+
+    def available(self) -> int:
+        return len(self.free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages at refcount 1, or None (caller defers/parks)."""
+        if n > len(self.free):
+            return None
+        out = [self.free.pop() for _ in range(n)]
+        for p in out:
+            self.refs[p] = 1
+        return out
+
+    def ref(self, pages: Sequence[int]):
+        for p in pages:
+            self.refs[p] += 1
+
+    def unref(self, pages: Sequence[int]):
+        for p in pages:
+            self.refs[p] -= 1
+            assert self.refs[p] >= 0, f"page {p} over-freed"
+            if self.refs[p] == 0:
+                self.free.append(p)
+
+
+class _PrefixCache:
+    """Prompt-prefix → resident-pages map, page-granular with an
+    exact-length tail entry.
+
+    Keys are content hashes of the token prefix at every page boundary
+    plus the full prompt length; entries pin their pages with a pool
+    reference so a cached prefix survives the lane that produced it.
+    Lookup probes the query's page boundaries longest-first (plus its
+    exact length), verifies tokens byte-for-byte (hashes only index),
+    and returns ``(hist_len, pages)`` — ``hist_len`` capped one token
+    short of the query so the suffix prefill always has a token to
+    sample from. Page-aligned hits share pages directly; an exact-length
+    hit ends mid-page and the engine forks that page copy-on-write.
+    LRU: entries are evicted (unpinning their pages) when the allocator
+    runs dry."""
+
+    def __init__(self, pool: _PagePool, page_size: int):
+        self._pool = pool
+        self._ps = page_size
+        # key -> (n_tokens, prefix_bytes, pages tuple)
+        self._entries: "collections.OrderedDict[bytes, Tuple[int, bytes, Tuple[int, ...]]]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    @staticmethod
+    def _key(tokens: np.ndarray, n: int) -> bytes:
+        return hashlib.sha1(
+            np.ascontiguousarray(tokens[:n]).tobytes()).digest()
+
+    def lookup(self, tokens: np.ndarray) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``tokens`` (< len(tokens)); returns
+        ``(hist_len, pages_covering_hist)`` or ``(0, [])``."""
+        P = len(tokens)
+        probes = sorted({n for n in
+                         list(range(self._ps, P + 1, self._ps)) + [P]},
+                        reverse=True)
+        for n in probes:
+            ent = self._entries.get(self._key(tokens, n))
+            if ent is None:
+                continue
+            n_cached, raw, pages = ent
+            if n_cached != n or raw != tokens[:n].tobytes():
+                continue                     # hash collision: skip
+            hist = min(n, P - 1)
+            if hist <= 0:
+                continue
+            self._entries.move_to_end(self._key(tokens, n))
+            self.hits += 1
+            n_cover = -(-hist // self._ps)   # ceil
+            return hist, list(pages[:n_cover])
+        self.misses += 1
+        return 0, []
+
+    def insert(self, tokens: np.ndarray, pages: Sequence[int]):
+        """Register a freshly prefilled prompt's pages: one entry per
+        covered page boundary plus the exact prompt length. Existing
+        keys just refresh their LRU position."""
+        P = len(tokens)
+        bounds = list(range(self._ps, P + 1, self._ps))
+        if P not in bounds:
+            bounds.append(P)
+        for n in bounds:
+            key = self._key(tokens, n)
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            n_cover = -(-n // self._ps)
+            ent_pages = tuple(pages[:n_cover])
+            self._pool.ref(ent_pages)
+            self._entries[key] = (n, tokens[:n].tobytes(), ent_pages)
+
+    def evict_lru(self) -> bool:
+        """Drop the oldest entry whose eviction actually FREES a page
+        (some page at refcount 1 — held by the cache alone). False when
+        no eviction can free anything: entries pinned by live lanes stay
+        resident and keep serving hits rather than being wiped for an
+        allocation that would fail anyway. Liveness: with no lane pins,
+        a prompt's maximal entry holds its tail page exclusively, so a
+        non-empty cache always has an evictable entry."""
+        for key, (_n, _raw, pages) in self._entries.items():
+            if any(self._pool.refs[p] == 1 for p in pages):
+                del self._entries[key]
+                self._pool.unref(pages)
+                self.evictions += 1
+                return True
+        return False
+
+    def clear(self):
+        """Unpin and drop EVERY entry, shared or not (cache teardown —
+        eviction's frees-a-page filter does not apply)."""
+        while self._entries:
+            _, (_n, _raw, pages) = self._entries.popitem(last=False)
+            self._pool.unref(pages)
+            self.evictions += 1
 
 
 class DecodeEngine:
@@ -116,7 +300,9 @@ class DecodeEngine:
                  max_len: int = 0, temperature: float = 0.0,
                  eos_token: int = -1,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 deployment: str = "", auto_start: bool = True):
+                 deployment: str = "", auto_start: bool = True,
+                 paged: bool = False, page_size: int = 16,
+                 n_pages: int = 0, prefix_cache: bool = True):
         from ..models import gpt_decode
 
         self.params = params
@@ -142,13 +328,7 @@ class DecodeEngine:
                 f"length {self.max_len}")
         self.prompt_buckets = buckets
         self._gd = gpt_decode
-        self._prefill = gpt_decode.jit_prefill_into_slot(
-            cfg, self.temperature)
-        self._step = gpt_decode.jit_decode_chunk_slots(
-            cfg, self.chunk, self.temperature, self.eos_token)
-        # THE persistent pool: allocated once, recycled forever.
-        self._cache = gpt_decode.init_slot_cache(cfg, self.slots,
-                                                 self.max_len)
+        self._build_pool(paged, page_size, n_pages, prefix_cache)
         # Per-slot host state; index i mirrors pool row i. ``_token`` /
         # ``_rngs`` are the host copies uploaded with each dispatch
         # (tiny against the chunk compute; keeping them host-side avoids
@@ -157,19 +337,122 @@ class DecodeEngine:
         self._token = np.zeros((self.slots,), np.int32)
         self._rngs = np.zeros((self.slots, 2), np.uint32)
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        # Driver-local FIFO fed from the submit queue; the head defers
+        # in place when paged admission runs out of pages, preserving
+        # arrival order across the backpressure boundary.
+        self._pending: "collections.deque[_EngineRequest]" = \
+            collections.deque()
         # Guards the put-vs-final-drain race: once _fail_all flips
         # _draining under this lock, no new submission can land in a
         # queue nobody will ever read again.
         self._admit_lock = threading.Lock()
         self._draining = False
+        self._fail_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._stats = {"admitted": 0, "completed": 0, "expired": 0,
                        "abandoned": 0, "prefills": 0, "dispatches": 0,
-                       "tokens": 0, "occupancy_sum": 0.0}
+                       "tokens": 0, "occupancy_sum": 0.0,
+                       "peak_active": 0, "prefix_hits": 0,
+                       "prefix_tokens_reused": 0, "cow_copies": 0,
+                       "admissions_deferred": 0, "lane_parks": 0,
+                       "preempted": 0}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if auto_start:
             self.start()
+
+    def _build_pool(self, paged: bool, page_size: int, n_pages: int,
+                    prefix_cache: bool):
+        """Allocate THE persistent pool (flat or paged) and bind the
+        matching jitted programs. Called once at construction, and again
+        only by :meth:`ensure_paging` on a never-used engine."""
+        gpt_decode = self._gd
+        cfg = self.cfg
+        self.paged = bool(paged)
+        if not self.paged:
+            self.page_size = 0
+            self.n_pages = 0
+            self.max_pages = 0
+            self._pool = None
+            self._prefix = None
+            self._pt = None
+            self._prefill = gpt_decode.jit_prefill_into_slot(
+                cfg, self.temperature)
+            self._step = gpt_decode.jit_decode_chunk_slots(
+                cfg, self.chunk, self.temperature, self.eos_token)
+            self._cache = gpt_decode.init_slot_cache(cfg, self.slots,
+                                                     self.max_len)
+            return
+        self.page_size = int(page_size)
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.max_pages = -(-self.max_len // self.page_size)   # ceil
+        # Default budget: the SAME KV bytes as the flat pool
+        # ([slots, max_len] worth of positions), re-cut into pages.
+        self.n_pages = int(n_pages) or self.slots * self.max_pages
+        if self.n_pages < self.max_pages:
+            raise ValueError(
+                f"n_pages {self.n_pages} cannot hold one max_len "
+                f"sequence ({self.max_pages} pages of {self.page_size})")
+        self._pool = _PagePool(self.n_pages)
+        self._prefix = _PrefixCache(self._pool, self.page_size) \
+            if prefix_cache else None
+        self._pt = np.full((self.slots, self.max_pages),
+                           gpt_decode.PT_SENTINEL, np.int32)
+        self._prefill = gpt_decode.jit_prefill_into_slot_paged(
+            cfg, self.page_size, self.temperature)
+        self._step = gpt_decode.jit_decode_chunk_slots_paged(
+            cfg, self.chunk, self.page_size, self.temperature,
+            self.eos_token)
+        self._cache = gpt_decode.init_paged_cache(
+            cfg, self.slots, self.n_pages, self.page_size)
+
+    def ensure_paging(self, page_size: Optional[int] = None,
+                      prefix_cache: Optional[bool] = None,
+                      n_pages: Optional[int] = None):
+        """Idempotently apply paging knobs from the config plane
+        (``@serve.batch(continuous=True, page_size=..)`` or the
+        deployment schema's ``engine:`` block). A matching engine is a
+        no-op; a mismatched engine is rebuilt IF it has never admitted a
+        request, else this raises — pool shape is load-bearing state,
+        not something to swap under live lanes."""
+        want_ps = int(page_size) if page_size is not None else None
+        if want_ps is not None and want_ps < 1:
+            raise ValueError("page_size must be >= 1")
+        with self._admit_lock:
+            if want_ps is None and not self.paged and (
+                    prefix_cache or n_pages is not None):
+                # Silently no-opping would leave the operator believing
+                # prefix caching / pool sizing is active on a flat pool.
+                raise ValueError(
+                    "prefix_cache/n_pages are paged-pool knobs; this "
+                    "engine is flat — pass page_size to repage it")
+            if want_ps is None and self.paged and n_pages is not None:
+                want_ps = self.page_size   # resize keeps the page size
+            need_rebuild = want_ps is not None and (
+                not self.paged or self.page_size != want_ps or
+                (n_pages is not None and int(n_pages) != self.n_pages))
+            if need_rebuild:
+                with self._stats_lock:
+                    used = self._stats["admitted"]
+                if used or self._queue.qsize() or self._pending or \
+                        any(s is not None for s in self._state):
+                    raise ValueError(
+                        f"cannot repage a live engine (page_size="
+                        f"{self.page_size or None} -> {want_ps}); "
+                        f"construct it paged or apply the config "
+                        f"before traffic")
+                self._build_pool(True, want_ps, int(n_pages or 0),
+                                 prefix_cache if prefix_cache is not None
+                                 else self._prefix is not None)
+            elif prefix_cache is not None and self.paged:
+                if prefix_cache and self._prefix is None:
+                    self._prefix = _PrefixCache(self._pool,
+                                                self.page_size)
+                elif not prefix_cache and self._prefix is not None:
+                    self._prefix.clear()
+                    self._prefix = None
+        return self
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, *,
@@ -192,13 +475,16 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({S}) + max_new ({max_new}) exceeds cache "
                 f"length {self.max_len}")
-        if self._thread is None or not self._thread.is_alive():
-            raise EngineShutdownError("engine is not running")
         lane = _StreamLane()
         if max_new <= 0:
             lane.q.put((_STREAM_END, None))
             return lane
         with self._admit_lock:
+            # _draining (not thread-aliveness) is the admission gate: a
+            # not-yet-started engine (auto_start=False) queues work for
+            # start(), while a shut-down or crashed driver — which
+            # flipped _draining in _fail_all — rejects instead of
+            # accepting submissions nobody will ever read.
             if self._draining:
                 raise EngineShutdownError("engine is not running")
             self._queue.put(_EngineRequest(
@@ -226,22 +512,51 @@ class DecodeEngine:
         self._thread.start()
 
     def shutdown(self, timeout_s: float = 5.0):
-        """Stop the driver; queued and in-flight lanes fail with
-        :class:`EngineShutdownError`."""
+        """Stop the driver and fail EVERY queued or in-flight lane with
+        :class:`EngineShutdownError` — unconditionally. The driver's own
+        exit path fails lanes too, but only if it is alive to run it; a
+        never-started driver (``auto_start=False``) or one that died at
+        startup would otherwise leave queued submissions hanging
+        forever, so the drain repeats here (idempotent: the queue is
+        drained once, double error puts on a lane are inert)."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout_s)
+        # A driver that outlived the join (stuck in a long dispatch /
+        # first-call compile) still owns the slot structures and the
+        # page pool: fail the lanes but leave the bookkeeping to its
+        # own exit path, or freed pages would be double-unref'd.
+        alive = self._thread is not None and self._thread.is_alive()
+        self._fail_all(EngineShutdownError("engine shut down"),
+                       free_state=not alive)
 
     def stats(self) -> dict:
         with self._stats_lock:
             out = dict(self._stats)
         out["active_slots"] = sum(s is not None for s in self._state)
         out["slots"] = self.slots
-        out["queued"] = self._queue.qsize()
+        out["queued"] = self._queue.qsize() + len(self._pending)
         d = max(out["dispatches"], 1)
         out["avg_occupancy"] = out.pop("occupancy_sum") / d
         out["dispatches_per_token"] = (
             (out["dispatches"] + out["prefills"]) / max(out["tokens"], 1))
+        out["paged"] = self.paged
+        out["deployment"] = self.deployment
+        if self.paged:
+            out["page_size"] = self.page_size
+            out["n_pages"] = self.n_pages
+            out["pages_free"] = self._pool.available()
+            out["pages_used"] = self.n_pages - self._pool.available()
+            out["parked_slots"] = sum(
+                s is not None and s.parked for s in self._state)
+            if self._prefix is not None:
+                out["prefix_cache_entries"] = len(self._prefix)
+                out["prefix_evictions"] = self._prefix.evictions
+        else:
+            for k in ("prefix_hits", "prefix_tokens_reused",
+                      "cow_copies", "admissions_deferred", "lane_parks",
+                      "preempted"):
+                out.pop(k, None)
         return out
 
     def _count(self, **deltas):
@@ -255,101 +570,347 @@ class DecodeEngine:
             while not self._stop.is_set():
                 self._admit_pending()
                 if not any(s is not None for s in self._state):
+                    if self._pending:
+                        # Deferred head with an empty pool and ZERO
+                        # running lanes cannot happen (n_pages holds a
+                        # full max_len sequence and the prefix cache
+                        # evicts first) — but never busy-spin on it.
+                        time.sleep(0.001)
+                        continue
                     # Idle: block briefly for the next arrival instead
                     # of spinning; the timeout bounds shutdown latency.
                     try:
-                        req = self._queue.get(timeout=0.05)
+                        self._pending.append(self._queue.get(timeout=0.05))
                     except queue.Empty:
                         continue
-                    self._admit_one(req)
-                    continue  # boundary: drain more arrivals first
+                    continue  # boundary: admission pass first
                 self._dispatch_chunk()
             self._fail_all(EngineShutdownError("engine shut down"))
         except BaseException as e:  # noqa: BLE001 - driver died: fan out
             self._fail_all(e)
             raise
 
-    def _fail_all(self, exc: BaseException):
+    def _fail_all(self, exc: BaseException, free_state: bool = True):
+        """Fail every queued / in-flight lane with ``exc``.
+
+        ``free_state=False`` (shutdown racing a still-alive driver)
+        only PUTS errors — slot state, the pending deque, and the page
+        pool stay driver-owned, so refcounts drop exactly once when the
+        driver's own exit path runs this with ``free_state=True``.
+        Double error puts on a lane are inert."""
         with self._admit_lock:
             self._draining = True    # no put can land past this point
-        for i, st in enumerate(self._state):
-            if st is not None:
-                st.lane.q.put(("err", exc))
-                self._state[i] = None
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                return
-            req.lane.q.put(("err", exc))
+        # Serialized: shutdown() calls this unconditionally (covering a
+        # dead/never-started driver) and may race the dying driver's own
+        # exit path — page refcounts must only drop once per slot.
+        with self._fail_lock:
+            for i, st in enumerate(self._state):
+                if st is not None:
+                    st.lane.q.put(("err", exc))
+                    if free_state:
+                        self._free_slot(i)
+            if free_state:
+                while self._pending:
+                    self._pending.popleft().lane.q.put(("err", exc))
+            else:
+                for req in list(self._pending):
+                    req.lane.q.put(("err", exc))
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+                req.lane.q.put(("err", exc))
+
+    def _free_slot(self, i: int):
+        """Release slot i: page references drop (pages whose last ref
+        was this slot return to the free list; prefix-cached pages stay
+        resident) and the page-table row resets to sentinel."""
+        st = self._state[i]
+        if st is not None and st.pages:
+            self._pool.unref(st.pages)
+            self._pt[i, :] = self._gd.PT_SENTINEL
+        self._state[i] = None
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocate n pages, evicting LRU prefix-cache entries while
+        short. None = genuinely out (every page pinned by live lanes) —
+        the caller defers or parks, never clamps."""
+        while self._pool.available() < n:
+            if self._prefix is None or not self._prefix.evict_lru():
+                return None
+        return self._pool.alloc(n)
+
+    def _observe_pages(self, sm=None):
+        if not self.paged:
+            return
+        if sm is None:
+            from .._private.metrics import serve_metrics
+            sm = serve_metrics()
+        free = self._pool.available()
+        labels = {"deployment": self.deployment}
+        sm["engine_pages_free"].set(free, labels=labels)
+        sm["engine_pages_used"].set(self.n_pages - free, labels=labels)
 
     def _admit_pending(self):
-        """Chunk-boundary admission: fill every free slot from the FIFO
-        queue. Expired / abandoned requests are failed out without
-        spending a prefill."""
-        while any(s is None for s in self._state):
+        """Chunk-boundary admission: fill every free slot in FIFO order.
+        Expired / abandoned requests are failed out without spending a
+        prefill; a paged admission that cannot get pages DEFERS — it
+        stays at the queue head (order preserved) and retries next
+        boundary, by which time a lane may have freed pages."""
+        while True:
             try:
-                req = self._queue.get_nowait()
+                self._pending.append(self._queue.get_nowait())
             except queue.Empty:
-                return
-            self._admit_one(req)
+                break
+        # Cull dead entries EVERYWHERE in the deque first — deferral
+        # under page pressure must not delay a deadline error that
+        # costs nothing to deliver. In-place rotation keeps FIFO order.
+        for _ in range(len(self._pending)):
+            req = self._pending.popleft()
+            if req.lane.closed:
+                self._count(abandoned=1)
+                continue
+            if deadline_expired(req.deadline_s):
+                from .._private.metrics import serve_metrics
+                self._count(expired=1)
+                serve_metrics()["requests_expired"].inc(
+                    labels={"where": "engine",
+                            "deployment": self.deployment})
+                req.lane.q.put(("err", RequestDeadlineExceeded(
+                    "request expired while queued for engine admission")))
+                continue
+            self._pending.append(req)
+        if any(s is not None and s.parked for s in self._state):
+            # Page pressure: freed pages must reach the (older) parked
+            # lanes before new admissions may take them — otherwise a
+            # preempted lane's pages would be re-pinned immediately and
+            # the pool would thrash prefills instead of progressing.
+            return
+        while self._pending and any(s is None for s in self._state):
+            if not self._admit_one(self._pending[0]):
+                self._count(admissions_deferred=1)
+                return               # out of pages: keep FIFO, back off
+            self._pending.popleft()
 
-    def _admit_one(self, req: _EngineRequest):
+    def _admit_one(self, req: _EngineRequest) -> bool:
+        """Prefill ``req`` into a free slot; returns False to defer
+        (paged mode, no pages). Lane-closed/expired checks happen in
+        :meth:`_admit_pending` before any resources are taken."""
         from .._private.metrics import serve_metrics
 
-        if req.lane.closed:
-            self._count(abandoned=1)
-            return
-        if deadline_expired(req.deadline_s):
-            self._count(expired=1)
-            serve_metrics()["requests_expired"].inc(
-                labels={"where": "engine", "deployment": self.deployment})
-            req.lane.q.put(("err", RequestDeadlineExceeded(
-                "request expired while queued for engine admission")))
-            return
         slot = next(i for i, s in enumerate(self._state) if s is None)
-        now = time.time()
-        serve_metrics()["engine_admission_wait"].observe(
-            max(now - req.enq_t, 0.0),
-            labels={"deployment": self.deployment})
-        if req.trace_ctx is not None:
-            tracing.record_span("engine.admission", req.enq_t, now,
-                                parent_ctx=req.trace_ctx, slot=slot,
-                                deployment=self.deployment)
         import jax
 
-        padded = np.zeros((1, req.bucket), np.int32)
-        padded[0, :req.prompt.shape[0]] = req.prompt
-        tok, self._cache, key = self._prefill(
-            self.params, self._cache, padded,
-            np.int32(req.prompt.shape[0]), np.int32(slot),
-            jax.random.PRNGKey(req.seed))
-        first = int(np.asarray(tok))
-        self._count(prefills=1, admitted=1, tokens=1)
-        serve_metrics()["engine_tokens"].inc(
+        P = req.prompt.shape[0]
+        sm = serve_metrics()
+        if self.paged:
+            admitted = self._prefill_paged(req, slot, P, sm, jax)
+            if admitted is None:
+                return False
+            first, pages, t_admit = admitted
+        else:
+            t_admit = time.time()
+            padded = np.zeros((1, req.bucket), np.int32)
+            padded[0, :P] = req.prompt
+            tok, self._cache, key = self._prefill(
+                self.params, self._cache, padded, np.int32(P),
+                np.int32(slot), jax.random.PRNGKey(req.seed))
+            first = int(np.asarray(tok))
+            self._rngs[slot] = np.asarray(key)
+            pages = []
+        sm["engine_admission_wait"].observe(
+            max(t_admit - req.enq_t, 0.0),
             labels={"deployment": self.deployment})
+        if req.trace_ctx is not None:
+            tracing.record_span("engine.admission", req.enq_t, t_admit,
+                                parent_ctx=req.trace_ctx, slot=slot,
+                                deployment=self.deployment)
+        self._count(prefills=1, admitted=1 if req.skip == 0 else 0)
         self._token[slot] = first
-        self._rngs[slot] = np.asarray(key)
-        req.lane.q.put(("item", np.asarray([first], np.int32)))
+        skip = req.skip
+        if skip > 0:
+            skip -= 1            # replay: the first token was delivered
+        else:                    # before the preemption
+            self._count(tokens=1)
+            sm["engine_tokens"].inc(
+                labels={"deployment": self.deployment})
+            req.lane.q.put(("item", np.asarray([first], np.int32)))
         if req.max_new <= 1 or (self.eos_token >= 0
                                 and first == self.eos_token):
             req.lane.q.put((_STREAM_END, None))
             self._count(completed=1)
-            return
+            if pages:
+                self._pool.unref(pages)
+                self._pt[slot, :] = self._gd.PT_SENTINEL
+            self._observe_pages(sm)
+            return True
         self._state[slot] = _Slot(
             lane=req.lane, remaining=req.max_new - 1,
-            deadline_s=req.deadline_s, trace_ctx=req.trace_ctx)
+            deadline_s=req.deadline_s, trace_ctx=req.trace_ctx,
+            req=req, emitted=1 if req.skip == 0 else req.skip,
+            pos=P, pages=pages, skip=skip)
+        self._observe_pages(sm)
+        return True
+
+    def _prefill_paged(self, req: _EngineRequest, slot: int, P: int,
+                       sm, jax
+                       ) -> Optional[Tuple[int, List[int], float]]:
+        """Paged admission: map the cached prefix (refcounted, COW fork
+        if it ends mid-page), allocate fresh pages for the suffix,
+        prefill ONLY the suffix, then register the prompt's pages in the
+        prefix cache. Returns None (nothing taken) when pages are
+        unavailable even after LRU eviction."""
+        gd = self._gd
+        ps = self.page_size
+        hist, shared_pages = (0, [])
+        if self._prefix is not None:
+            hist, shared_pages = self._prefix.lookup(req.prompt)
+        shared_full = hist // ps
+        partial = hist % ps
+        cow_src = shared_pages[shared_full] if partial else \
+            gd.PT_SENTINEL
+        shared = shared_pages[:shared_full]
+        # Pin everything we read BEFORE eviction-driven allocation can
+        # free it from under us.
+        self._pool.ref(shared)
+        if partial:
+            self._pool.ref([cow_src])
+        n_fresh = -(-P // ps) - shared_full
+        fresh = self._alloc_pages(n_fresh)
+        if fresh is None:
+            self._pool.unref(shared)
+            if partial:
+                self._pool.unref([cow_src])
+            return None
+        pages = shared + fresh
+        t_admit = time.time()
+        suffix = req.prompt[hist:]
+        sl = P - hist
+        bucket = next(b for b in self.prompt_buckets if b >= sl)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :sl] = suffix
+        pt_row = np.full((self.max_pages,), gd.PT_SENTINEL, np.int32)
+        pt_row[:len(pages)] = pages
+        self._pt[slot] = pt_row
+        tok, self._cache, key = self._prefill(
+            self.params, self._cache, padded, np.int32(sl),
+            np.int32(hist), pt_row, np.int32(cow_src), np.int32(slot),
+            jax.random.PRNGKey(req.seed))
+        first = int(np.asarray(tok))
+        self._rngs[slot] = np.asarray(key)
+        if partial:
+            # The fork read src synchronously inside the dispatch above;
+            # its pin is no longer needed.
+            self._pool.unref([cow_src])
+            self._count(cow_copies=1)
+            sm["engine_cow_copies"].inc(
+                labels={"deployment": self.deployment})
+        if hist:
+            self._count(prefix_hits=1, prefix_tokens_reused=hist)
+            sm["engine_prefix_hits"].inc(
+                labels={"deployment": self.deployment})
+        if self._prefix is not None:
+            self._prefix.insert(req.prompt, pages)
+        return first, pages, t_admit
+
+    def _cover_pages(self) -> bool:
+        """Allocate-on-advance (paged mode, chunk boundary): every
+        occupied slot must have pages mapped through the positions this
+        chunk will write (``pos + min(chunk, remaining)``). A slot that
+        cannot be covered PARKS — it keeps its state and pages but sits
+        out the dispatch mask until a page frees. Returns True if at
+        least one lane can run; False means every occupied slot was
+        parked and the youngest lane has been preempted by recompute
+        to break the deadlock."""
+        ps = self.page_size
+        # Cull dead PARKED lanes first: a parked slot sits out the
+        # dispatch mask, so it never reaches the post-dispatch
+        # closed/deadline checks — a consumer that walked away (or a
+        # deadline that already passed) would otherwise pin its pages
+        # forever and could force recompute-preemption of a healthy
+        # lane. Freed pages are immediately available to the coverage
+        # loop below.
+        culled = False
+        for i, st in enumerate(self._state):
+            if st is None or not st.parked:
+                continue
+            if st.lane.closed:
+                self._free_slot(i)
+                self._count(abandoned=1)
+                culled = True
+            elif deadline_expired(st.deadline_s):
+                from .._private.metrics import serve_metrics
+                st.lane.q.put(("err", RequestDeadlineExceeded(
+                    "request deadline passed while parked for pages")))
+                self._free_slot(i)
+                self._count(expired=1)
+                serve_metrics()["requests_expired"].inc(
+                    labels={"where": "engine",
+                            "deployment": self.deployment})
+                culled = True
+        if culled:
+            self._observe_pages()
+            if not any(s is not None for s in self._state):
+                return False         # nothing left to dispatch
+        runnable = 0
+        for i, st in enumerate(self._state):
+            if st is None:
+                continue
+            need = st.pos + min(self.chunk, st.remaining)
+            while len(st.pages) * ps < need:
+                got = self._alloc_pages(1)
+                if got is None:
+                    break
+                self._pt[i, len(st.pages)] = got[0]
+                st.pages.extend(got)
+            short = len(st.pages) * ps < need
+            if short and not st.parked:
+                self._count(lane_parks=1)
+            st.parked = short
+            runnable += not short
+        if runnable:
+            return True
+        # Deadlock: every occupied slot is parked and nothing will free
+        # a page on its own. Preempt the youngest lane (least sunk
+        # work) BY RECOMPUTE: free its pages, requeue its request at
+        # the head, and let the replay suppress the already-delivered
+        # tokens — the consumer sees a stall, never an error or a
+        # duplicate. Each preemption strictly shrinks the lane set, and
+        # one lane always fits (n_pages >= max_pages), so this
+        # terminates.
+        youngest = max(
+            (i for i, s in enumerate(self._state) if s is not None),
+            key=lambda i: self._state[i].admitted_t)
+        st = self._state[youngest]
+        req = st.req
+        req.skip = st.emitted
+        req.enq_t = time.time()
+        self._free_slot(youngest)
+        self._pending.appendleft(req)
+        self._count(preempted=1)
+        self._observe_pages()
+        return False
 
     def _dispatch_chunk(self):
         """ONE fused device dispatch decoding every active slot, then
         per-slot routing/trimming and boundary frees."""
         from .._private.metrics import serve_metrics
 
-        active = np.array([s is not None for s in self._state], bool)
+        if self.paged and not self._cover_pages():
+            return                    # re-run admission/coverage pass
+        active = np.array([s is not None and not s.parked
+                           for s in self._state], bool)
         n_active = int(active.sum())
         t0 = time.time()
-        toks, self._cache, _done, rngs = self._step(
-            self.params, self._cache, self._token, self._rngs, active)
+        if self.paged:
+            toks, self._cache, _done, rngs = self._step(
+                self.params, self._cache, self._token, self._rngs,
+                active, self._pt)
+        else:
+            toks, self._cache, _done, rngs = self._step(
+                self.params, self._cache, self._token, self._rngs,
+                active)
         toks_np = np.asarray(toks)        # ONE transfer per chunk
         rngs_np = np.asarray(rngs)
         t1 = time.time()
@@ -359,20 +920,24 @@ class DecodeEngine:
         sm["engine_dispatches"].inc(
             labels={"deployment": self.deployment})
         self._count(dispatches=1, occupancy_sum=n_active / self.slots)
+        with self._stats_lock:
+            self._stats["peak_active"] = max(self._stats["peak_active"],
+                                             n_active)
         emitted = 0
         for i, st in enumerate(self._state):
-            if st is None:
-                continue
+            if st is None or st.parked:
+                continue                     # parked: nothing advanced
             self._token[i] = toks_np[i, -1]
             self._rngs[i] = rngs_np[i]
+            st.pos += self.chunk             # mirrors the device pos
             if st.lane.closed:               # consumer left: free now
-                self._state[i] = None
+                self._free_slot(i)
                 self._count(abandoned=1)
                 continue
             if deadline_expired(st.deadline_s):
                 st.lane.q.put(("err", RequestDeadlineExceeded(
                     "request deadline passed mid-generation")))
-                self._state[i] = None
+                self._free_slot(i)
                 self._count(expired=1)
                 sm["requests_expired"].inc(
                     labels={"where": "engine",
@@ -391,15 +956,22 @@ class DecodeEngine:
                                     parent_ctx=st.trace_ctx, slot=i,
                                     active_slots=n_active, tokens=j,
                                     deployment=self.deployment)
-            st.lane.q.put(("item", row[:j].copy()))
+            # Recompute replay: the first ``skip`` regenerated tokens
+            # were already delivered before the preemption — suppress
+            # them, stream only the new tail.
+            cut = min(st.skip, j)
+            st.skip -= cut
+            if j > cut:
+                st.lane.q.put(("item", row[cut:j].copy()))
+                st.emitted += j - cut
+                emitted += j - cut
             st.remaining -= j
-            st.emitted += j
-            emitted += j
             if finished:
                 st.lane.q.put((_STREAM_END, None))
-                self._state[i] = None
+                self._free_slot(i)
                 self._count(completed=1)
         if emitted:
             sm["engine_tokens"].inc(
                 emitted, labels={"deployment": self.deployment})
             self._count(tokens=emitted)
+        self._observe_pages(sm)

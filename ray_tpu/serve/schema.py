@@ -38,6 +38,13 @@ class DeploymentSchema:
     autoscaling_config: Optional[Dict[str, Any]] = None
     user_config: Any = None
     ray_actor_options: Optional[Dict[str, Any]] = None
+    #: Paged KV-cache block for continuous-batching deployments:
+    #: ``engine: {page_size: 16, prefix_cache: true, n_pages: 512}``.
+    #: The replica applies it to every DecodeEngine the deployment
+    #: constructs (see ``DeploymentConfig.engine_config``).
+    engine: Optional[Dict[str, Any]] = None
+
+    _ENGINE_KEYS = frozenset({"page_size", "prefix_cache", "n_pages"})
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSchema":
@@ -46,6 +53,13 @@ class DeploymentSchema:
         if unknown:
             raise ValueError(
                 f"unknown deployment config keys {sorted(unknown)}")
+        eng = d.get("engine")
+        if eng is not None:
+            bad = set(eng) - cls._ENGINE_KEYS
+            if bad:
+                raise ValueError(
+                    f"unknown engine config keys {sorted(bad)}; "
+                    f"known: {sorted(cls._ENGINE_KEYS)}")
         return cls(**d)
 
 
@@ -175,6 +189,8 @@ def apply_overrides(spec: Dict[str, Any],
             cfg.user_config = o.user_config
         if o.ray_actor_options is not None:
             cfg.ray_actor_options = dict(o.ray_actor_options)
+        if o.engine is not None:
+            cfg.engine_config = dict(o.engine)
     return spec
 
 
