@@ -56,6 +56,14 @@ max_len); then a shared-prefix TTFT probe — median TTFT of a request
 whose system prompt is prefix-cached (page-table copy + short-suffix
 prefill) vs the flat pool's full prefill. ``--smoke`` shrinks it for
 tier-1 CI.
+
+``--chaos`` (ISSUE 7) switches to the crash-safety acceptance run: a
+2-replica continuous-engine deployment serves seeded (deterministic)
+streams under load while a replica is KILLED mid-stream; every client
+stream holds a replay token (``resumable=True``) and must complete
+token-identical to its uninterrupted reference — the row asserts ZERO
+broken client streams and reports resumes, kills, and the recovery
+stall. ``--smoke`` shrinks it for tier-1 CI.
 """
 from __future__ import annotations
 
@@ -103,6 +111,11 @@ def main():
                              "pool at the SAME KV-byte budget, plus a "
                              "shared-prefix TTFT probe (direct engine "
                              "drive, no serve stack)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="crash-safety run: kill a replica of a "
+                             "2-replica engine deployment mid-load and "
+                             "assert zero broken client streams "
+                             "(deterministic replay resume)")
     parser.add_argument("--page-size", type=int, default=8)
     parser.add_argument("--smoke", action="store_true",
                         help="with --continuous/--paged: shrunk load "
@@ -254,6 +267,11 @@ def main():
     # Cache sized for the worst chunk over-run: the last fused chunk may
     # execute up to (chunk - 1) steps past max_new before truncation.
     max_len = 16 + max_new + max(max(chunks), 8)
+    if args.chaos:
+        run_chaos_mode(args, serve, np, cfg_name, f"gpt_{cfg_name}")
+        serve.shutdown()
+        rt.shutdown()
+        return
     if args.continuous:
         run_continuous_ab(args, serve, np, cfg_name, f"gpt_{cfg_name}")
         serve.shutdown()
@@ -1057,6 +1075,211 @@ def run_paged_ab(args, np, cfg_name, model):
         "kv_budget_positions": kv_positions,
         "smoke": bool(args.smoke),
     }))
+
+
+def run_chaos_mode(args, serve, np, cfg_name, model):
+    """ISSUE 7 acceptance: a 2-replica continuous-engine deployment
+    serves seeded deterministic streams under load; ONE replica is
+    hard-killed mid-load. Every client stream is submitted with
+    ``resumable=True`` — a stream cut mid-flight re-routes to the
+    survivor with its replay token and must complete TOKEN-IDENTICAL to
+    its uninterrupted reference. The row asserts zero broken streams."""
+    import threading as _th
+
+    import jax
+
+    import ray_tpu as rt
+    from ray_tpu._private.metrics import serve_metrics
+    from ray_tpu.models import gpt, gpt_decode
+    from ray_tpu.testing import _serve_replica_handles, inject_engine_fault
+
+    slots = 4
+    chunk = 8
+    plen = 16
+    n_req = 10 if args.smoke else min(args.requests, 32)
+    base = min(args.tokens, 16) if args.smoke else max(args.tokens, 32)
+    max_len = plen + 2 * base + chunk
+    cfg = gpt.CONFIGS[cfg_name]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    max_news = np.random.default_rng(7).integers(base, 2 * base + 1,
+                                                 size=n_req)
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=64,
+                      health_check_period_s=0.5,
+                      graceful_shutdown_timeout_s=10.0)
+    class ChaosGPT:
+        def __init__(self, cfg_name, max_len, slots, chunk, plen):
+            from ray_tpu.models import gpt as _gpt
+            from ray_tpu.serve.engine import DecodeEngine
+
+            self.cfg = _gpt.CONFIGS[cfg_name]
+            p = _gpt.init_params(jax.random.PRNGKey(0), self.cfg)
+            self.plen = plen
+            self.engine = DecodeEngine(
+                p, self.cfg, slots=slots, chunk=chunk, max_len=max_len,
+                prompt_buckets=(plen,), deployment="gpt_chaos")
+
+        @serve.batch(continuous=True)
+        def decode(self, request):
+            rid = int(request["rid"])
+            return self.engine, {
+                "prompt": _mk_prompt(rid, self.plen,
+                                     self.cfg.vocab_size),
+                "max_new": int(request["max_new"]), "seed": rid}
+
+        def warm(self, max_new: int = 2):
+            list(self.engine.stream(
+                _mk_prompt(0, self.plen, self.cfg.vocab_size), max_new))
+            return "warm"
+
+        def __call__(self, request):
+            if hasattr(request, "json"):
+                request = request.json()
+            return self.decode(request)
+
+    handle = serve.run(
+        ChaosGPT.bind(cfg_name, max_len, slots, chunk, plen),
+        name="gpt_chaos", route_prefix="/chaos")
+    handle.options(method_name="warm").remote(2).result(timeout=600)
+    # Compile both replicas' programs before the clock starts.
+    warm_threads = [_th.Thread(target=lambda: list(
+        handle.options(stream=True).remote({"rid": 0, "max_new": 2})))
+        for _ in range(4)]
+    for t in warm_threads:
+        t.start()
+    for t in warm_threads:
+        t.join()
+    # Throttle the engines so the kill reliably lands while streams are
+    # mid-flight. The smoke run carries far fewer tokens, so it needs a
+    # heavier per-chunk stall to stay airborne past the kill (the total
+    # dispatch count times the throttle must comfortably exceed the
+    # time it takes the first third of the streams to yield a token).
+    inject_engine_fault("gpt_chaos", "ChaosGPT", kind="driver_slow",
+                        wedge_s=0.05 if args.smoke else 0.02)
+
+    refs = {int(i): gpt_decode.generate_chunked(
+        params, _mk_prompt(int(i), plen, cfg.vocab_size)[None], cfg,
+        int(max_news[i]), chunk=chunk, max_len=max_len)
+        for i in range(n_req)}
+    refs = {i: np.concatenate([s[0] for s in r]) for i, r in refs.items()}
+
+    resumes0 = sum(v for _k, v in
+                   serve_metrics()["stream_resumes"].collect())
+    first_tokens = _th.Semaphore(0)
+    results = [None] * n_req
+    errors = [None] * n_req
+    stalls = [0.0] * n_req
+
+    def one(i):
+        try:
+            toks = []
+            last = time.perf_counter()
+            it = handle.options(stream=True, resumable=True,
+                                timeout_s=300.0).remote(
+                {"rid": int(i), "max_new": int(max_news[i])})
+            for item in it:
+                now = time.perf_counter()
+                stalls[i] = max(stalls[i], now - last)
+                last = now
+                w = np.asarray(item).ravel()
+                if not toks:
+                    first_tokens.release()
+                toks.extend(int(t) for t in w)
+            results[i] = np.asarray(toks, np.int32)
+        except Exception as e:  # noqa: BLE001 - counted as broken
+            errors[i] = repr(e)
+
+    def launch():
+        for i in range(n_req):
+            results[i], errors[i], stalls[i] = None, None, 0.0
+        ths = [_th.Thread(target=one, args=(i,)) for i in range(n_req)]
+        for t in ths:
+            t.start()
+            time.sleep(0.02)       # staggered arrivals
+        return ths
+
+    def count_resumes():
+        return sum(v for _k, v in
+                   serve_metrics()["stream_resumes"].collect()) - resumes0
+
+    handles = _serve_replica_handles("gpt_chaos", "ChaosGPT")
+    t_start = time.perf_counter()
+    threads = launch()
+
+    # Arm a deterministic mid-stream kill on the BUSIER replica once a
+    # third of the streams are flowing: the engine hard-exits the
+    # replica process at the NEXT delivered token, so the kill lands
+    # while a stream is delivering BY CONSTRUCTION — an outside-in
+    # rt.kill races stream completion on a loaded box.
+    for _ in range(max(2, n_req // 3)):
+        first_tokens.acquire(timeout=60)
+    busiest, busiest_slots, busiest_toks = None, -1, 0
+    for rid_, h in handles.items():
+        try:
+            m = rt.get(h.get_metrics.remote(), timeout=10)
+            est = (m.get("engines") or [{}])[0]
+            act = est.get("active_slots", 0)
+        except Exception:  # noqa: BLE001
+            act, est = 0, {}
+        if act > busiest_slots:
+            busiest, busiest_slots = rid_, act
+            busiest_toks = int(est.get("tokens", 0))
+    busiest = busiest if busiest is not None else next(iter(handles))
+    rt.get(handles[busiest].inject_engine_fault.remote(
+        "kill_process", busiest_toks + 1, 0.0), timeout=10)
+    t_kill = time.perf_counter()
+    kills = 1
+
+    for t in threads:
+        t.join()
+    rounds = 1
+    if not any(errors) and count_resumes() == 0:
+        # Every stream outran the armed kill (tiny smoke loads on a
+        # contended box): the one-shot fault is STILL armed and fires
+        # at the armed replica's next delivered token — one more
+        # identical round guarantees a mid-stream kill.
+        rounds = 2
+        threads = launch()
+        t_kill = time.perf_counter()
+        for t in threads:
+            t.join()
+    wall = time.perf_counter() - t_start
+
+    broken = []
+    for i in range(n_req):
+        if errors[i] is not None:
+            broken.append((i, errors[i]))
+        elif results[i] is None or len(results[i]) != len(refs[i]) \
+                or not (results[i] == refs[i]).all():
+            broken.append((i, f"token mismatch: got "
+                              f"{None if results[i] is None else len(results[i])}"
+                              f" want {len(refs[i])}"))
+    resumes = count_resumes()
+    completed = sum(r is not None for r in results)
+    row = {
+        "metric": f"serve_{model}_chaos_recovery",
+        "value": len(broken), "unit": "broken_streams",
+        "broken_streams": len(broken),
+        "requests": n_req, "completed": completed,
+        "kills": kills, "killed_replica": busiest,
+        "rounds": rounds,
+        "active_slots_at_kill": busiest_slots,
+        "stream_resumes": int(resumes),
+        "max_stall_ms": round(max(stalls) * 1000, 1),
+        "stall_p50_ms": round(sorted(stalls)[len(stalls) // 2] * 1000, 1),
+        "kill_at_s": round(t_kill - t_start, 2),
+        "wall_s": round(wall, 2),
+        "tokens_total": int(sum(len(r) for r in results
+                                if r is not None)),
+        "output_tokens": [int(m) for m in max_news],
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(row))
+    assert not broken, f"broken client streams after replica kill: " \
+                       f"{broken[:4]}"
+    assert resumes >= 1, \
+        "the kill interrupted no stream — chaos run proved nothing"
+    serve.delete("gpt_chaos")
 
 
 def run_overload_ab(args, serve, GPTStream, cfg_name, max_len, chunks,

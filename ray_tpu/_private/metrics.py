@@ -437,6 +437,28 @@ def serve_metrics() -> dict:
                 "serve_engine_cow_copies_total",
                 "Copy-on-write page forks (cached prefix ended "
                 "mid-page)"),
+            # ---- crash-safe streaming (ISSUE 7). Resumes are observed
+            # caller-side (the router re-routes a mid-stream failure
+            # with a replay token); driver restarts on the engine's
+            # supervisor path; drains by the layer executing them
+            # (replica and controller).
+            stream_resumes=Counter(
+                "serve_stream_resumes_total",
+                "Mid-stream failovers: streams re-routed to another "
+                "replica with a deterministic replay token after a "
+                "replica/driver failure"),
+            engine_driver_restarts=Counter(
+                "serve_engine_driver_restarts_total",
+                "Engine driver threads restarted by the supervisor "
+                "after a death or wedge (first occurrence; a second "
+                "escalates to replica replacement)"),
+            replica_drains=Counter(
+                "serve_replica_drains_total",
+                "Graceful replica drains (admissions stopped, running "
+                "lanes finished or failed retryably) before teardown"),
+            drain_duration=Histogram(
+                "serve_drain_duration_seconds",
+                "Wall time of graceful replica drains"),
         )
         return _serve
 

@@ -11,20 +11,22 @@ from .api import (Application, Deployment, delete, deployment,
                   start, status)
 from .batching import batch, default_buckets, pad_to_bucket
 from .config import (AutoscalingConfig, DeploymentConfig, HTTPOptions, gRPCOptions)
-from .engine import DecodeEngine, EngineShutdownError
+from .engine import DecodeEngine, EngineRestartError, EngineShutdownError
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
-from .request import (BackPressureError, ReplicaOverloadedError, Request,
+from .request import (BackPressureError, ReplicaDrainingError,
+                      ReplicaOverloadedError, Request,
                       RequestDeadlineExceeded, Response,
                       get_request_deadline)
 
 __all__ = [
     "Application", "AutoscalingConfig", "BackPressureError", "DecodeEngine",
     "Deployment",
-    "DeploymentConfig", "EngineShutdownError",
+    "DeploymentConfig", "EngineRestartError", "EngineShutdownError",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
-    "HTTPOptions", "gRPCOptions", "ReplicaOverloadedError", "Request",
+    "HTTPOptions", "gRPCOptions", "ReplicaDrainingError",
+    "ReplicaOverloadedError", "Request",
     "RequestDeadlineExceeded",
     "Response", "batch", "default_buckets", "delete", "deployment",
     "get_multiplexed_model_id", "get_request_deadline", "multiplexed",

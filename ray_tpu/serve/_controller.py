@@ -119,21 +119,55 @@ class ServeController:
         return deferred
 
     def _teardown_deployment(self, dstate: dict):
-        from .. import api as rt
-
         with self._reconcile_lock:
             with self._lock:
                 dstate["deleted"] = True
                 victims = list(dstate["replicas"].values())
                 dstate["replicas"] = {}
-            for r in victims:
-                self._call_quietly(
-                    r["handle"].drain,
-                    dstate["config"].graceful_shutdown_timeout_s)
-                try:
-                    rt.kill(r["handle"])
-                except Exception:  # noqa: BLE001
-                    pass
+                dstate["version"] += 1
+            self._drain_and_kill(
+                victims, dstate["config"].graceful_shutdown_timeout_s,
+                dstate["name"])
+
+    def _drain_and_kill(self, victims: list, timeout_s: float,
+                        deployment: str):
+        """Graceful drain before any teardown (reconfigure, scale-down,
+        health replacement, app delete), then the kill: each replica
+        stops admitting (retryable pushback → routers re-pick), running
+        engine lanes finish, stragglers fail retryably so clients
+        resume elsewhere. Drains are fired in PARALLEL and gathered
+        under ONE shared budget — N stalled victims cost the same wall
+        time as one, so a wide scale-down cannot wedge the control
+        loop. Drain count/duration are observed HERE — the controller
+        outlives the replica, so the observation always ships."""
+        from .. import api as rt
+        from .._private.metrics import serve_metrics
+
+        if not victims:
+            return
+        t0 = time.time()
+        refs = []
+        for r in victims:
+            try:
+                refs.append(r["handle"].drain.remote(timeout_s))
+            except Exception:  # noqa: BLE001 - already-dead actor
+                pass
+        if refs:
+            try:
+                rt.wait(refs, num_returns=len(refs),
+                        timeout=timeout_s + 2)
+            except Exception:  # noqa: BLE001 - degrade to the kills
+                pass
+        sm = serve_metrics()
+        labels = {"deployment": deployment}
+        dt = time.time() - t0
+        for r in victims:
+            sm["replica_drains"].inc(labels=labels)
+            sm["drain_duration"].observe(dt, labels=labels)
+            try:
+                rt.kill(r["handle"])
+            except Exception:  # noqa: BLE001
+                pass
 
     # ------------------------------------------------------------ queries
     def get_replicas(self, app_name: str, deployment_name: str
@@ -189,7 +223,7 @@ class ServeController:
                         # the health pass; see _health_check).
                         "lifecycle": dict(d.get("lifecycle") or
                                           {"expired": 0, "overloaded": 0,
-                                           "total": 0}),
+                                           "total": 0, "drains": 0}),
                     }
                     # Paged decode-engine visibility (pages free/used,
                     # prefix hits, COW forks), same health-pass ride.
@@ -319,6 +353,12 @@ class ServeController:
                 except Exception:  # noqa: BLE001
                     traceback.print_exc()
 
+    #: Whole-pass budget for gathering health probes. A replica that
+    #: accepts the RPC but never replies used to wedge the entire pass
+    #: (serial per-probe waits); now the pass waits AT MOST this long in
+    #: aggregate and any probe still unanswered counts as FAILED.
+    _HEALTH_PROBE_TIMEOUT_S = 5.0
+
     def _health_check(self, d: dict):
         from .. import api as rt
 
@@ -330,20 +370,38 @@ class ServeController:
             probes = [(rid, r["handle"].check_health.remote(),
                        r["handle"].get_metrics.remote())
                       for rid, r in d["replicas"].items()]
+        if not probes:
+            return
+        # Bounded gather: one shared deadline for the whole pass, not a
+        # fresh window per replica — N wedged replicas cost the same as
+        # one. Probes not ready at the deadline are failed probes.
+        deadline = time.monotonic() + self._HEALTH_PROBE_TIMEOUT_S
+        try:
+            ready, _ = rt.wait([ref for _rid, ref, _m in probes],
+                               num_returns=len(probes),
+                               timeout=self._HEALTH_PROBE_TIMEOUT_S)
+            ready = set(ready)
+        except Exception:  # noqa: BLE001 - degrade to bounded gets
+            ready = {ref for _rid, ref, _m in probes}
         dead = []
         # Live-replica lifecycle totals (expired / overloaded / served),
         # piggybacked on the health pass and surfaced via status().
-        life = {"expired": 0, "overloaded": 0, "total": 0}
+        life = {"expired": 0, "overloaded": 0, "total": 0, "drains": 0}
         # Engine page/prefix totals (paged decode engines only),
         # summed across replicas, same piggyback.
         engine: dict = {}
         for rid, ref, mref in probes:
             try:
-                ok = rt.get(ref, timeout=5)
+                if ref not in ready:
+                    raise TimeoutError(
+                        f"health probe to {rid} unanswered after "
+                        f"{self._HEALTH_PROBE_TIMEOUT_S}s")
+                ok = rt.get(ref,
+                            timeout=max(deadline - time.monotonic(), 0.1))
                 if not ok:
                     dead.append(rid)
                     continue
-            except Exception:  # noqa: BLE001 - died or hung
+            except Exception:  # noqa: BLE001 - died, hung, or timed out
                 dead.append(rid)
                 continue
             # Metrics scrape is best-effort: only a failed HEALTH probe
@@ -351,36 +409,49 @@ class ServeController:
             # (e.g. user code holding the GIL through a long compile)
             # must not take down a healthy replica.
             try:
-                m = rt.get(mref, timeout=5)
+                m = rt.get(mref,
+                           timeout=max(deadline - time.monotonic(), 0.1))
                 life["expired"] += int(m.get("expired", 0))
                 life["overloaded"] += int(m.get("overloaded", 0))
                 life["total"] += int(m.get("total", 0))
+                life["drains"] += int(m.get("drains", 0))
                 for est in m.get("engines") or []:
                     for key in ("pages_free", "pages_used",
                                 "prefix_hits", "cow_copies",
                                 "admissions_deferred", "lane_parks",
                                 "preempted", "prefix_tokens_reused",
-                                "active_slots", "slots"):
+                                "active_slots", "slots",
+                                "resumed", "driver_restarts"):
                         if key in est:
                             engine[key] = engine.get(key, 0) + est[key]
                     engine["paged"] = engine.get("paged", False) \
                         or bool(est.get("paged"))
             except Exception:  # noqa: BLE001 - totals dip this round
                 pass
-        if probes:
-            d["lifecycle"] = life
-            if engine:
-                d["engine"] = engine
+        d["lifecycle"] = life
+        if engine:
+            d["engine"] = engine
         if dead:
             with self._lock:
+                victims = []
                 for rid in dead:
                     r = d["replicas"].pop(rid, None)
                     if r is not None:
-                        try:
-                            rt.kill(r["handle"])
-                        except Exception:  # noqa: BLE001
-                            pass
+                        victims.append(r)
                 d["version"] += 1
+            # Membership already dropped (routers stop picking on the
+            # next refresh); give a wedged-but-alive replica the chance
+            # to fail its in-flight lanes RETRYABLY before the kill —
+            # hard-killing first would turn every stream it still holds
+            # into an actor-death error race. A genuinely dead actor
+            # fails the drain RPC instantly. The budget is CAPPED at the
+            # probe timeout here — the victim already failed a health
+            # probe, and a wedged replica that swallows the drain RPC
+            # must not stall the control loop for the full graceful
+            # window per victim.
+            self._drain_and_kill(
+                victims, min(d["config"].graceful_shutdown_timeout_s,
+                             self._HEALTH_PROBE_TIMEOUT_S), d["name"])
 
     def _autoscale(self, d: dict):
         from .. import api as rt
@@ -455,13 +526,8 @@ class ServeController:
                 for rid, _ in victims:
                     d["replicas"].pop(rid, None)
                 d["version"] += 1
-            for rid, r in victims:
-                self._call_quietly(r["handle"].drain,
-                                   cfg.graceful_shutdown_timeout_s)
-                try:
-                    rt.kill(r["handle"])
-                except Exception:  # noqa: BLE001
-                    pass
+            self._drain_and_kill([r for _rid, r in victims],
+                                 cfg.graceful_shutdown_timeout_s, dname)
 
     def _start_replica(self, app_name: str, dname: str, d: dict):
         from .. import api as rt

@@ -18,9 +18,10 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ..util import tracing
-from .request import (SUBMITTED_AT_KEY, TRACE_CTX_KEY,
-                      ReplicaOverloadedError, RequestDeadlineExceeded,
-                      _request_deadline, _request_deployment,
+from .request import (RESUME_FROM_KEY, SUBMITTED_AT_KEY, TRACE_CTX_KEY,
+                      ReplicaDrainingError, ReplicaOverloadedError,
+                      RequestDeadlineExceeded, _request_deadline,
+                      _request_deployment, _request_resume_from,
                       deadline_expired)
 
 #: Bound on the fault-injection invocation log (test hook, see below).
@@ -65,6 +66,11 @@ class Replica:
         self._max_ongoing = int(max_ongoing_requests or 0)
         self._expired = 0
         self._overloaded = 0
+        # Graceful-drain state: once draining, admissions push back with
+        # the retryable ReplicaDrainingError (router re-picks) while
+        # running work finishes.
+        self._draining = False
+        self._drains = 0
         self._start_time = time.time()
         # Fault-injection hook (armed via set_fault_injection; testing
         # only): optional per-request latency/error plus an invocation
@@ -83,6 +89,11 @@ class Replica:
         deadline. Raises the typed pushback/expiry errors."""
         deadline = (ctx or {}).get("deadline_s")
         with self._lock:
+            if self._draining:
+                # Routing signal, not a failure: the router re-picks
+                # another replica; this one is being torn down.
+                raise ReplicaDrainingError(
+                    f"{self.replica_id} is draining for shutdown")
             if deadline_expired(deadline):
                 self._expired += 1
                 self._count_lifecycle("requests_expired", "replica")
@@ -205,15 +216,26 @@ class Replica:
         stream item per chunk — unless the caller sets
         ``ctx["flatten_chunks"]``, which re-yields each list/tuple item
         element-wise so per-token consumers keep token granularity
-        without a second code path on the replica."""
+        without a second code path on the replica.
+
+        Mid-stream failover (``ctx["resume_from"] = n``): the caller
+        already holds the first ``n`` tokens of this deterministic
+        stream, delivered by a replica that has since died. Engine-fed
+        streams suppress the replayed prefix INSIDE the engine (the
+        continuous-batching wrapper forwards the count into
+        ``engine.submit``); any other handler gets the generic fallback
+        — the replica drops the first ``n`` tokens of the replayed
+        stream before they reach the wire."""
         deadline = self._admit(method_name, ctx)
         token = None
         if ctx and ctx.get("multiplexed_model_id"):
             from .multiplex import _request_model_id
 
             token = _request_model_id.set(ctx["multiplexed_model_id"])
+        resume_from = int((ctx or {}).get(RESUME_FROM_KEY, 0) or 0)
         dl_token = _request_deadline.set(deadline)
         dep_token = _request_deployment.set(self.deployment_name)
+        rf_token = _request_resume_from.set(resume_from)
         try:
             self._pre_invoke(method_name, deadline)
             # user_code stage span covers the ITERATION of the handler
@@ -231,6 +253,8 @@ class Replica:
                                           False))
                 items = self._traced_items(self._normalize_stream(out),
                                            engine_fed=engine_fed)
+                if resume_from and not engine_fed:
+                    items = self._suppress_prefix(items, resume_from)
                 if ctx and ctx.get("flatten_chunks"):
                     for item in items:
                         if isinstance(item, (list, tuple)):
@@ -246,6 +270,7 @@ class Replica:
                 else:
                     yield from items
         finally:
+            _request_resume_from.reset(rf_token)
             _request_deployment.reset(dep_token)
             _request_deadline.reset(dl_token)
             if token is not None:
@@ -254,6 +279,30 @@ class Replica:
                 _request_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    @staticmethod
+    def _suppress_prefix(items, n: int):
+        """Replay-token suppression for non-engine streams: drop the
+        first ``n`` TOKENS — counted by the same
+        :func:`~.request.stream_item_width` contract the caller-side
+        generator records deliveries with — from a deterministically
+        replayed stream, so a resumed caller never sees a duplicate.
+        The chunk containing the boundary is trimmed, not dropped."""
+        from .request import stream_item_width
+
+        for item in items:
+            if n <= 0:
+                yield item
+                continue
+            w = stream_item_width(item)
+            if w <= n:
+                n -= w
+                continue
+            if isinstance(item, (list, tuple)):
+                yield list(item[n:])
+            else:
+                yield item.reshape(-1)[n:]
+            n = 0
 
     @staticmethod
     def _traced_items(items, engine_fed: bool = False):
@@ -336,17 +385,23 @@ class Replica:
             yield out
 
     # ---------------------------------------------------------- control plane
+    def _engines(self) -> list:
+        """Every DecodeEngine the user callable constructed (the units
+        the supervisor, drain, and chaos fault points operate on)."""
+        from .engine import DecodeEngine
+
+        if not hasattr(self._user, "__dict__"):
+            return []
+        return [v for v in vars(self._user).values()
+                if isinstance(v, DecodeEngine)]
+
     def _apply_engine_config(self, engine_config: dict):
         """Push the deployment schema's ``engine:`` block (paged KV
         knobs) into every DecodeEngine the user callable constructed —
         applied right after ``__init__``, before any traffic, which is
         the only window an engine may be repaged in."""
-        from .engine import DecodeEngine
-
-        for v in vars(self._user).values() \
-                if hasattr(self._user, "__dict__") else []:
-            if isinstance(v, DecodeEngine):
-                v.ensure_paging(**engine_config)
+        for eng in self._engines():
+            eng.ensure_paging(**engine_config)
 
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:
@@ -354,18 +409,29 @@ class Replica:
                    "total": self._total,
                    "expired": self._expired,
                    "overloaded": self._overloaded,
+                   "draining": self._draining,
+                   "drains": self._drains,
                    "uptime": time.time() - self._start_time}
         try:
-            from .engine import DecodeEngine
-
-            engines = [v for v in vars(self._user).values()
-                       if isinstance(v, DecodeEngine)] \
-                if hasattr(self._user, "__dict__") else []
+            engines = self._engines()
             if engines:
                 out["engines"] = [e.stats() for e in engines]
         except Exception:  # noqa: BLE001 - metrics stay useful without it
             pass
         return out
+
+    def inject_engine_fault(self, kind: str = "driver_die",
+                            at_tokens: int = 0,
+                            wedge_s: float = 0.0) -> int:
+        """Arm one chaos fault (driver death / wedge / process kill at
+        token N) on every DecodeEngine of this replica — the fault
+        points behind ``tests/test_serve_chaos.py`` and
+        ``benchmarks/serve_gpt.py --chaos``. Returns how many engines
+        were armed. Testing only."""
+        engines = self._engines()
+        for eng in engines:
+            eng.inject_fault(kind, at_tokens=at_tokens, wedge_s=wedge_s)
+        return len(engines)
 
     def set_fault_injection(self, latency_s: float = 0.0,
                             error_rate: float = 0.0) -> bool:
@@ -398,6 +464,15 @@ class Replica:
         return getattr(core, "node_id", None) if core is not None else None
 
     def check_health(self) -> bool:
+        # Engine driver supervision first (ISSUE 7): a dead or wedged
+        # driver thread is restarted ONCE — its lanes fail with the
+        # retryable EngineRestartError, so clients resume on another
+        # replica — and the replica stays healthy. Only a REPEAT failure
+        # reports unhealthy, escalating to controller-driven replica
+        # replacement.
+        for eng in self._engines():
+            if not eng.supervise():
+                return False
         fn = getattr(self._user, "check_health", None)
         if fn is not None:
             out = fn()
@@ -415,14 +490,31 @@ class Replica:
         return True
 
     def drain(self, timeout_s: float = 5.0) -> bool:
-        """Graceful shutdown: wait for in-flight requests to finish."""
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        """Graceful shutdown (controller teardown / scale-down / health
+        replacement path): stop admissions — new requests push back with
+        the retryable :class:`ReplicaDrainingError` so routers re-pick —
+        drain every DecodeEngine (queued requests fail retryably at
+        once, running lanes finish, stragglers fail retryably at the
+        deadline so clients resume elsewhere), then wait for the
+        remaining in-flight requests. Returns True when everything
+        finished inside the budget; False means stragglers were failed
+        retryably. Idempotent. The drain counter/duration metrics are
+        observed by the CONTROLLER around this RPC — a replica about to
+        be killed may never ship its final metrics snapshot."""
+        t0 = time.time()
+        deadline = t0 + max(float(timeout_s), 0.0)
+        with self._lock:
+            self._draining = True
+            self._drains += 1
+        for eng in self._engines():
+            eng.drain(max(deadline - time.time(), 0.0))
+        while True:
             with self._lock:
-                if self._ongoing == 0:
-                    return True
+                ok = self._ongoing == 0
+            if ok or time.time() >= deadline:
+                break
             time.sleep(0.01)
-        return False
+        return ok
 
 
 def _resolve_handles(app_name: str, obj):

@@ -44,7 +44,8 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from ..util import tracing
 from .request import (RequestDeadlineExceeded, deadline_expired,
-                      get_request_deadline, get_request_deployment)
+                      get_request_deadline, get_request_deployment,
+                      get_request_resume_from)
 
 
 def default_buckets(max_batch_size: int) -> List[int]:
@@ -465,6 +466,12 @@ def _decorate_continuous(fn, page_size: Optional[int] = None,
             engine.ensure_paging(page_size=page_size,
                                  prefix_cache=prefix_cache)
             configured.add(engine)
+        # Mid-stream failover replay token: a resumed request (its first
+        # replica died after delivering n tokens) replays the SAME
+        # deterministic generation here with the delivered prefix
+        # suppressed — stamped by the router, carried by the replica's
+        # request context.
+        kw.setdefault("resume_from", get_request_resume_from())
         lane = engine.submit(deadline_s=get_request_deadline(),
                              trace_ctx=tracing.current_context(), **kw)
         return _EngineStream(lane)

@@ -73,11 +73,36 @@ by a host-side allocator:
 Flat slots remain the default; paged engines are asserted
 token-identical to flat (temp 0 AND seeded temp > 0) in
 ``tests/test_serve_engine_paged.py``.
+
+**Crash-safe streaming** (ISSUE 7 tentpole): the recompute-preemption
+replay above generalizes ACROSS engines — a stream is fully determined
+by (prompt, sampling knobs, seed, delivered-token count), so any engine
+holding the same weights can reconstruct a lane killed elsewhere:
+``submit(resume_from=n)`` replays the generation and suppresses the
+first ``n`` tokens (on a paged engine whose prefix cache holds the
+prompt, the replay prefill is near-free). The serve layers lean on it
+three ways:
+
+- the driver thread stamps a **heartbeat** per dispatch loop;
+  :meth:`supervise` (called from the replica's ``check_health``)
+  detects a dead or wedged driver, fails current lanes with the
+  *retryable* :class:`EngineRestartError` (clients resume on another
+  replica via ``resume_from``), and restarts the driver ONCE before
+  reporting unhealthy — replica replacement is the escalation, not the
+  first response;
+- :meth:`drain` winds an engine down gracefully: admissions stop
+  (``submit`` raises the retryable :class:`EngineShutdownError`, so the
+  router re-picks), running lanes finish, stragglers fail retryably at
+  the deadline;
+- :meth:`inject_fault` arms the chaos harness (driver death / wedge /
+  process kill at token N) driven by ``tests/test_serve_chaos.py`` and
+  ``benchmarks/serve_gpt.py --chaos``.
 """
 from __future__ import annotations
 
 import collections
 import hashlib
+import os
 import queue
 import threading
 import time
@@ -136,7 +161,21 @@ class _Slot:
 
 
 class EngineShutdownError(RuntimeError):
-    """The engine stopped while this request was queued or decoding."""
+    """The engine stopped while this request was queued or decoding.
+
+    Retryable: the request state (prompt, knobs, seed, delivered count)
+    fully determines the stream, so the router re-picks another replica
+    — mid-stream via ``resume_from`` replay — instead of surfacing a
+    hard failure or marking the replica dead."""
+
+    retryable = True
+
+
+class EngineRestartError(EngineShutdownError):
+    """The engine's driver thread died or wedged; its lanes were failed
+    and the driver restarted (or is awaiting replica replacement).
+    Retryable like :class:`EngineShutdownError` — resumed streams replay
+    deterministically on whichever replica admits them next."""
 
 
 class _PagePool:
@@ -302,7 +341,9 @@ class DecodeEngine:
                  prompt_buckets: Optional[Sequence[int]] = None,
                  deployment: str = "", auto_start: bool = True,
                  paged: bool = False, page_size: int = 16,
-                 n_pages: int = 0, prefix_cache: bool = True):
+                 n_pages: int = 0, prefix_cache: bool = True,
+                 wedge_timeout_s: float = 30.0,
+                 max_driver_restarts: int = 1):
         from ..models import gpt_decode
 
         self.params = params
@@ -355,9 +396,24 @@ class DecodeEngine:
                        "peak_active": 0, "prefix_hits": 0,
                        "prefix_tokens_reused": 0, "cow_copies": 0,
                        "admissions_deferred": 0, "lane_parks": 0,
-                       "preempted": 0}
+                       "preempted": 0, "resumed": 0, "driver_restarts": 0}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # ---- driver supervision (ISSUE 7): the driver stamps _beat at
+        # every loop iteration; supervise() treats a stale beat from a
+        # live thread as a wedge (stuck dispatch / stuck user fault) and
+        # a dead thread as a crash. Each driver run gets an epoch — a
+        # wedged thread that wakes after a restart finds the epoch moved
+        # and drops its results instead of corrupting the new pool.
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.max_driver_restarts = int(max_driver_restarts)
+        self._beat = time.monotonic()
+        self._epoch = 0
+        self._shutdown = False
+        self._supervise_lock = threading.Lock()
+        #: Chaos-harness fault armed via inject_fault() (testing only).
+        self._fault: Optional[dict] = None
+        self._throttle_s = 0.0
         if auto_start:
             self.start()
 
@@ -458,14 +514,28 @@ class DecodeEngine:
     def submit(self, prompt, max_new: int, *,
                deadline_s: Optional[float] = None,
                trace_ctx: Optional[dict] = None,
-               seed: int = 0) -> _StreamLane:
+               seed: int = 0, resume_from: int = 0) -> _StreamLane:
         """Enqueue one request; returns its stream lane immediately. The
         driver admits it at the next chunk boundary with a free slot.
-        Safe from any thread."""
+        Safe from any thread.
+
+        ``resume_from=n`` is the mid-stream failover replay token: the
+        caller already holds the first ``n`` tokens of this exact
+        (prompt, knobs, seed) stream — delivered by another replica
+        before it died — so the engine replays the generation (the
+        per-request PRNG lane is deterministic; a paged engine's prefix
+        cache makes the prompt prefill near-free) and suppresses the
+        first ``n`` tokens from the lane."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         S = prompt.shape[0]
         if S < 1:
             raise ValueError("empty prompt")
+        resume_from = int(resume_from)
+        if resume_from < 0 or resume_from > max_new:
+            raise ValueError(
+                f"resume_from {resume_from} outside [0, max_new="
+                f"{max_new}] — the replay token counts tokens this "
+                f"stream already delivered")
         bucket = next((b for b in self.prompt_buckets if b >= S), None)
         if bucket is None:
             raise ValueError(
@@ -482,15 +552,20 @@ class DecodeEngine:
         with self._admit_lock:
             # _draining (not thread-aliveness) is the admission gate: a
             # not-yet-started engine (auto_start=False) queues work for
-            # start(), while a shut-down or crashed driver — which
-            # flipped _draining in _fail_all — rejects instead of
-            # accepting submissions nobody will ever read.
+            # start(), while a shut-down, draining, or crashed driver —
+            # which flipped _draining in _fail_all — rejects (retryably:
+            # the router re-picks) instead of accepting submissions
+            # nobody will ever read.
             if self._draining:
-                raise EngineShutdownError("engine is not running")
+                raise EngineShutdownError(
+                    "engine is not accepting requests (draining or shut "
+                    "down); resubmit on another replica")
             self._queue.put(_EngineRequest(
                 prompt=prompt, bucket=bucket, max_new=int(max_new),
                 lane=lane, deadline_s=deadline_s, trace_ctx=trace_ctx,
-                seed=int(seed), enq_t=time.time()))
+                seed=int(seed), enq_t=time.time(), skip=resume_from))
+        if resume_from:
+            self._count(resumed=1)
         return lane
 
     def stream(self, prompt, max_new: int, **kw):
@@ -505,10 +580,12 @@ class DecodeEngine:
             return
         with self._admit_lock:
             self._draining = False
-        self._stop.clear()
+        self._shutdown = False
+        self._stop = threading.Event()
+        self._beat = time.monotonic()
         self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"rt-serve-engine-{self.deployment}")
+            target=self._run, args=(self._stop, self._epoch),
+            daemon=True, name=f"rt-serve-engine-{self.deployment}")
         self._thread.start()
 
     def shutdown(self, timeout_s: float = 5.0):
@@ -519,6 +596,7 @@ class DecodeEngine:
         startup would otherwise leave queued submissions hanging
         forever, so the drain repeats here (idempotent: the queue is
         drained once, double error puts on a lane are inert)."""
+        self._shutdown = True
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout_s)
@@ -529,6 +607,143 @@ class DecodeEngine:
         alive = self._thread is not None and self._thread.is_alive()
         self._fail_all(EngineShutdownError("engine shut down"),
                        free_state=not alive)
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful wind-down (replica teardown path): stop admissions
+        NOW — ``submit`` raises the retryable
+        :class:`EngineShutdownError`, so routers re-pick another replica
+        — fail queued-but-unstarted requests the same way (they have no
+        delivered state; the retry is a fresh stream), let RUNNING lanes
+        finish, and fail stragglers retryably at the deadline (clients
+        resume elsewhere via ``resume_from``). Returns True when every
+        lane finished inside the budget. The driver keeps running — the
+        caller tears the replica down afterwards."""
+        with self._admit_lock:
+            self._draining = True
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        while time.monotonic() < deadline:
+            if not any(s is not None for s in self._state) \
+                    and not self._queue.qsize() and not self._pending:
+                return True
+            time.sleep(0.01)
+        alive = self._thread is not None and self._thread.is_alive()
+        self._fail_all(
+            EngineShutdownError(
+                f"engine drained with lanes still running after "
+                f"{timeout_s:.1f}s; resubmit to resume"),
+            free_state=not alive)
+        return False
+
+    def supervise(self) -> bool:
+        """Driver health verdict, with a one-shot recovery: True while
+        the driver is alive and beating (or deliberately stopped); on
+        the FIRST death/wedge, fail current lanes with the retryable
+        :class:`EngineRestartError` (clients resume on another replica),
+        restart the driver, and still report True — the replica stays.
+        A second death/wedge reports False, escalating to
+        controller-driven replica replacement. Called from the replica's
+        ``check_health``; safe from any thread."""
+        with self._supervise_lock:
+            t = self._thread
+            if t is None or self._shutdown:
+                # Never started (auto_start=False) or deliberately shut
+                # down: not a health signal.
+                return True
+            alive = t.is_alive()
+            beat_age = time.monotonic() - self._beat
+            wedged = alive and beat_age > self.wedge_timeout_s
+            if alive and not wedged:
+                return True
+            with self._stats_lock:
+                restarts = self._stats["driver_restarts"]
+            if restarts >= self.max_driver_restarts:
+                return False
+            self._restart_driver(
+                f"driver wedged (no heartbeat for {beat_age:.1f}s)"
+                if wedged else "driver thread died")
+            return True
+
+    def _restart_driver(self, reason: str):
+        """Supervisor recovery: retire the current driver epoch (a
+        wedged thread that later wakes drops its results at the epoch
+        guards), fail its lanes retryably, rebuild EVERY pool structure
+        fresh — the old thread may still hold the old ones mid-dispatch
+        — and start a new driver."""
+        exc = EngineRestartError(
+            f"engine driver restarted ({reason}); resubmit to resume")
+        old_stop = self._stop
+        old_stop.set()            # the old thread exits when it wakes
+        with self._fail_lock:
+            # Lanes error retryably; state/pages are NOT freed into the
+            # old structures (the wedged thread may still be touching
+            # them) — the rebuild below replaces them wholesale.
+            self._fail_all_locked(exc, free_state=False)
+            self._epoch += 1
+            self._build_pool(self.paged, self.page_size or 16,
+                             self.n_pages, self._prefix is not None)
+            self._state = [None] * self.slots
+            self._token = np.zeros((self.slots,), np.int32)
+            self._rngs = np.zeros((self.slots, 2), np.uint32)
+            self._pending = collections.deque()
+            self._queue = queue.SimpleQueue()
+        self._count(driver_restarts=1)
+        from .._private.metrics import serve_metrics
+        serve_metrics()["engine_driver_restarts"].inc(
+            labels={"deployment": self.deployment})
+        self._thread = None
+        self.start()
+
+    def inject_fault(self, kind: str = "driver_die", at_tokens: int = 0,
+                     wedge_s: float = 0.0):
+        """Arm ONE chaos fault on the driver (testing only), triggered
+        at the next loop boundary once ``at_tokens`` tokens have been
+        delivered:
+
+        - ``kind="driver_die"``: the driver thread raises — lanes fail
+          with the retryable :class:`EngineRestartError`, clients resume
+          elsewhere, and :meth:`supervise` restarts the driver once.
+        - ``kind="driver_wedge"`` (with ``wedge_s``): the driver stalls
+          without heartbeating, simulating a stuck dispatch; supervise
+          detects the stale beat and recovers as above.
+        - ``kind="kill_process"``: hard ``os._exit`` — the whole replica
+          worker dies mid-stream, exercising the actor-death retry path.
+        - ``kind="driver_slow"`` (with ``wedge_s``): a PERSISTENT
+          per-loop stall of ``wedge_s`` seconds (heartbeat still beats)
+          — simulates a heavily loaded device so chaos tests can
+          interleave kills with a stream that is reliably mid-flight.
+        """
+        if kind not in ("driver_die", "driver_wedge", "kill_process",
+                        "driver_slow"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if kind == "driver_slow":
+            self._throttle_s = float(wedge_s)
+            return
+        self._fault = {"kind": kind, "at_tokens": int(at_tokens),
+                       "wedge_s": float(wedge_s)}
+
+    def _check_fault(self):
+        """Driver-loop fault point (no-op unless armed; one-shot except
+        the persistent ``driver_slow`` throttle)."""
+        throttle = getattr(self, "_throttle_s", 0.0)
+        if throttle:
+            time.sleep(throttle)
+        f = self._fault
+        if f is None:
+            return
+        with self._stats_lock:
+            toks = self._stats["tokens"]
+        if toks < f["at_tokens"]:
+            return
+        self._fault = None
+        if f["kind"] == "driver_wedge":
+            # Stall WITHOUT beating: supervise() sees a live thread with
+            # a stale heartbeat — the wedge signature.
+            time.sleep(f["wedge_s"])
+        elif f["kind"] == "kill_process":
+            os._exit(43)
+        else:
+            raise RuntimeError(
+                f"injected engine driver death at {toks} tokens")
 
     def stats(self) -> dict:
         with self._stats_lock:
@@ -542,6 +757,10 @@ class DecodeEngine:
             (out["dispatches"] + out["prefills"]) / max(out["tokens"], 1))
         out["paged"] = self.paged
         out["deployment"] = self.deployment
+        t = self._thread
+        out["driver_alive"] = bool(t is not None and t.is_alive())
+        out["heartbeat_age_s"] = round(time.monotonic() - self._beat, 3)
+        out["draining"] = self._draining
         if self.paged:
             out["page_size"] = self.page_size
             out["n_pages"] = self.n_pages
@@ -565,10 +784,19 @@ class DecodeEngine:
                 self._stats[k] += v
 
     # ---------------------------------------------------------- driver loop
-    def _run(self):
+    def _run(self, stop: threading.Event, epoch: int):
         try:
-            while not self._stop.is_set():
-                self._admit_pending()
+            while not stop.is_set():
+                # Heartbeat BEFORE any work: supervise() reads its age
+                # to tell a wedged dispatch from a live idle loop.
+                self._beat = time.monotonic()
+                self._check_fault()
+                if stop.is_set():
+                    # Woke from a wedge (fault sleep / stuck dispatch)
+                    # to find the supervisor restarted past this run:
+                    # exit before touching the rebuilt structures.
+                    break
+                self._admit_pending(epoch)
                 if not any(s is not None for s in self._state):
                     if self._pending:
                         # Deferred head with an empty pool and ZERO
@@ -584,43 +812,62 @@ class DecodeEngine:
                     except queue.Empty:
                         continue
                     continue  # boundary: admission pass first
-                self._dispatch_chunk()
-            self._fail_all(EngineShutdownError("engine shut down"))
+                self._dispatch_chunk(epoch)
+            self._fail_all(EngineShutdownError("engine shut down"),
+                           epoch=epoch)
         except BaseException as e:  # noqa: BLE001 - driver died: fan out
-            self._fail_all(e)
+            # An unexpected driver death is RECOVERABLE for the lanes —
+            # their streams replay deterministically elsewhere — so they
+            # fail with the retryable restart error, not the raw cause.
+            if not isinstance(e, EngineShutdownError):
+                exc: BaseException = EngineRestartError(
+                    f"engine driver died: {e!r}; resubmit to resume")
+                exc.__cause__ = e
+            else:
+                exc = e
+            self._fail_all(exc, epoch=epoch)
             raise
 
-    def _fail_all(self, exc: BaseException, free_state: bool = True):
+    def _fail_all(self, exc: BaseException, free_state: bool = True,
+                  epoch: Optional[int] = None):
         """Fail every queued / in-flight lane with ``exc``.
 
         ``free_state=False`` (shutdown racing a still-alive driver)
         only PUTS errors — slot state, the pending deque, and the page
         pool stay driver-owned, so refcounts drop exactly once when the
         driver's own exit path runs this with ``free_state=True``.
-        Double error puts on a lane are inert."""
-        with self._admit_lock:
-            self._draining = True    # no put can land past this point
+        ``epoch`` (driver exit paths) makes the call a no-op when the
+        supervisor already retired that driver's run — a late exit must
+        not fail the RESTARTED engine's lanes. Double error puts on a
+        lane are inert."""
         # Serialized: shutdown() calls this unconditionally (covering a
         # dead/never-started driver) and may race the dying driver's own
         # exit path — page refcounts must only drop once per slot.
         with self._fail_lock:
-            for i, st in enumerate(self._state):
-                if st is not None:
-                    st.lane.q.put(("err", exc))
-                    if free_state:
-                        self._free_slot(i)
-            if free_state:
-                while self._pending:
-                    self._pending.popleft().lane.q.put(("err", exc))
-            else:
-                for req in list(self._pending):
-                    req.lane.q.put(("err", exc))
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    return
+            if epoch is not None and epoch != self._epoch:
+                return           # stale driver: its lanes already moved
+            self._fail_all_locked(exc, free_state)
+
+    def _fail_all_locked(self, exc: BaseException, free_state: bool):
+        with self._admit_lock:
+            self._draining = True    # no put can land past this point
+        for i, st in enumerate(self._state):
+            if st is not None:
+                st.lane.q.put(("err", exc))
+                if free_state:
+                    self._free_slot(i)
+        if free_state:
+            while self._pending:
+                self._pending.popleft().lane.q.put(("err", exc))
+        else:
+            for req in list(self._pending):
                 req.lane.q.put(("err", exc))
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.lane.q.put(("err", exc))
 
     def _free_slot(self, i: int):
         """Release slot i: page references drop (pages whose last ref
@@ -632,14 +879,20 @@ class DecodeEngine:
             self._pt[i, :] = self._gd.PT_SENTINEL
         self._state[i] = None
 
-    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+    def _alloc_pages(self, n: int, pool: Optional[_PagePool] = None,
+                     prefix: Optional[_PrefixCache] = None
+                     ) -> Optional[List[int]]:
         """Allocate n pages, evicting LRU prefix-cache entries while
         short. None = genuinely out (every page pinned by live lanes) —
-        the caller defers or parks, never clamps."""
-        while self._pool.available() < n:
-            if self._prefix is None or not self._prefix.evict_lru():
+        the caller defers or parks, never clamps. ``pool``/``prefix``
+        let an in-flight admission keep ONE consistent snapshot across
+        a supervisor restart (default: the engine's current ones)."""
+        pool = self._pool if pool is None else pool
+        prefix = self._prefix if prefix is None else prefix
+        while pool.available() < n:
+            if prefix is None or not prefix.evict_lru():
                 return None
-        return self._pool.alloc(n)
+        return pool.alloc(n)
 
     def _observe_pages(self, sm=None):
         if not self.paged:
@@ -652,17 +905,32 @@ class DecodeEngine:
         sm["engine_pages_free"].set(free, labels=labels)
         sm["engine_pages_used"].set(self.n_pages - free, labels=labels)
 
-    def _admit_pending(self):
+    def _admit_pending(self, epoch: int = -1):
         """Chunk-boundary admission: fill every free slot in FIFO order.
         Expired / abandoned requests are failed out without spending a
         prefill; a paged admission that cannot get pages DEFERS — it
         stays at the queue head (order preserved) and retries next
         boundary, by which time a lane may have freed pages."""
+        if epoch >= 0 and epoch != self._epoch:
+            # Stale driver (the supervisor restarted past it while it
+            # was blocked mid-iteration): every structure it can see is
+            # the NEW driver's — touching them would race the live
+            # admission pass or discard its requests.
+            return
         while True:
             try:
                 self._pending.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        if self._draining and self._pending:
+            # Draining: queued-but-unstarted requests fail retryably NOW
+            # (no delivered state — the retry is a fresh stream on
+            # another replica) while running lanes ride to completion.
+            exc = EngineShutdownError(
+                "engine draining; resubmit on another replica")
+            while self._pending:
+                self._pending.popleft().lane.q.put(("err", exc))
+            return
         # Cull dead entries EVERYWHERE in the deque first — deferral
         # under page pressure must not delay a deadline error that
         # costs nothing to deliver. In-place rotation keeps FIFO order.
@@ -688,15 +956,25 @@ class DecodeEngine:
             # the pool would thrash prefills instead of progressing.
             return
         while self._pending and any(s is None for s in self._state):
-            if not self._admit_one(self._pending[0]):
+            admitted = self._admit_one(self._pending[0], epoch)
+            if epoch >= 0 and epoch != self._epoch:
+                # The supervisor restarted past this driver WHILE its
+                # prefill was blocked on the device: the deque now holds
+                # the new driver's requests — popping would silently
+                # discard one (its lane would hang to its deadline).
+                return
+            if not admitted:
                 self._count(admissions_deferred=1)
                 return               # out of pages: keep FIFO, back off
             self._pending.popleft()
 
-    def _admit_one(self, req: _EngineRequest) -> bool:
+    def _admit_one(self, req: _EngineRequest, epoch: int = -1) -> bool:
         """Prefill ``req`` into a free slot; returns False to defer
         (paged mode, no pages). Lane-closed/expired checks happen in
-        :meth:`_admit_pending` before any resources are taken."""
+        :meth:`_admit_pending` before any resources are taken. A stale
+        driver (the supervisor restarted past it while its prefill was
+        stuck on the device) drops the result at the epoch guard instead
+        of writing into the rebuilt pool."""
         from .._private.metrics import serve_metrics
 
         slot = next(i for i, s in enumerate(self._state) if s is None)
@@ -705,7 +983,7 @@ class DecodeEngine:
         P = req.prompt.shape[0]
         sm = serve_metrics()
         if self.paged:
-            admitted = self._prefill_paged(req, slot, P, sm, jax)
+            admitted = self._prefill_paged(req, slot, P, sm, jax, epoch)
             if admitted is None:
                 return False
             first, pages, t_admit = admitted
@@ -713,10 +991,13 @@ class DecodeEngine:
             t_admit = time.time()
             padded = np.zeros((1, req.bucket), np.int32)
             padded[0, :P] = req.prompt
-            tok, self._cache, key = self._prefill(
+            tok, cache, key = self._prefill(
                 self.params, self._cache, padded, np.int32(P),
                 np.int32(slot), jax.random.PRNGKey(req.seed))
             first = int(np.asarray(tok))
+            if epoch >= 0 and epoch != self._epoch:
+                return True          # stale driver: drop on the floor
+            self._cache = cache
             self._rngs[slot] = np.asarray(key)
             pages = []
         sm["engine_admission_wait"].observe(
@@ -754,18 +1035,25 @@ class DecodeEngine:
         return True
 
     def _prefill_paged(self, req: _EngineRequest, slot: int, P: int,
-                       sm, jax
+                       sm, jax, epoch: int = -1
                        ) -> Optional[Tuple[int, List[int], float]]:
         """Paged admission: map the cached prefix (refcounted, COW fork
         if it ends mid-page), allocate fresh pages for the suffix,
         prefill ONLY the suffix, then register the prompt's pages in the
         prefix cache. Returns None (nothing taken) when pages are
-        unavailable even after LRU eviction."""
+        unavailable even after LRU eviction — or when a supervisor
+        restart retired this driver's epoch while its prefill ran (the
+        stale result must not touch the rebuilt pool)."""
         gd = self._gd
         ps = self.page_size
+        # ONE pool/prefix snapshot for the whole admission: a supervisor
+        # restart swaps self._pool wholesale, and page accounting split
+        # across two pool objects would corrupt both free lists.
+        pool = self._pool
+        prefix = self._prefix
         hist, shared_pages = (0, [])
-        if self._prefix is not None:
-            hist, shared_pages = self._prefix.lookup(req.prompt)
+        if prefix is not None:
+            hist, shared_pages = prefix.lookup(req.prompt)
         shared_full = hist // ps
         partial = hist % ps
         cow_src = shared_pages[shared_full] if partial else \
@@ -773,15 +1061,15 @@ class DecodeEngine:
         shared = shared_pages[:shared_full]
         # Pin everything we read BEFORE eviction-driven allocation can
         # free it from under us.
-        self._pool.ref(shared)
+        pool.ref(shared)
         if partial:
-            self._pool.ref([cow_src])
+            pool.ref([cow_src])
         n_fresh = -(-P // ps) - shared_full
-        fresh = self._alloc_pages(n_fresh)
+        fresh = self._alloc_pages(n_fresh, pool, prefix)
         if fresh is None:
-            self._pool.unref(shared)
+            pool.unref(shared)
             if partial:
-                self._pool.unref([cow_src])
+                pool.unref([cow_src])
             return None
         pages = shared + fresh
         t_admit = time.time()
@@ -793,16 +1081,27 @@ class DecodeEngine:
         pt_row = np.full((self.max_pages,), gd.PT_SENTINEL, np.int32)
         pt_row[:len(pages)] = pages
         self._pt[slot] = pt_row
-        tok, self._cache, key = self._prefill(
+        tok, cache, key = self._prefill(
             self.params, self._cache, padded, np.int32(sl),
             np.int32(hist), pt_row, np.int32(cow_src), np.int32(slot),
             jax.random.PRNGKey(req.seed))
         first = int(np.asarray(tok))
+        if epoch >= 0 and epoch != self._epoch:
+            # Stale driver: drop the result AND hand back every page
+            # this admission took — against the SAME pool snapshot, so
+            # the accounting stays balanced whether the restart replaced
+            # the pool before or during the admission (a leak here would
+            # shrink the free list forever).
+            pool.unref(pages)
+            if partial:
+                pool.unref([cow_src])
+            return None
+        self._cache = cache
         self._rngs[slot] = np.asarray(key)
         if partial:
             # The fork read src synchronously inside the dispatch above;
             # its pin is no longer needed.
-            self._pool.unref([cow_src])
+            pool.unref([cow_src])
             self._count(cow_copies=1)
             sm["engine_cow_copies"].inc(
                 labels={"deployment": self.deployment})
@@ -810,8 +1109,8 @@ class DecodeEngine:
             self._count(prefix_hits=1, prefix_tokens_reused=hist)
             sm["engine_prefix_hits"].inc(
                 labels={"deployment": self.deployment})
-        if self._prefix is not None:
-            self._prefix.insert(req.prompt, pages)
+        if prefix is not None:
+            prefix.insert(req.prompt, pages)
         return first, pages, t_admit
 
     def _cover_pages(self) -> bool:
@@ -892,11 +1191,20 @@ class DecodeEngine:
         self._observe_pages()
         return False
 
-    def _dispatch_chunk(self):
+    def _dispatch_chunk(self, epoch: int = -1):
         """ONE fused device dispatch decoding every active slot, then
-        per-slot routing/trimming and boundary frees."""
+        per-slot routing/trimming and boundary frees. A stale driver —
+        one whose dispatch was stuck on the device while the supervisor
+        restarted past it — drops the whole result at the post-dispatch
+        epoch guard: its lanes were already failed retryably and the
+        pool rebuilt."""
         from .._private.metrics import serve_metrics
 
+        if epoch >= 0 and epoch != self._epoch:
+            # Stale driver: _cover_pages parks/preempts lanes — running
+            # it against the NEW driver's pool would preempt a healthy
+            # restarted lane.
+            return
         if self.paged and not self._cover_pages():
             return                    # re-run admission/coverage pass
         active = np.array([s is not None and not s.parked
@@ -904,16 +1212,19 @@ class DecodeEngine:
         n_active = int(active.sum())
         t0 = time.time()
         if self.paged:
-            toks, self._cache, _done, rngs = self._step(
+            toks, cache, _done, rngs = self._step(
                 self.params, self._cache, self._token, self._rngs,
                 active, self._pt)
         else:
-            toks, self._cache, _done, rngs = self._step(
+            toks, cache, _done, rngs = self._step(
                 self.params, self._cache, self._token, self._rngs,
                 active)
         toks_np = np.asarray(toks)        # ONE transfer per chunk
         rngs_np = np.asarray(rngs)
         t1 = time.time()
+        if epoch >= 0 and epoch != self._epoch:
+            return                    # stale driver: drop on the floor
+        self._cache = cache
         sm = serve_metrics()
         sm["engine_slot_occupancy"].observe(
             n_active / self.slots, labels={"deployment": self.deployment})

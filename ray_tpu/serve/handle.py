@@ -37,10 +37,11 @@ from ..exceptions import (ActorDiedError, ActorUnavailableError,
                           GetTimeoutError, RayTpuError, TaskError,
                           WorkerCrashedError)
 from ..util import tracing
-from .request import (SUBMITTED_AT_KEY, TRACE_CTX_KEY, BackPressureError,
-                      ReplicaOverloadedError, RequestDeadlineExceeded,
-                      deadline_expired, get_request_deadline,
-                      make_deadline, remaining_s)
+from .request import (RESUME_FROM_KEY, SUBMITTED_AT_KEY, TRACE_CTX_KEY,
+                      BackPressureError, ReplicaOverloadedError,
+                      RequestDeadlineExceeded, deadline_expired,
+                      get_request_deadline, make_deadline, remaining_s,
+                      stream_item_width)
 
 _RETRYABLE_CAUSES = ("ActorDiedError", "ActorUnavailableError",
                      "WorkerCrashedError", "ConnectionLost",
@@ -65,11 +66,25 @@ def _is_replica_failure(e: Exception) -> bool:
     return ACTOR_NOT_ON_WORKER in str(e)
 
 
+#: Typed replica-side pushback: the replica (or its engine) declined or
+#: abandoned the request for a reason that is ROUTING state, not a
+#: failure — overload, a graceful drain, an engine shutdown mid-rolling-
+#: restart, or a supervised driver restart. All of them mean "re-pick
+#: another replica, don't mark this one dead, don't spend retry budget";
+#: membership refresh retires genuinely departing replicas shortly
+#: after. Each class carries ``retryable = True``; the names matter only
+#: once the error has crossed the wire as a TaskError.
+_PUSHBACK_CAUSES = ("ReplicaOverloadedError", "ReplicaDrainingError",
+                    "EngineShutdownError", "EngineRestartError")
+
+
 def _is_overload(e: Exception) -> bool:
-    """Replica-side admission pushback (crosses the wire as TaskError)."""
-    return isinstance(e, ReplicaOverloadedError) or (
-        isinstance(e, TaskError)
-        and getattr(e, "cause_type", "") == "ReplicaOverloadedError")
+    """Replica-side pushback (crosses the wire as TaskError): overload,
+    drain, or a retryable engine shutdown/restart."""
+    if getattr(e, "retryable", False):
+        return True
+    return (isinstance(e, TaskError)
+            and getattr(e, "cause_type", "") in _PUSHBACK_CAUSES)
 
 
 def _is_deadline_error(e: Exception) -> bool:
@@ -288,14 +303,27 @@ class DeploymentResponseGenerator:
     **Retry-before-first-item**: stream setup against a dead or
     saturated replica transparently re-routes — budgeted and
     backoff-spaced like unary retries — as long as no item has been
-    delivered yet. Once the caller holds an item the stream has state on
-    a specific replica and a mid-stream failure raises."""
+    delivered yet.
+
+    **Mid-stream failover** (``resumable=True``): the generator keeps a
+    replay token — the call itself plus the count of tokens already
+    delivered to this caller — so a replica that dies (or drains, or
+    restarts its engine driver) MID-stream no longer kills the stream:
+    the call is resubmitted through the same budgeted retry path with
+    ``resume_from=n``, and the receiving replica replays the
+    deterministic generation suppressing the first ``n`` tokens. The
+    caller sees a stall, then the exact continuation — token-identical
+    to an uninterrupted run at temp 0 and seeded temp > 0. Only enable
+    for DETERMINISTIC streams (seeded engine decodes); a nondeterministic
+    handler would resume onto a different continuation. The resume
+    respects the ORIGINAL deadline and withdraws from the same retry
+    budget as unary retries."""
 
     def __init__(self, router: "Router", rid: str, gen,
                  call: Optional[Tuple[str, tuple, dict]] = None,
                  model_id: str = "", flatten_chunks: bool = False,
                  deadline_s: Optional[float] = None,
-                 t0: Optional[float] = None):
+                 t0: Optional[float] = None, resumable: bool = False):
         self._router = router
         self._rid = rid
         self._gen = gen
@@ -303,6 +331,10 @@ class DeploymentResponseGenerator:
         self._model_id = model_id
         self._flatten_chunks = flatten_chunks
         self._deadline_s = deadline_s
+        self._resumable = resumable
+        #: Replay token: tokens (not items — a chunk slice is several)
+        #: already delivered to the caller.
+        self._delivered = 0
         self._done = False
         self._got_first = False
         self._reroutes = 0
@@ -341,39 +373,38 @@ class DeploymentResponseGenerator:
             except StopIteration:
                 raise
             except Exception as e:  # noqa: BLE001
-                if self._got_first or self._call is None \
+                if self._call is None \
+                        or (self._got_first and not self._resumable) \
                         or not self._reroute(e):
                     self._finish()
                     raise
                 continue
             now = time.perf_counter()
+            # Tokens landed by this arrival (shared width contract with
+            # the replica-side suppression — see stream_item_width).
+            # Empty filler slices (lockstep batch handlers) land nothing
+            # and must not record a bogus 1-token sample.
+            width = stream_item_width(item)
             if not self._got_first:
                 self._got_first = True
                 self._router.budget.record_success()
                 _serve_counters()["ttft"].observe(now - self._t0,
                                                   labels=labels)
-            else:
-                # Tokens landed by this arrival: list/tuple chunk slice
-                # length, ndarray element count (a [B, j] slice is B*j
-                # tokens — len() would say B), else one. Empty filler
-                # slices (lockstep batch handlers) land nothing and
-                # must not record a bogus 1-token sample.
-                if isinstance(item, (list, tuple)):
-                    width = len(item)
-                elif getattr(item, "ndim", 0):
-                    width = int(getattr(item, "size", 1))
-                else:
-                    width = 1
-                if width > 0:
-                    per_token = (now - self._last_item_at) / width
-                    tpot = _serve_counters()["tpot"]
-                    for _ in range(width):
-                        tpot.observe(per_token, labels=labels)
+            elif width > 0:
+                per_token = (now - self._last_item_at) / width
+                tpot = _serve_counters()["tpot"]
+                for _ in range(width):
+                    tpot.observe(per_token, labels=labels)
+            self._delivered += width     # the mid-stream replay token
             self._last_item_at = now
             return item
 
     def _reroute(self, e: Exception) -> bool:
-        """Re-route a not-yet-started stream; True = resubmitted."""
+        """Re-route a not-yet-started stream — or, when ``resumable``, a
+        MID-stream one (the resubmission carries ``resume_from`` = the
+        delivered-token count, so the receiving replica suppresses the
+        replayed prefix). True = resubmitted. A resume never extends the
+        original deadline and spends the same budget as a fresh retry."""
         labels = {"deployment": self._router.deployment_name}
         if deadline_expired(self._deadline_s) or _is_deadline_error(e):
             return False
@@ -382,13 +413,25 @@ class DeploymentResponseGenerator:
             _serve_counters()["overload_repicks"].inc(labels=labels)
         elif _is_replica_failure(e):
             self._router.mark_dead(self._rid)
+        else:
+            return False
+        if self._got_first:
+            # A MID-stream resume is never free, whatever the trigger
+            # (replica death or retryable engine restart/drain/shutdown
+            # pushback): the replay re-prefills real work, so it is
+            # capped and budgeted exactly like a fresh retry — the
+            # documented contract, and the bound that stops a
+            # crash-looping replica from being resubmitted to forever.
+            if self._reroutes >= Router.DEFAULT_MAX_RETRIES \
+                    or not self._router.budget.take():
+                return False
+            self._reroutes += 1
+        elif _is_replica_failure(e):
             if self._reroutes >= Router.DEFAULT_MAX_RETRIES \
                     or not self._router.budget.take():
                 return False
             self._reroutes += 1
             _serve_counters()["retries"].inc(labels=labels)
-        else:
-            return False
         _backoff_sleep(self._backoff, self._deadline_s)
         self._backoff = min(self._backoff * 2, Router.RETRY_BACKOFF_CAP_S)
         method, args, kwargs = self._call
@@ -396,7 +439,8 @@ class DeploymentResponseGenerator:
             rid, gen = self._router._submit_stream_raw(
                 method, args, kwargs, deadline_s=self._deadline_s,
                 model_id=self._model_id,
-                flatten_chunks=self._flatten_chunks)
+                flatten_chunks=self._flatten_chunks,
+                resume_from=self._delivered if self._got_first else 0)
         except Exception:  # noqa: BLE001 - nothing admitted the re-route;
             return False   # _finish() releases the old slot exactly once
         # Old slot released only now: on the failure path mark_dead
@@ -405,6 +449,8 @@ class DeploymentResponseGenerator:
         # _finish() decrement the same slot twice.
         self._router.release(self._rid)
         self._rid, self._gen = rid, gen
+        if self._got_first:
+            _serve_counters()["stream_resumes"].inc(labels=labels)
         return True
 
     def __del__(self):
@@ -421,7 +467,8 @@ class DeploymentHandle:
                  method_name: str = "__call__",
                  multiplexed_model_id: str = "", stream: bool = False,
                  flatten_chunks: bool = False,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 resumable: bool = False):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.method_name = method_name
@@ -436,18 +483,25 @@ class DeploymentHandle:
         # default). The proxy sets this from request_timeout_s so HTTP
         # deadlines propagate end to end.
         self.timeout_s = timeout_s
+        # Mid-stream failover: streams submitted through this handle
+        # survive replica/driver death by deterministic replay with
+        # delivered-prefix suppression. Opt-in, because it requires the
+        # stream to be a deterministic function of the call (seeded
+        # engine decodes are; an unseeded sampling handler is not).
+        self.resumable = resumable
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.app_name, self.deployment_name, self.method_name,
                  self.multiplexed_model_id, self.stream,
-                 self.flatten_chunks, self.timeout_s))
+                 self.flatten_chunks, self.timeout_s, self.resumable))
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
                 stream: Optional[bool] = None,
                 flatten_chunks: Optional[bool] = None,
-                timeout_s: Optional[float] = None) -> "DeploymentHandle":
+                timeout_s: Optional[float] = None,
+                resumable: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self.method_name,
@@ -456,14 +510,16 @@ class DeploymentHandle:
             self.stream if stream is None else stream,
             self.flatten_chunks if flatten_chunks is None
             else flatten_chunks,
-            self.timeout_s if timeout_s is None else timeout_s)
+            self.timeout_s if timeout_s is None else timeout_s,
+            self.resumable if resumable is None else resumable)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.app_name, self.deployment_name, name,
                                 self.multiplexed_model_id, self.stream,
-                                self.flatten_chunks, self.timeout_s)
+                                self.flatten_chunks, self.timeout_s,
+                                self.resumable)
 
     def remote(self, *args, **kwargs):
         router = get_router(self.app_name, self.deployment_name)
@@ -471,7 +527,8 @@ class DeploymentHandle:
             return router.submit_stream(self.method_name, args, kwargs,
                                         timeout_s=self.timeout_s,
                                         model_id=self.multiplexed_model_id,
-                                        flatten_chunks=self.flatten_chunks)
+                                        flatten_chunks=self.flatten_chunks,
+                                        resumable=self.resumable)
         return router.submit(self.method_name, args, kwargs,
                              timeout_s=self.timeout_s,
                              model_id=self.multiplexed_model_id)
@@ -728,23 +785,29 @@ class Router:
 
     def _submit_stream_raw(self, method_name: str, args: tuple, kwargs: dict,
                            deadline_s: Optional[float], model_id: str,
-                           flatten_chunks: bool) -> Tuple[str, Any]:
+                           flatten_chunks: bool,
+                           resume_from: int = 0) -> Tuple[str, Any]:
         """Admission + dispatch for one stream attempt; returns
         (rid, core streaming generator). Shared by first submission and
-        the generator's retry-before-first-item re-routes."""
+        the generator's re-routes. ``resume_from`` is the mid-stream
+        replay token: the receiving replica replays the deterministic
+        stream and suppresses that many already-delivered tokens."""
         rid, handle = self._acquire(deadline_s, model_id)
         ctx = self._request_ctx(deadline_s)
         if model_id:
             ctx["multiplexed_model_id"] = model_id
         if flatten_chunks:
             ctx["flatten_chunks"] = True
+        if resume_from:
+            ctx[RESUME_FROM_KEY] = int(resume_from)
         gen = handle.handle_request_streaming.options(
             num_returns="streaming").remote(method_name, args, kwargs, ctx)
         return rid, gen
 
     def submit_stream(self, method_name: str, args: tuple, kwargs: dict,
                       timeout_s: Optional[float] = None, model_id: str = "",
-                      flatten_chunks: bool = False
+                      flatten_chunks: bool = False,
+                      resumable: bool = False
                       ) -> "DeploymentResponseGenerator":
         """Streaming dispatch: same admission + pow-2 pick as submit(),
         but the replica call rides the core streaming-generator
@@ -761,7 +824,7 @@ class Router:
         return DeploymentResponseGenerator(
             self, rid, gen, call=(method_name, args, kwargs),
             model_id=model_id, flatten_chunks=flatten_chunks,
-            deadline_s=deadline_s, t0=t0)
+            deadline_s=deadline_s, t0=t0, resumable=resumable)
 
     def release(self, rid: str):
         """Return one in-flight slot (stream finished or abandoned)."""

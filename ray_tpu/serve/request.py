@@ -39,6 +39,22 @@ class ReplicaOverloadedError(RayTpuError):
     The router treats this as "re-pick another replica, don't mark this
     one dead" — overload is a routing signal, not a failure."""
 
+    #: Routing signal, not a failure: the router may resubmit elsewhere
+    #: without marking the replica dead. Mirrored by the drain/restart
+    #: errors (``ReplicaDrainingError``, ``EngineShutdownError``,
+    #: ``EngineRestartError``) so one marker covers every
+    #: re-pick-don't-bury pushback.
+    retryable = True
+
+
+class ReplicaDrainingError(RayTpuError):
+    """Typed drain pushback: the replica stopped admitting because it is
+    being torn down (reconfigure, scale-down, health replacement). Like
+    overload, this is a routing signal — the router re-picks another
+    replica; membership refresh drops the draining one shortly after."""
+
+    retryable = True
+
 
 class BackPressureError(RayTpuError):
     """Every replica is saturated and the pending queue is past its bound;
@@ -104,6 +120,45 @@ def get_request_deployment() -> Optional[str]:
 #: foreign threads (the batcher) can join the request's trace.
 TRACE_CTX_KEY = "trace_ctx"
 SUBMITTED_AT_KEY = "submitted_at"
+#: Mid-stream failover replay token (count of tokens the caller already
+#: holds): a resumed stream re-executes the SAME deterministic call and
+#: the serving side suppresses the first ``resume_from`` tokens, so the
+#: client's concatenated stream is token-identical to an uninterrupted
+#: run. Stamped by ``DeploymentResponseGenerator`` on re-route after a
+#: mid-stream replica failure.
+RESUME_FROM_KEY = "resume_from"
+
+
+#: Tokens already delivered to the caller of the request being handled
+#: on this thread (0 for a fresh stream). Set by the replica around user
+#: code; the continuous-batching wrapper forwards it into
+#: ``DecodeEngine.submit(resume_from=...)`` so the engine replays the
+#: delivered prefix deterministically and suppresses it.
+_request_resume_from: "contextvars.ContextVar[int]" = \
+    contextvars.ContextVar("rt_serve_request_resume_from", default=0)
+
+
+def get_request_resume_from() -> int:
+    """Delivered-token count of the stream being resumed on this thread
+    (0 outside a resumed stream)."""
+    return _request_resume_from.get()
+
+
+def stream_item_width(item) -> int:
+    """Tokens carried by ONE stream item: list/tuple chunk slice →
+    its length, ndarray slice → its element count (a ``[B, j]`` slice
+    is B*j tokens — ``len()`` would say B), anything else → 1.
+
+    This is the single shared definition behind the replay token: the
+    caller-side generator COUNTS delivered tokens with it and the
+    replica-side fallback SUPPRESSES that many on resume — if the two
+    ever classified an item differently, a resumed stream would
+    duplicate or swallow tokens."""
+    if isinstance(item, (list, tuple)):
+        return len(item)
+    if getattr(item, "ndim", 0):
+        return int(getattr(item, "size", 1))
+    return 1
 
 
 @dataclass

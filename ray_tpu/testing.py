@@ -218,6 +218,56 @@ def get_replica_invocation_logs(app_name: str, deployment_name: str) -> list:
     return out
 
 
+def inject_engine_fault(app_name: str, deployment_name: str, *,
+                        kind: str = "driver_die", at_tokens: int = 0,
+                        wedge_s: float = 0.0, rid: str = None) -> list:
+    """Arm ONE chaos fault on the DecodeEngines of a serve deployment
+    (the ISSUE 7 fault points): triggered at the driver's next loop
+    boundary once ``at_tokens`` tokens have been delivered.
+
+    - ``kind="driver_die"``: the engine driver thread raises — lanes
+      fail with the retryable ``EngineRestartError``, clients resume on
+      another replica, and the replica's ``check_health`` restarts the
+      driver once before escalating.
+    - ``kind="driver_wedge"`` (with ``wedge_s``): the driver stalls
+      without heartbeating — ``check_health`` detects the stale beat.
+    - ``kind="kill_process"``: hard ``os._exit`` of the replica worker —
+      kill-at-token-N, the realistic mid-stream replica crash.
+
+    ``rid`` targets one replica; default arms every live replica.
+    Returns the replica ids armed."""
+    import ray_tpu as rt
+
+    handles = _serve_replica_handles(app_name, deployment_name)
+    if rid is not None:
+        handles = {rid: handles[rid]}
+    armed = []
+    for r, h in handles.items():
+        n = rt.get(h.inject_engine_fault.remote(kind, at_tokens, wedge_s),
+                   timeout=10)
+        if n:
+            armed.append(r)
+    return armed
+
+
+def drain_replicas(app_name: str, deployment_name: str,
+                   timeout_s: float = 5.0) -> dict:
+    """Invoke the graceful drain on every live replica of a deployment
+    (admissions stop with retryable pushback, running engine lanes
+    finish, stragglers fail retryably). Returns {rid: drained_clean}."""
+    import ray_tpu as rt
+
+    handles = _serve_replica_handles(app_name, deployment_name)
+    refs = {r: h.drain.remote(timeout_s) for r, h in handles.items()}
+    out = {}
+    for r, ref in refs.items():
+        try:
+            out[r] = bool(rt.get(ref, timeout=timeout_s + 10))
+        except Exception:  # noqa: BLE001 - replica died mid-drain
+            out[r] = False
+    return out
+
+
 class ReplicaKiller:
     """Serve-aware sibling of ``WorkerKiller``: kills random replica
     ACTORS of one deployment while traffic runs, exercising the serve
