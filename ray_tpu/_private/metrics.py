@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import contextlib
 import os
-import re
 import threading
 import time
 import warnings
@@ -23,31 +22,47 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 LabelPairs = Tuple[Tuple[str, str], ...]
 
-#: Prometheus metric-name grammar (data model spec).
-_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-#: A histogram whose name suggests it measures time must carry the
-#: canonical ``_seconds`` unit suffix.
-_DURATION_HINTS = ("duration", "latency", "wait", "elapsed", "_time",
-                   "ttft", "tpot")
+
+def _load_shared_name_lint():
+    """The metric naming lint is SHARED with the static analyzer:
+    ``tools/rtlint/metrics_names.py`` is the single implementation, and
+    rtlint rule RT106 applies it to every Counter/Gauge/Histogram
+    construction site while :meth:`MetricsRegistry.register` applies it
+    at runtime — one function, two call sites, no drift.
+
+    The module is loaded BY FILE PATH (``tools/`` sits next to the
+    ``ray_tpu`` package in this repo): importing the ``tools.rtlint``
+    package here would execute its ``__init__`` and drag the whole
+    analyzer into every ray_tpu process — metrics_names.py is
+    deliberately dependency-free so this load stays a single stdlib-only
+    exec. The package import is only the fallback (installed layouts
+    that relocated the file). If neither works, the lint degrades to a
+    no-op with a warning rather than breaking ``ray_tpu`` at import."""
+    try:
+        import importlib.util
+
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        path = os.path.join(root, "tools", "rtlint", "metrics_names.py")
+        spec = importlib.util.spec_from_file_location(
+            "_rt_shared_metrics_names", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.lint_metric_name
+    except Exception:  # noqa: BLE001 - fall through to package import
+        pass
+    try:
+        from tools.rtlint.metrics_names import lint_metric_name
+        return lint_metric_name
+    except Exception:  # noqa: BLE001 - packaged without tools/: degrade
+        warnings.warn(
+            "tools/rtlint/metrics_names.py not found; metric naming "
+            "lint disabled (run rtlint from the source tree instead)")
+        return lambda name, kind: []
 
 
-def lint_metric_name(name: str, kind: str) -> List[str]:
-    """Prometheus naming-convention problems for an instrument, or []."""
-    problems = []
-    if not _METRIC_NAME_RE.match(name):
-        problems.append(
-            f"metric name {name!r} does not match the prometheus naming "
-            f"regex {_METRIC_NAME_RE.pattern}")
-    if kind == "counter" and not name.endswith("_total"):
-        problems.append(
-            f"counter {name!r} must end in '_total' (prometheus counter "
-            f"convention)")
-    if kind == "histogram" and not name.endswith("_seconds") and \
-            any(h in name for h in _DURATION_HINTS):
-        problems.append(
-            f"duration histogram {name!r} must end in '_seconds' "
-            f"(prometheus base-unit convention)")
-    return problems
+#: Shared prometheus naming lint (see :func:`_load_shared_name_lint`).
+lint_metric_name = _load_shared_name_lint()
 
 
 def _labels(kv: Optional[Dict[str, str]]) -> LabelPairs:

@@ -397,7 +397,16 @@ class SharedMemoryStore:
             shm = _open_shm(self._name(object_id))
         except FileNotFoundError:
             return False
-        self._attached[object_id] = shm
+        # Probe only — do NOT cache the mapping (rtlint RT101 real
+        # finding, sharpened in review): the old unguarded insert could
+        # race delete() and resurrect an entry for a deleted object,
+        # and even a locked insert can land AFTER a delete() that ran
+        # in the open-to-insert window. No views escaped this probe, so
+        # closing is safe; get() re-attaches on demand.
+        try:
+            shm.close()
+        except BufferError:  # pragma: no cover - paranoia
+            pass
         return True
 
     def delete(self, object_id: ObjectID) -> None:
@@ -424,8 +433,9 @@ class SharedMemoryStore:
             except Exception:
                 pass
 
-    def _spill_lru(self, need_bytes: int) -> None:
-        """Move oldest in-shm objects to disk until need_bytes freed."""
+    def _spill_lru(self, need_bytes: int) -> None:  # rtlint: holds=_lock
+        """Move oldest in-shm objects to disk until need_bytes freed.
+        Both call sites (put / create capacity checks) hold _lock."""
         os.makedirs(self._spill_dir, exist_ok=True)
         freed = 0
         for oid in list(self._owned):

@@ -371,8 +371,9 @@ class SplitCoordinator:
         self._rr = 0
         self._carry = None  # equal mode: rows not yet forming a full round
 
-    def _pump_one(self) -> bool:
-        """Pull one block from the plan; route it. Returns False at EOS."""
+    def _pump_one(self) -> bool:  # rtlint: holds=_lock
+        """Pull one block from the plan; route it. Returns False at EOS.
+        The only call site (next_block's miss path) holds _lock."""
         from . import block as B
 
         try:

@@ -280,7 +280,11 @@ class ServeController:
                     d["latency"] = block
 
     def set_http_info(self, info: dict):
-        self._http_info = info
+        # rtlint RT101 (real finding): every other writer/reader of
+        # _http_info holds _lock; an unguarded RPC write here could be
+        # lost under a concurrent _reconcile_proxies publish.
+        with self._lock:
+            self._http_info = info
 
     def get_http_info(self) -> Optional[dict]:
         return self._http_info

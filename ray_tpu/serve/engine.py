@@ -369,7 +369,13 @@ class DecodeEngine:
                 f"length {self.max_len}")
         self.prompt_buckets = buckets
         self._gd = gpt_decode
-        self._build_pool(paged, page_size, n_pages, prefix_cache)
+        # Guards the put-vs-final-drain race: once _fail_all flips
+        # _draining under this lock, no new submission can land in a
+        # queue nobody will ever read again. Created BEFORE the pool so
+        # every _build_pool call site can hold it (its holds= contract).
+        self._admit_lock = threading.Lock()
+        with self._admit_lock:
+            self._build_pool(paged, page_size, n_pages, prefix_cache)
         # Per-slot host state; index i mirrors pool row i. ``_token`` /
         # ``_rngs`` are the host copies uploaded with each dispatch
         # (tiny against the chunk compute; keeping them host-side avoids
@@ -383,10 +389,6 @@ class DecodeEngine:
         # arrival order across the backpressure boundary.
         self._pending: "collections.deque[_EngineRequest]" = \
             collections.deque()
-        # Guards the put-vs-final-drain race: once _fail_all flips
-        # _draining under this lock, no new submission can land in a
-        # queue nobody will ever read again.
-        self._admit_lock = threading.Lock()
         self._draining = False
         self._fail_lock = threading.Lock()
         self._stats_lock = threading.Lock()
@@ -418,10 +420,14 @@ class DecodeEngine:
             self.start()
 
     def _build_pool(self, paged: bool, page_size: int, n_pages: int,
-                    prefix_cache: bool):
+                    prefix_cache: bool):  # rtlint: holds=_admit_lock
         """Allocate THE persistent pool (flat or paged) and bind the
-        matching jitted programs. Called once at construction, and again
-        only by :meth:`ensure_paging` on a never-used engine."""
+        matching jitted programs. Called once at construction, by
+        :meth:`ensure_paging` on a never-used engine, and by
+        :meth:`_restart_driver` — EVERY call site holds ``_admit_lock``
+        (rtlint RT101 real finding: the restart path used to swap
+        ``_pool``/``_prefix``/``_cache`` under only ``_fail_lock``,
+        racing a concurrent ``ensure_paging`` config push)."""
         gpt_decode = self._gd
         cfg = self.cfg
         self.paged = bool(paged)
@@ -679,13 +685,19 @@ class DecodeEngine:
             # them) — the rebuild below replaces them wholesale.
             self._fail_all_locked(exc, free_state=False)
             self._epoch += 1
-            self._build_pool(self.paged, self.page_size or 16,
-                             self.n_pages, self._prefix is not None)
-            self._state = [None] * self.slots
-            self._token = np.zeros((self.slots,), np.int32)
-            self._rngs = np.zeros((self.slots, 2), np.uint32)
-            self._pending = collections.deque()
-            self._queue = queue.SimpleQueue()
+            # The rebuild holds _admit_lock too (lock order: fail →
+            # admit, same as _fail_all_locked): ensure_paging reads and
+            # swaps the pool structures under _admit_lock, and a config
+            # push racing this restart must see either the old pool or
+            # the new one — never a half-built mix.
+            with self._admit_lock:
+                self._build_pool(self.paged, self.page_size or 16,
+                                 self.n_pages, self._prefix is not None)
+                self._state = [None] * self.slots
+                self._token = np.zeros((self.slots,), np.int32)
+                self._rngs = np.zeros((self.slots, 2), np.uint32)
+                self._pending = collections.deque()
+                self._queue = queue.SimpleQueue()
         self._count(driver_restarts=1)
         from .._private.metrics import serve_metrics
         serve_metrics()["engine_driver_restarts"].inc(
@@ -784,6 +796,10 @@ class DecodeEngine:
                 self._stats[k] += v
 
     # ---------------------------------------------------------- driver loop
+    # THE driver loop: everything it calls below dispatches against
+    # pool structures only this thread (or a supervisor that already
+    # fenced it off by epoch) may touch.
+    # rtlint: owner=driver
     def _run(self, stop: threading.Event, epoch: int):
         try:
             while not stop.is_set():
@@ -869,6 +885,9 @@ class DecodeEngine:
                 return
             req.lane.q.put(("err", exc))
 
+    # Ownership transfers to the failing thread only once the driver is
+    # confirmed dead — see _fail_all's free_state contract.
+    # rtlint: owner=driver
     def _free_slot(self, i: int):
         """Release slot i: page references drop (pages whose last ref
         was this slot return to the free list; prefix-cached pages stay
@@ -905,7 +924,7 @@ class DecodeEngine:
         sm["engine_pages_free"].set(free, labels=labels)
         sm["engine_pages_used"].set(self.n_pages - free, labels=labels)
 
-    def _admit_pending(self, epoch: int = -1):
+    def _admit_pending(self, epoch: int = -1):  # rtlint: owner=driver
         """Chunk-boundary admission: fill every free slot in FIFO order.
         Expired / abandoned requests are failed out without spending a
         prefill; a paged admission that cannot get pages DEFERS — it
@@ -968,6 +987,7 @@ class DecodeEngine:
                 return               # out of pages: keep FIFO, back off
             self._pending.popleft()
 
+    # rtlint: owner=driver
     def _admit_one(self, req: _EngineRequest, epoch: int = -1) -> bool:
         """Prefill ``req`` into a free slot; returns False to defer
         (paged mode, no pages). Lane-closed/expired checks happen in
@@ -1034,6 +1054,7 @@ class DecodeEngine:
         self._observe_pages(sm)
         return True
 
+    # rtlint: owner=driver
     def _prefill_paged(self, req: _EngineRequest, slot: int, P: int,
                        sm, jax, epoch: int = -1
                        ) -> Optional[Tuple[int, List[int], float]]:
@@ -1113,7 +1134,7 @@ class DecodeEngine:
             prefix.insert(req.prompt, pages)
         return first, pages, t_admit
 
-    def _cover_pages(self) -> bool:
+    def _cover_pages(self) -> bool:  # rtlint: owner=driver
         """Allocate-on-advance (paged mode, chunk boundary): every
         occupied slot must have pages mapped through the positions this
         chunk will write (``pos + min(chunk, remaining)``). A slot that
@@ -1191,7 +1212,7 @@ class DecodeEngine:
         self._observe_pages()
         return False
 
-    def _dispatch_chunk(self, epoch: int = -1):
+    def _dispatch_chunk(self, epoch: int = -1):  # rtlint: owner=driver
         """ONE fused device dispatch decoding every active slot, then
         per-slot routing/trimming and boundary frees. A stale driver —
         one whose dispatch was stuck on the device while the supervisor

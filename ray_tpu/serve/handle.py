@@ -182,7 +182,14 @@ class RetryBudget:
 
 
 def _backoff_sleep(backoff_s: float, deadline_s: Optional[float]):
-    """Jittered backoff, never sleeping past the request deadline."""
+    """Jittered backoff, never sleeping past the request deadline.
+
+    Deliberately a blocking sleep (rtlint RT104 audit): retries run on
+    the CALLER's thread — a sync ``result()``/``__next__`` that is
+    already committed to blocking until the deadline — never on an
+    event loop. The async surfaces (proxy dispatch, ``__await__``)
+    reach this code only through ``run_in_executor`` pool threads,
+    where blocking is the contract."""
     delay = backoff_s * (0.5 + random.random() * 0.5)
     rem = remaining_s(deadline_s)
     if rem is not None:

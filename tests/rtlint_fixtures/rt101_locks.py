@@ -1,0 +1,118 @@
+"""RT101 fixture: lock-guard inference (never imported).
+
+Lines tagged ``# FIRES`` must produce exactly one RT101 finding each;
+every other line must stay clean. The test derives expectations from
+these tags, so line numbers never need maintaining.
+"""
+import threading
+
+
+class Positive:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0          # __init__ writes never count
+
+    def guarded(self):
+        with self._lock:
+            self._count += 1
+
+    def unguarded(self):
+        self._count += 1  # FIRES RT101
+
+    def unguarded_item(self):
+        self._stats = {}  # FIRES RT101
+
+    def guarded_item(self):
+        with self._lock:
+            self._stats["x"] = 1
+
+
+class PositiveItem:
+    """Subscript stores count as writes to the attribute; Condition
+    attrs count as locks."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._vals = {}
+
+    def guarded(self):
+        with self._cond:
+            self._vals["a"] = 1
+
+    def unguarded(self):
+        self._vals["b"] = 2  # FIRES RT101
+
+
+class Suppressed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def guarded(self):
+        with self._lock:
+            self._n = 1
+
+    def justified(self):
+        self._n = 2              # rtlint: disable=RT101 single writer
+
+    def justified_above(self):
+        # rtlint: disable=RT101 wrapped statement, directive above
+        self._n = 3
+
+    def whole_method(self):  # rtlint: disable=RT101 ctor-only path
+        self._n = 4
+        self._n = 5
+
+    def multi_rule(self):
+        # The suppressed rule is SECOND in the comma list — pins the
+        # documented disable=RTxxx,RTyyy grammar.
+        self._n = 6              # rtlint: disable=RT103,RT101 multi
+
+
+class Negative:
+    """All writes guarded, or no lock at all — no findings."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._a = 0
+        self._plain = 0
+
+    def one(self):
+        with self._lock:
+            self._a = 1
+
+    def two(self):
+        with self._lock:
+            self._a += 2
+
+    def lockless_attr(self):
+        self._plain = 3          # never guarded anywhere: no finding
+
+
+class NegativeConventions:
+    """_locked suffix, holds=, owner=driver, and manual acquire all
+    count as guarded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0
+
+    def guarded(self):
+        with self._lock:
+            self._x = 1
+
+    def _bump_locked(self):
+        self._x += 1             # *_locked: callers hold the lock
+
+    def annotated(self):  # rtlint: holds=_lock
+        self._x += 1
+
+    def driver_owned(self):  # rtlint: owner=driver
+        self._x += 1
+
+    def manual(self):
+        if self._lock.acquire(blocking=False):
+            try:
+                self._x += 1
+            finally:
+                self._lock.release()

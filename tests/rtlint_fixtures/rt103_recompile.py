@@ -1,0 +1,42 @@
+"""RT103 fixture: recompile / lru_cache hazards at jit factory call
+sites. Never imported."""
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def jit_decode_fixture(cfg, k, temperature=0.0):
+    return lambda *a: a
+
+
+class Driver:
+    def __init__(self, cfg, prompt, buckets):
+        self.cfg = cfg
+        self.chunk = 8
+        self.temperature = 0.0
+        # Bounded, hashable static knobs: clean.
+        self.step = jit_decode_fixture(cfg, self.chunk, self.temperature)
+        self.alt = jit_decode_fixture(cfg, k=buckets[-1])
+
+    def hazard_unhashable(self, cfg):
+        return jit_decode_fixture(cfg, [1, 2, 3])  # FIRES RT103
+
+    def hazard_unhashable_kw(self, cfg):
+        return jit_decode_fixture(cfg, k={"a": 1})  # FIRES RT103
+
+    def hazard_len(self, cfg, prompt):
+        return jit_decode_fixture(cfg, len(prompt))  # FIRES RT103
+
+    def hazard_shape(self, cfg, prompt):
+        return jit_decode_fixture(cfg, prompt.shape[0])  # FIRES RT103
+
+    def suppressed(self, cfg, prompt):
+        # rtlint: disable=RT103 bounded: prompt is bucket-padded upstream
+        return jit_decode_fixture(cfg, len(prompt))
+
+
+def static_argnums_flow(jax, fn, x):
+    jitted = jax.jit(fn, static_argnums=(1,))
+    ok = jitted(x, 8)                      # bounded constant: clean
+    bad = jitted(x, len(x))  # FIRES RT103
+    also_ok = jitted(len(x), 8)            # pos 0 is traced, not static
+    return ok, bad, also_ok

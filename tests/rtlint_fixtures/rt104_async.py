@@ -1,0 +1,56 @@
+"""RT104 fixture: blocking calls in async def bodies. Never imported."""
+import asyncio
+import queue
+import time
+
+
+async def bad_sleep():
+    time.sleep(1.0)  # FIRES RT104
+
+
+async def bad_queue_get(q: "queue.Queue"):
+    return q.get()  # FIRES RT104
+
+
+async def bad_result(fut):
+    return fut.result()  # FIRES RT104
+
+
+async def suppressed():
+    time.sleep(0.001)  # rtlint: disable=RT104 sub-ms, startup only
+
+
+async def good_await(aq):
+    await asyncio.sleep(1.0)
+    return await aq.get()              # awaited: async protocol
+
+
+async def good_wait_for(aq):
+    # Under an await expression: wait_for drives the coroutine.
+    return await asyncio.wait_for(aq.get(), timeout=1.0)
+
+
+async def good_timeouts(q, fut):
+    a = q.get(timeout=0.5)             # bounded: allowed
+    b = q.get_nowait()                 # non-blocking
+    c = q.get(False)                   # non-blocking
+    d = fut.result(timeout=0.5)        # bounded: allowed
+    e = q.get(True, 5)                 # positional timeout: allowed
+    return a, b, c, d, e
+
+
+async def good_dict_get(d):
+    return d.get("key", None)          # dict.get shape: not a queue
+
+
+async def good_nested_sync(q):
+    def puller():                      # runs on an executor thread
+        time.sleep(0.1)
+        return q.get()
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, puller)
+
+
+def sync_context(q):
+    time.sleep(0.1)                    # sync def: out of scope
+    return q.get()

@@ -1,0 +1,38 @@
+"""RT105 fixture: retryable-wire consistency. Self-contained — defines
+its own ``_PUSHBACK_CAUSES`` and exception classes. Never imported."""
+
+_PUSHBACK_CAUSES = ("ListedRetryableError", "ListedNotRetryableError",
+                    "InheritedRetryableError", "UnknownElsewhereError")
+
+
+class ListedRetryableError(RuntimeError):
+    retryable = True
+
+
+class ListedNotRetryableError(RuntimeError):  # FIRES RT105
+    """Listed in _PUSHBACK_CAUSES but missing retryable = True."""
+
+
+class InheritedRetryableError(ListedRetryableError):
+    """retryable inherited from the base: clean."""
+
+
+class UnlistedRetryableError(RuntimeError):  # FIRES RT105
+    """Sets retryable = True but is not in _PUSHBACK_CAUSES."""
+
+    retryable = True
+
+
+# rtlint: disable=RT105 local-only error, never crosses the wire
+class SuppressedRetryableError(RuntimeError):
+    retryable = True
+
+
+class ExplicitlyNotRetryable(RuntimeError):
+    """retryable = False is an explicit opt-out: clean."""
+
+    retryable = False
+
+
+class PlainError(RuntimeError):
+    """No retryable attribute, not listed: clean."""

@@ -1,0 +1,6 @@
+"""RT106 negative fixture: collections.Counter is not a metric."""
+import collections
+from collections import Counter
+
+char_counts = Counter("mississippi")             # clean: collections
+qualified = collections.Counter("mississippi")   # clean: collections

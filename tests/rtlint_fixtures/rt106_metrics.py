@@ -1,0 +1,35 @@
+"""RT106 fixture: prometheus metric-name conventions at construction
+sites (shared implementation with MetricsRegistry.register). Never
+imported."""
+from collections import Counter as CollectionsCounter
+
+
+class Counter:      # stand-ins for ray_tpu._private.metrics types
+    def __init__(self, name, description=""):
+        self.name = name
+
+
+class Gauge(Counter):
+    pass
+
+
+class Histogram(Counter):
+    pass
+
+
+good = (
+    Counter("serve_requests_shed_total"),
+    Gauge("serve_engine_pages_free"),
+    Histogram("serve_queue_wait_seconds"),
+    Histogram("serve_batch_size"),          # not a duration: no suffix
+)
+
+bad_counter = Counter("requests_shed")  # FIRES RT106
+bad_histogram = Histogram("decode_latency")  # FIRES RT106
+bad_grammar = Gauge("pages free")  # FIRES RT106
+bad_kw = Counter(name="retries")  # FIRES RT106
+
+suppressed = Counter("legacy_shed")  # rtlint: disable=RT106 grandfathered wire name
+
+# collections.Counter is not a metric: clean.
+histogram_of_chars = CollectionsCounter("not_a_metric_name")

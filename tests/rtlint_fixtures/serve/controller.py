@@ -1,0 +1,62 @@
+"""RT107 fixture: exception hygiene in serve control loops. The rule is
+path-scoped to ``serve/``, which is why this file lives here. Never
+imported."""
+import traceback
+
+
+def control_loop(work):
+    for item in work:
+        try:
+            item()
+        # FIRES-BELOW RT107 (a comment on the except or pass line would
+        # count as the justification, so the marker sits above)
+        except Exception:
+            pass
+
+
+def bare_loop(work):
+    for item in work:
+        try:
+            item()
+        except:  # FIRES RT107
+            pass
+
+
+def justified_loop(work):
+    for item in work:
+        try:
+            item()
+        except Exception:  # noqa: BLE001 - best-effort probe; reaped later
+            continue
+
+
+def suppressed_loop(work):
+    for item in work:
+        try:
+            item()
+        except Exception:  # rtlint: disable=RT107 shutdown teardown
+            pass
+
+
+def handled_loop(work):
+    for item in work:
+        try:
+            item()
+        except Exception:
+            traceback.print_exc()   # not swallowed: clean
+
+
+def narrow_loop(work):
+    for item in work:
+        try:
+            item()
+        except (ValueError, KeyError):
+            pass                    # narrow types: clean
+
+
+def reraising(work):
+    try:
+        work()
+    except:                         # bare but re-raises: clean
+        work.cleanup()
+        raise

@@ -1,0 +1,39 @@
+"""RT102 fixture: driver-thread dispatch ownership. Path-scoped — the
+rule only looks at files named ``serve/engine.py``. Never imported.
+"""
+
+
+def jit_fake_factory(cfg):
+    def step(params):
+        return params
+    return step
+
+
+class FixtureEngine:
+    def __init__(self, cfg):
+        # Binding a factory result is construction, not a dispatch.
+        self._prefill = jit_fake_factory(cfg)
+        self._step = jit_fake_factory(cfg)
+
+    # rtlint: owner=driver
+    def _dispatch(self, params):
+        a = self._prefill(params)
+        b = self._step(params)
+        return a, b
+
+    def rogue_prefill(self, params):
+        return self._prefill(params)  # FIRES RT102
+
+    def rogue_step(self, params):
+        return self._step(params)  # FIRES RT102
+
+    def rogue_immediate(self, cfg, params):
+        return jit_fake_factory(cfg)(params)  # FIRES RT102
+
+    def suppressed(self, params):
+        # rtlint: disable=RT102 test-only synchronous probe
+        return self._step(params)
+
+    def helper(self, cfg):
+        # Factory call WITHOUT immediate invocation: construction only.
+        return jit_fake_factory(cfg)

@@ -1,0 +1,283 @@
+"""rtlint (tools/rtlint): the repo-native static analyzer.
+
+Three layers of coverage:
+
+- fixture files under ``tests/rtlint_fixtures/`` assert every rule
+  RT101-RT107 both FIRES (lines tagged ``# FIRES RTxxx``, or
+  ``# FIRES-BELOW RTxxx`` when a same-line comment would read as a
+  justification) and respects inline suppressions — the expectation set
+  is derived from the tags, so the fixtures are self-describing;
+- the baseline mechanism is proven on a real finding (grandfathered
+  entries filtered, stale entries reported);
+- the CI gate: ``python -m tools.rtlint ray_tpu/ --check`` must exit 0
+  against the checked-in baseline (this is the tier-1 hook — a new
+  finding in ray_tpu/ fails this test), and two runs must be
+  byte-identical (determinism).
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from tools.rtlint import (DEFAULT_BASELINE, RULE_TABLE, lint_metric_name,
+                          run_paths, write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "rtlint_fixtures")
+
+_MARKER = re.compile(r"#\s*FIRES(-BELOW)?\s+(RT\d{3})")
+
+
+def _expected_from_markers(path):
+    """(line, rule) pairs a fixture file declares it must produce."""
+    out = set()
+    with open(path) as f:
+        lines = f.readlines()
+    for i, text in enumerate(lines, 1):
+        m = _MARKER.search(text)
+        if not m:
+            continue
+        line = i
+        if m.group(1):  # FIRES-BELOW: next non-blank, non-comment line
+            j = i
+            while j < len(lines) and (
+                    not lines[j].strip()
+                    or lines[j].lstrip().startswith("#")):
+                j += 1
+            line = j + 1
+        out.add((line, m.group(2)))
+    return out
+
+
+def _fixture_findings():
+    report = run_paths([FIXTURES])
+    return report, {(f.line, f.rule) for f in report.findings
+                    if f.rule != "RT999"}
+
+
+def test_fixtures_fire_exactly_as_marked():
+    """Every tagged line fires its rule; nothing else fires — which
+    proves, per rule, the positive, the negative, AND the suppressed
+    cases in one comparison."""
+    report, got = _fixture_findings()
+    expected = set()
+    by_file = {}
+    for root, _dirs, files in os.walk(FIXTURES):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, REPO).replace(os.sep, "/")
+            marks = _expected_from_markers(p)
+            by_file[rel] = marks
+            expected |= marks
+    # Findings are repo-relative only when cwd == repo root; compare on
+    # (line, rule) per file to stay cwd-independent.
+    got_pairs = {(f.path.split("rtlint_fixtures/")[-1], f.line, f.rule)
+                 for f in report.findings}
+    exp_pairs = {(rel.split("rtlint_fixtures/")[-1], line, rule)
+                 for rel, marks in by_file.items()
+                 for (line, rule) in marks}
+    assert got_pairs == exp_pairs, (
+        f"unexpected: {sorted(got_pairs - exp_pairs)}\n"
+        f"missing: {sorted(exp_pairs - got_pairs)}")
+
+
+def test_every_rule_has_fire_and_suppression_coverage():
+    """The fixture set exercises each rule's fire path (a tagged line)
+    and its suppression path (a ``# rtlint: disable=`` for the same
+    rule somewhere in the fixtures)."""
+    tagged, suppressed = set(), set()
+    for root, _dirs, files in os.walk(FIXTURES):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(root, fn)).read()
+            tagged |= {m.group(2) for m in _MARKER.finditer(src)}
+            suppressed |= set(
+                re.findall(r"rtlint:\s*disable=(RT\d{3})", src))
+    rules = set(RULE_TABLE)
+    assert tagged == rules, f"no fire fixture for {rules - tagged}"
+    assert suppressed == rules, \
+        f"no suppression fixture for {rules - suppressed}"
+
+
+def test_baseline_grandfathers_and_reports_stale(tmp_path):
+    report, _ = _fixture_findings()
+    assert report.findings, "fixtures must produce findings"
+    # One grandfathered finding PER RULE: the baseline must silence
+    # each rule's findings individually, not just wholesale.
+    grandfathered = {}
+    for f in report.findings:
+        grandfathered.setdefault(f.rule, f)
+    assert set(grandfathered) == set(RULE_TABLE)
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), list(grandfathered.values()))
+    data = json.loads(baseline.read_text())
+    assert sorted(data["findings"]) == sorted(
+        f.key for f in grandfathered.values())
+
+    again = run_paths([FIXTURES], baseline_path=str(baseline))
+    assert {f.key for f in again.baselined} == \
+        {f.key for f in grandfathered.values()}
+    assert not {f.key for f in again.new} & set(data["findings"])
+    assert len(again.new) == len(report.findings) - len(grandfathered)
+    grandfather = report.findings[0]
+
+    # A stale entry (finding since fixed) is surfaced, not silently kept.
+    baseline.write_text(json.dumps(
+        {"findings": [grandfather.key, "RT101:gone.py:Gone.fixed.attr"]}))
+    stale = run_paths([FIXTURES], baseline_path=str(baseline))
+    assert stale.stale_baseline == ["RT101:gone.py:Gone.fixed.attr"]
+
+
+def test_baseline_keys_are_line_number_free(tmp_path):
+    """Inserting lines above a finding must not churn its baseline key
+    (the whole point of symbol-keyed entries)."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self._n = 1\n"
+        "    def b(self):\n"
+        "        self._n = 2\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    key1 = run_paths([str(p)]).findings[0].key
+    p.write_text("# a new header comment\n# another\n" + src)
+    moved = run_paths([str(p)]).findings[0]
+    assert moved.key == key1 and moved.line > 10 - 1
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    report = run_paths([str(p)])
+    assert [f.rule for f in report.findings] == ["RT999"]
+    assert report.new, "a broken file must fail the gate"
+
+
+def test_parse_errors_are_never_grandfatherable(tmp_path):
+    """A baseline must not greenlight a file that escapes every rule:
+    write_baseline drops RT999 keys, and even a hand-edited baseline
+    carrying one still fails the gate."""
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    report = run_paths([str(p)])
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), report.findings)
+    assert json.loads(baseline.read_text())["findings"] == []
+    baseline.write_text(json.dumps(
+        {"findings": [report.findings[0].key]}))  # hand-edited in
+    again = run_paths([str(p)], baseline_path=str(baseline))
+    assert again.new and not again.baselined
+
+
+def test_rt106_shares_the_runtime_implementation():
+    """The satellite contract: MetricsRegistry.register and the static
+    RT106 rule run ONE source of truth, so they cannot drift. The
+    runtime loads metrics_names.py by FILE PATH (a package import
+    would drag the whole analyzer into every ray_tpu process), so the
+    pin is source-file identity, not function-object identity."""
+    from ray_tpu._private import metrics
+    from tools.rtlint import metrics_names
+
+    assert os.path.samefile(
+        metrics.lint_metric_name.__code__.co_filename,
+        metrics_names.__file__)
+    # And ray_tpu's import must NOT pull the analyzer package in.
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import ray_tpu._private.metrics as m, sys; "
+         "assert not any(k.startswith('tools') for k in sys.modules), "
+         "sorted(k for k in sys.modules if k.startswith('tools')); "
+         "assert m.lint_metric_name('x', 'counter')"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    # RT_METRICS_STRICT semantics unchanged: strict registries raise on
+    # the same problems the static rule reports.
+    reg = metrics.MetricsRegistry(strict=True)
+    with pytest.raises(ValueError, match="_total"):
+        metrics.Counter("requests_shed", registry=reg)
+    reg_warn = metrics.MetricsRegistry(strict=False)
+    with pytest.warns(UserWarning, match="_seconds"):
+        metrics.Histogram("decode_latency", registry=reg_warn)
+
+
+def test_rtlint_is_clean_on_itself():
+    report = run_paths([os.path.join(REPO, "tools")])
+    assert not report.findings, [f.render() for f in report.findings]
+
+
+def test_determinism_two_runs_byte_identical():
+    """Two analyses of ray_tpu/ must render byte-identical JSON (no
+    timestamps, no dict-order leakage, stable sort)."""
+    target = os.path.join(REPO, "ray_tpu")
+    a = run_paths([target]).to_json()
+    b = run_paths([target]).to_json()
+    assert a == b
+
+
+def test_ci_gate_ray_tpu_is_clean():
+    """THE tier-1 hook: the analyzer over ray_tpu/ must exit 0 against
+    the checked-in baseline — a new finding fails this test, which
+    fails the suite, which fails the existing verify command. Runs the
+    real CLI so the exit-code contract is what's pinned."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", "ray_tpu/", "--check"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"rtlint found new findings (fix them or, if genuinely "
+        f"grandfathered, add them to {DEFAULT_BASELINE}):\n"
+        f"{proc.stdout}\n{proc.stderr}")
+
+
+def test_ci_gate_fails_on_new_findings(tmp_path):
+    """--check exits non-zero on a non-baselined finding."""
+    p = tmp_path / "serve"
+    p.mkdir()
+    bad = p / "controller.py"
+    bad.write_text(
+        "def loop(work):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", str(bad), "--check",
+         "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "RT107" in proc.stdout
+
+
+def test_cli_json_output_and_rule_filter(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.rtlint",
+         "tests/rtlint_fixtures/rt104_async.py", "--json",
+         "--no-baseline", "--rules", "RT104"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    rules = {f["rule"] for f in data["findings"]}
+    assert rules == {"RT104"}
+    assert data["files_checked"] == 1
+
+
+def test_shared_lint_rules_agree_with_register():
+    """Spot-check the shared function directly (the same strings the
+    runtime warns/raises about are what RT106 reports)."""
+    assert lint_metric_name("x_total", "counter") == []
+    assert any("_total" in p
+               for p in lint_metric_name("x", "counter"))
+    assert any("_seconds" in p
+               for p in lint_metric_name("wait_ms", "histogram"))
+    assert any("regex" in p
+               for p in lint_metric_name("1bad", "gauge"))

@@ -1,0 +1,46 @@
+"""rtlint: repo-native static analysis for ray_tpu's concurrency,
+jit-recompile, and wire-protocol invariants.
+
+Usage::
+
+    python -m tools.rtlint ray_tpu/              # human report
+    python -m tools.rtlint ray_tpu/ --json       # machine report
+    python -m tools.rtlint ray_tpu/ --check      # CI gate (quiet)
+
+Rules (see ``tools/rtlint/rules.py`` for the conventions each leans on):
+
+========  ============================================================
+RT101     attribute written both with and without its guarding lock
+RT102     device dispatch outside a driver-annotated engine method
+RT103     unhashable / unbounded-cardinality args into jit factories
+RT104     blocking calls (time.sleep, .get(), .result()) in async defs
+RT105     retryable pushback classes out of sync with _PUSHBACK_CAUSES
+RT106     metric names violating prometheus conventions (shared with
+          the runtime MetricsRegistry.register lint)
+RT107     bare / silently-swallowed except in serve control loops
+========  ============================================================
+
+Suppression: ``# rtlint: disable=RT101[,RT104]`` on the offending line
+(or the line above, or the enclosing ``def`` line) — add a justification
+after the directive. Grandfathered findings live in
+``tools/rtlint/baseline.json``; ``--update-baseline`` regenerates it.
+"""
+from .core import (Finding, Module, ProjectRule, Report, Rule,
+                   load_baseline, run, write_baseline)
+from .metrics_names import lint_metric_name
+from .rules import ALL_RULES, RULE_TABLE
+
+DEFAULT_BASELINE = "tools/rtlint/baseline.json"
+
+
+def run_paths(paths, baseline_path=None, rule_filter=None) -> Report:
+    """Analyze ``paths`` with every rule; the library entry point the
+    CLI and the tests share."""
+    return run(paths, ALL_RULES, baseline_path=baseline_path,
+               rule_filter=rule_filter)
+
+
+__all__ = ["Finding", "Module", "ProjectRule", "Report", "Rule",
+           "ALL_RULES", "RULE_TABLE", "DEFAULT_BASELINE",
+           "lint_metric_name", "load_baseline", "run", "run_paths",
+           "write_baseline"]

@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.rtlint <paths...>``.
+
+Exit code 0 when every finding is grandfathered in the baseline (or
+there are none); 1 when new findings exist (or any analyzed file fails
+to parse); 2 on usage errors. ``--check`` is the CI-gate spelling: it
+prints only the failures. Output is deterministic — two runs over the
+same tree produce byte-identical reports (pinned by the determinism
+test in ``tests/test_rtlint.py``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import DEFAULT_BASELINE, RULE_TABLE, run_paths, write_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rtlint",
+        description="repo-native static analysis (rules RT101-RT107)")
+    ap.add_argument("paths", nargs="+", help="files or directories")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: print only new findings")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         f"when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline entirely")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default all)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings")
+    args = ap.parse_args(argv)
+
+    rule_filter = None
+    if args.rules:
+        rule_filter = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = rule_filter - set(RULE_TABLE)
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))} "
+                  f"(known: {', '.join(sorted(RULE_TABLE))})",
+                  file=sys.stderr)
+            return 2
+
+    baseline = None if args.no_baseline else (
+        args.baseline if args.baseline is not None
+        else (DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE)
+              else None))
+
+    report = run_paths(args.paths, baseline_path=baseline,
+                       rule_filter=rule_filter)
+
+    if args.update_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        write_baseline(path, report.findings)
+        print(f"baseline written: {path} "
+              f"({len(report.findings)} findings)")
+        return 0
+
+    if args.json:
+        print(report.to_json())
+    else:
+        shown = report.new if args.check else report.findings
+        for f in shown:
+            mark = "" if args.check else (
+                " [baselined]" if f in report.baselined else "")
+            print(f.render() + mark)
+        if not args.check or report.new or report.stale_baseline:
+            print(f"rtlint: {report.files_checked} files, "
+                  f"{len(report.findings)} findings "
+                  f"({len(report.new)} new, "
+                  f"{len(report.baselined)} baselined)")
+        if report.stale_baseline:
+            print(f"rtlint: {len(report.stale_baseline)} stale baseline "
+                  f"entr{'y' if len(report.stale_baseline) == 1 else 'ies'}"
+                  f" (fixed findings - remove them): ")
+            for k in report.stale_baseline:
+                print(f"  {k}")
+    return 1 if report.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

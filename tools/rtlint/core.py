@@ -1,0 +1,327 @@
+"""rtlint core: module model, finding model, suppressions, baseline,
+and the analysis driver.
+
+The analyzer is a plain AST walk per file plus a handful of
+whole-project rules; there is no type inference and no import
+resolution. Everything a rule learns comes from three places:
+
+- the parse tree (``Module.tree``),
+- the comment map (``Module.comments``, built with ``tokenize`` so
+  comments survive into analysis — ``ast`` alone drops them),
+- rtlint directives parsed out of those comments.
+
+Directive grammar (one comment, any number of ``key=value`` tokens
+separated by whitespace or commas; prose after the tokens is ignored so
+directives can carry a justification)::
+
+    # rtlint: disable=RT101,RT104   <why this is safe>
+    # rtlint: disable=all
+    # rtlint: owner=driver          <single-thread-owned method>
+    # rtlint: holds=_lock           <every caller holds self._lock>
+
+Placement: a ``disable`` on the finding line (or the line directly
+above, for wrapped statements) suppresses that line; any directive on a
+``def`` line (or the line directly above the ``def``) applies to the
+whole function body. ``owner``/``holds`` are function-level facts used
+by RT101/RT102.
+
+Findings carry a stable **key** (``rule:path:symbol``) that does not
+include the line number, so the checked-in baseline survives unrelated
+edits; duplicate symbols within a file are disambiguated with ``#n``
+suffixes in source order.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULE_ID_RE = re.compile(r"^RT\d{3}$")
+
+#: Pseudo-rule for files the analyzer cannot parse: a broken file must
+#: fail the gate (it would otherwise silently escape every real rule).
+PARSE_ERROR_RULE = "RT999"
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str          # repo-relative, '/'-separated
+    line: int
+    rule: str
+    message: str
+    symbol: str        # stable anchor for the baseline key
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message, "symbol": self.symbol,
+                "key": self.key}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _parse_directives(comment: str) -> Dict[str, str]:
+    """``# rtlint: k=v[,v2] [k=v ...] prose`` -> {k: v[,v2]}. Tokens
+    split on whitespace ONLY, so comma-joined values
+    (``disable=RT101,RT104``) stay intact; the first non ``k=v`` token
+    starts the prose. Non-directive comments return {}."""
+    m = re.search(r"rtlint:\s*(.*)", comment)
+    if not m:
+        return {}
+    out: Dict[str, str] = {}
+    for tok in m.group(1).split():
+        if "=" not in tok:
+            break      # first non k=v token starts the prose
+        k, _, v = tok.partition("=")
+        if not k or not v:
+            break
+        out[k] = out[k] + "," + v if k in out else v
+    return out
+
+
+class Module:
+    """One parsed source file plus its comment/directive maps."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)       # caller handles SyntaxError
+        #: line -> full comment text (without the leading '#')
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#")
+        except tokenize.TokenError:
+            pass  # comment map stays partial; ast.parse already passed
+        #: line -> directives on that line
+        self.directives: Dict[int, Dict[str, str]] = {
+            ln: d for ln, c in self.comments.items()
+            if (d := _parse_directives(c))}
+        # Function-level directive intervals (innermost last so lookups
+        # can prefer the tightest enclosing def).
+        self._func_spans: List[Tuple[int, int, Dict[str, str]]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                d = self.func_directives(node)
+                if d:
+                    self._func_spans.append(
+                        (node.lineno, node.end_lineno or node.lineno, d))
+        self._func_spans.sort()
+
+    # ----------------------------------------------------------- directives
+    def line_directives(self, line: int) -> Dict[str, str]:
+        """Directives attached to ``line``: on the line itself or the
+        line directly above (wrapped statements)."""
+        out = dict(self.directives.get(line - 1, ()))
+        out.update(self.directives.get(line, ()))
+        return out
+
+    def func_directives(self, funcdef) -> Dict[str, str]:
+        """Directives anywhere on the (possibly multi-line) ``def``
+        signature, or on the line directly above it."""
+        out = dict(self.directives.get(funcdef.lineno - 1, ()))
+        sig_end = (funcdef.body[0].lineno - 1 if funcdef.body
+                   else funcdef.lineno)
+        for ln in range(funcdef.lineno, sig_end + 1):
+            out.update(self.directives.get(ln, ()))
+        return out
+
+    def _disabled_rules(self, d: Dict[str, str]) -> Set[str]:
+        raw = d.get("disable", "")
+        return {r.strip() for r in raw.split(",") if r.strip()}
+
+    def suppresses(self, line: int, rule: str) -> bool:
+        """Inline or enclosing-def ``disable=`` suppression for a
+        finding anchored at ``line``."""
+        dis = self._disabled_rules(self.line_directives(line))
+        if rule in dis or "all" in dis:
+            return True
+        for start, end, d in self._func_spans:
+            if start <= line <= end:
+                dis = self._disabled_rules(d)
+                if rule in dis or "all" in dis:
+                    return True
+        return False
+
+
+class Rule:
+    """Per-module rule. Subclasses set ``id``/``summary`` and implement
+    :meth:`check`; override :meth:`applies` to scope by path."""
+
+    id = "RT000"
+    summary = ""
+
+    def applies(self, mod: Module) -> bool:
+        return True
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """Rule that needs the whole analyzed file set at once (cross-file
+    consistency checks)."""
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        return ()
+
+
+# --------------------------------------------------------------- baseline
+def load_baseline(path: Optional[str]) -> Set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    data = {
+        "comment": (
+            "rtlint grandfathered findings. Entries are finding keys "
+            "(rule:path:symbol — line numbers excluded so unrelated "
+            "edits don't churn this file). Remove an entry once its "
+            "finding is fixed; regenerate with --update-baseline."),
+        # Parse errors are never grandfatherable: a baselined broken
+        # file would pass --check while escaping every real rule.
+        "findings": sorted(f.key for f in findings
+                           if f.rule != PARSE_ERROR_RULE),
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ----------------------------------------------------------------- driver
+def collect_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """Expand files/dirs into sorted (abspath, relpath) python files."""
+    out = []
+    for p in paths:
+        p = os.path.normpath(p)
+        if os.path.isfile(p):
+            out.append((os.path.abspath(p), p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    fp = os.path.join(root, fn)
+                    out.append((os.path.abspath(fp), fp))
+    # Dedup while keeping deterministic order.
+    seen, uniq = set(), []
+    for ap, rp in sorted(out, key=lambda t: t[1]):
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append((ap, rp))
+    return uniq
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)   # all, sorted
+    new: List[Finding] = field(default_factory=list)        # not baselined
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+    files_checked: int = 0
+
+    def to_json(self) -> str:
+        """Deterministic JSON: content-addressed only — no timestamps,
+        no absolute paths — so two runs over the same tree are
+        byte-identical."""
+        return json.dumps({
+            "version": 1,
+            "files_checked": self.files_checked,
+            "findings": [f.to_dict() for f in self.findings],
+            "new": [f.key for f in self.new],
+            "baselined": [f.key for f in self.baselined],
+            "stale_baseline": sorted(self.stale_baseline),
+        }, indent=2, sort_keys=True)
+
+
+def _dedup_symbols(findings: List[Finding]) -> List[Finding]:
+    """Disambiguate duplicate (rule, path, symbol) keys with ``#n``
+    suffixes in source order, so every baseline key is unique."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in sorted(findings):
+        k = (f.rule, f.path, f.symbol)
+        n = counts.get(k, 0)
+        counts[k] = n + 1
+        if n:
+            f = Finding(f.path, f.line, f.rule, f.message,
+                        f"{f.symbol}#{n + 1}")
+        out.append(f)
+    return out
+
+
+def run(paths: Sequence[str], rules: Sequence[Rule],
+        baseline_path: Optional[str] = None,
+        rule_filter: Optional[Set[str]] = None) -> Report:
+    """Analyze ``paths`` with ``rules``; returns the full report with
+    baseline split applied."""
+    report = Report()
+    mods: List[Module] = []
+    raw: List[Finding] = []
+    for abspath, relpath in collect_files(paths):
+        report.files_checked += 1
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+            mod = Module(abspath, relpath, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            raw.append(Finding(
+                relpath.replace(os.sep, "/"),
+                getattr(e, "lineno", 0) or 0, PARSE_ERROR_RULE,
+                f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
+                "<parse>"))
+            continue
+        mods.append(mod)
+    for mod in mods:
+        for rule in rules:
+            if isinstance(rule, ProjectRule) or not rule.applies(mod):
+                continue
+            for f in rule.check(mod):
+                if not mod.suppresses(f.line, f.rule):
+                    raw.append(f)
+    by_rel = {m.relpath: m for m in mods}
+    for rule in rules:
+        if not isinstance(rule, ProjectRule):
+            continue
+        for f in rule.check_project(mods):
+            mod = by_rel.get(f.path)
+            if mod is None or not mod.suppresses(f.line, f.rule):
+                raw.append(f)
+    if rule_filter:
+        raw = [f for f in raw if f.rule in rule_filter
+               or f.rule == PARSE_ERROR_RULE]
+    report.findings = _dedup_symbols(raw)
+    baseline = load_baseline(baseline_path)
+    seen_keys = set()
+    for f in report.findings:
+        seen_keys.add(f.key)
+        # A parse error always fails the gate, even if a hand-edited
+        # baseline carries its key — a broken file escapes every rule.
+        if f.rule != PARSE_ERROR_RULE and f.key in baseline:
+            report.baselined.append(f)
+        else:
+            report.new.append(f)
+    report.stale_baseline = sorted(baseline - seen_keys)
+    return report

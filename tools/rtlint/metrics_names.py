@@ -1,0 +1,48 @@
+"""Prometheus metric-name lint — THE shared implementation.
+
+This is the single source of truth for the metric naming conventions
+enforced in two places that must never drift:
+
+- at runtime, ``ray_tpu._private.metrics.MetricsRegistry.register``
+  lints every instrument as it is registered (warn by default, raise
+  under ``RT_METRICS_STRICT``);
+- statically, rtlint rule **RT106** applies the same function to every
+  ``Counter(...)`` / ``Gauge(...)`` / ``Histogram(...)`` construction
+  site it can see, so a bad name fails CI before the instrument ever
+  registers.
+
+Deliberately dependency-free (stdlib ``re`` only): the runtime imports
+this module from inside ``ray_tpu`` and must not pull the rest of the
+analyzer in with it.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+#: Prometheus metric-name grammar (data model spec).
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+#: A histogram whose name suggests it measures time must carry the
+#: canonical ``_seconds`` unit suffix.
+DURATION_HINTS = ("duration", "latency", "wait", "elapsed", "_time",
+                  "ttft", "tpot")
+
+
+def lint_metric_name(name: str, kind: str) -> List[str]:
+    """Prometheus naming-convention problems for an instrument, or []."""
+    problems = []
+    if not METRIC_NAME_RE.match(name):
+        problems.append(
+            f"metric name {name!r} does not match the prometheus naming "
+            f"regex {METRIC_NAME_RE.pattern}")
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append(
+            f"counter {name!r} must end in '_total' (prometheus counter "
+            f"convention)")
+    if kind == "histogram" and not name.endswith("_seconds") and \
+            any(h in name for h in DURATION_HINTS):
+        problems.append(
+            f"duration histogram {name!r} must end in '_seconds' "
+            f"(prometheus base-unit convention)")
+    return problems

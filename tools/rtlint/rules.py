@@ -1,0 +1,646 @@
+"""rtlint rules RT101-RT107: the invariants this repo's serve/engine
+stack keeps breaking in review (see ISSUE 8).
+
+Every rule is lexical AST analysis — no type inference — so each one
+documents the convention it leans on and the annotation that satisfies
+it. False positives are handled with ``# rtlint: disable=RTxxx`` plus a
+justification, or grandfathered in the checked-in baseline.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Module, ProjectRule, Rule
+from .metrics_names import lint_metric_name
+
+#: Attribute names that count as locks for RT101 guard inference.
+LOCKISH_RE = re.compile(r"lock|cond|mutex", re.I)
+#: Receiver names that look like queues for RT104's timeout-less .get().
+QUEUEISH_RE = re.compile(r"(^|_)(q|queue)$|queue", re.I)
+
+
+def _self_attr(node) -> Optional[str]:
+    """``self.X`` -> ``'X'`` (else None)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _terminal_name(func) -> Optional[str]:
+    """Rightmost name of a call target: ``a.b.c(...)`` -> ``'c'``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _write_target_attr(node) -> Optional[str]:
+    """Attr written by an assignment target: ``self.X`` or
+    ``self.X[...]`` -> ``'X'``."""
+    a = _self_attr(node)
+    if a is not None:
+        return a
+    if isinstance(node, ast.Subscript):
+        return _self_attr(node.value)
+    return None
+
+
+# ------------------------------------------------------------------ RT101
+class LockGuardRule(Rule):
+    """RT101: a ``self._x`` attribute written both inside and outside
+    ``with self.<lock>`` blocks across a class's methods.
+
+    Convention knobs (all lexical):
+
+    - lock attrs are ``self.*`` names matching ``lock|cond|mutex`` used
+      as ``with`` contexts anywhere in the class;
+    - ``__init__``/``__del__`` writes are construction/teardown, never
+      counted as unguarded;
+    - methods named ``*_locked``, annotated ``# rtlint: holds=<lock>``,
+      or containing a manual ``self.<lock>.acquire(...)`` call are
+      treated as guarded (callers hold the lock / hand-rolled locking);
+    - methods annotated ``# rtlint: owner=driver`` are single-thread
+      owned: their writes need no lock by design (see RT102).
+    """
+
+    id = "RT101"
+    summary = "attribute written both with and without its guarding lock"
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for cls in [n for n in ast.walk(mod.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            yield from self._check_class(mod, cls)
+
+    def _check_class(self, mod: Module, cls: ast.ClassDef):
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        locks: Set[str] = set()
+        for m in methods:
+            for w in ast.walk(m):
+                if isinstance(w, ast.With):
+                    for item in w.items:
+                        a = _self_attr(item.context_expr)
+                        if a and LOCKISH_RE.search(a):
+                            locks.add(a)
+        if not locks:
+            return
+        # attr -> [(method, line, guards frozenset, assumed_guarded)]
+        writes: Dict[str, List[Tuple[str, int, frozenset, bool]]] = {}
+        for m in methods:
+            d = mod.func_directives(m)
+            if d.get("owner") == "driver":
+                continue           # single-thread owned: no lock needed
+            held = {h.strip() for h in d.get("holds", "").split(",")
+                    if h.strip()}
+            assumed = (m.name.endswith("_locked") or bool(held)
+                       or self._acquires_manually(m, locks))
+            self._collect_writes(m, locks, held, assumed, writes)
+        for attr, ws in sorted(writes.items()):
+            guarded = [w for w in ws if w[2] or w[3]]
+            unguarded = [w for w in ws if not (w[2] or w[3])
+                         and w[0] not in ("__init__", "__del__")]
+            if not guarded or not unguarded:
+                continue
+            lock_names = sorted({l for w in guarded for l in w[2]}) \
+                or sorted(locks)
+            g = guarded[0]
+            for (mn, ln, _gs, _a) in unguarded:
+                yield Finding(
+                    mod.relpath, ln, self.id,
+                    f"self.{attr} is written in {cls.name}.{mn} without "
+                    f"{'/'.join('self.' + l for l in lock_names)} held, "
+                    f"but under it in {cls.name}.{g[0]} (line {g[1]}); "
+                    f"guard the write, annotate the method with "
+                    f"'# rtlint: holds=<lock>' or "
+                    f"'# rtlint: owner=driver', or suppress with a "
+                    f"justification",
+                    f"{cls.name}.{mn}.{attr}")
+
+    @staticmethod
+    def _acquires_manually(m, locks: Set[str]) -> bool:
+        for w in ast.walk(m):
+            if isinstance(w, ast.Call) and \
+                    isinstance(w.func, ast.Attribute) and \
+                    w.func.attr == "acquire" and \
+                    _self_attr(w.func.value) in locks:
+                return True
+        return False
+
+    @staticmethod
+    def _collect_writes(m, locks, held, assumed, writes):
+        def rec(node, guards):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not m:
+                return             # nested def: different execution ctx
+            if isinstance(node, ast.With):
+                g2 = set(guards)
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a in locks:
+                        g2.add(a)
+                for c in node.body:
+                    rec(c, g2)
+                return
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            for t in targets:
+                a = _write_target_attr(t)
+                if a and a not in locks:
+                    writes.setdefault(a, []).append(
+                        (m.name, node.lineno,
+                         frozenset(guards | held), assumed))
+            for c in ast.iter_child_nodes(node):
+                rec(c, guards)
+        rec(m, set())
+
+
+# ------------------------------------------------------------------ RT102
+class DriverOwnershipRule(Rule):
+    """RT102: device-dispatch calls in the decode engine must run on
+    the driver thread. Lexically: calls to the bound jit wrappers
+    (``self._prefill`` / ``self._step``) or an immediately-invoked
+    ``jit_*`` factory (``jit_x(...)(...)``) are only allowed inside
+    methods annotated ``# rtlint: owner=driver``. Binding a factory
+    (``self._prefill = jit_prefill(...)``) is construction, not a
+    dispatch, and is not flagged."""
+
+    id = "RT102"
+    summary = "device dispatch outside a driver-annotated method"
+
+    DISPATCH_ATTRS = ("_prefill", "_step")
+
+    def applies(self, mod: Module) -> bool:
+        return mod.relpath.endswith("serve/engine.py")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        yield from self._walk(mod, mod.tree, scope="<module>",
+                              owned=False)
+
+    def _walk(self, mod, node, scope, owned):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                d = mod.func_directives(child)
+                yield from self._walk(
+                    mod, child, f"{scope}.{child.name}"
+                    if scope != "<module>" else child.name,
+                    d.get("owner") == "driver")
+                continue
+            if isinstance(child, ast.ClassDef):
+                yield from self._walk(mod, child, child.name, False)
+                continue
+            if isinstance(child, ast.Call) and not owned:
+                what = self._dispatch_callee(child)
+                if what:
+                    yield Finding(
+                        mod.relpath, child.lineno, self.id,
+                        f"device dispatch {what} in {scope}, which is "
+                        f"not annotated '# rtlint: owner=driver' — only "
+                        f"the engine driver thread may touch the "
+                        f"device (TPU dispatch discipline)",
+                        f"{scope}.{what}")
+            yield from self._walk(mod, child, scope, owned)
+
+    def _dispatch_callee(self, call: ast.Call) -> Optional[str]:
+        a = _self_attr(call.func)
+        if a in self.DISPATCH_ATTRS:
+            return f"self.{a}(...)"
+        if isinstance(call.func, ast.Call):
+            inner = _terminal_name(call.func.func)
+            if inner and inner.startswith("jit_"):
+                return f"{inner}(...)(...)"
+        return None
+
+
+# ------------------------------------------------------------------ RT103
+class RecompileHazardRule(Rule):
+    """RT103: arguments flowing into ``lru_cache``'d jit factories
+    (``jit_*`` call sites) or recorded ``static_argnums`` positions
+    must be hashable and of bounded cardinality. Flags:
+
+    - unhashable literals (list/set/dict displays, comprehensions) —
+      ``lru_cache`` raises ``TypeError`` at runtime;
+    - values derived from ``len(...)`` or ``.shape``/``.size`` —
+      unbounded cardinality: every distinct value compiles (and caches)
+      a fresh program, the silent-recompile failure mode the engine's
+      bucket discipline exists to prevent.
+
+    ``static_argnums`` tracking is module-local: an assignment
+    ``x = jax.jit(f, static_argnums=(2,))`` makes position 2 of later
+    ``x(...)`` calls subject to the same classifiers."""
+
+    id = "RT103"
+    summary = "recompile / lru_cache hazard at a jit factory call site"
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        static_map = self._collect_static_argnums(mod)
+        for node, scope in _calls_with_scope(mod.tree):
+            name = _terminal_name(node.func)
+            args = []
+            if name and name.startswith("jit_"):
+                args = [(i, a) for i, a in enumerate(node.args)]
+                args += [(k.arg, k.value) for k in node.keywords]
+            else:
+                key = _self_attr(node.func) or (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+                positions = static_map.get(key or "")
+                if positions:
+                    args = [(i, a) for i, a in enumerate(node.args)
+                            if i in positions]
+                    name = key
+            for pos, arg in args:
+                bad = self._classify(arg)
+                if bad:
+                    yield Finding(
+                        mod.relpath, arg.lineno, self.id,
+                        f"argument {ast.unparse(arg)!r} (position "
+                        f"{pos}) of {name}(...) is {bad}; static knobs "
+                        f"must be hashable, bounded-cardinality values "
+                        f"(config attrs, constants, bucket sizes)",
+                        f"{scope}.{name}.arg{pos}")
+
+    @staticmethod
+    def _classify(arg) -> Optional[str]:
+        if isinstance(arg, (ast.List, ast.Set, ast.Dict, ast.ListComp,
+                            ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return ("an unhashable literal (lru_cache raises TypeError; "
+                    "pass a tuple)")
+        for w in ast.walk(arg):
+            if isinstance(w, ast.Call) and \
+                    isinstance(w.func, ast.Name) and w.func.id == "len":
+                return ("derived from len(...) — unbounded cardinality, "
+                        "one compiled program per distinct value")
+            if isinstance(w, ast.Attribute) and w.attr in ("shape",
+                                                           "size"):
+                return (f"derived from .{w.attr} — unbounded "
+                        f"cardinality, one compiled program per "
+                        f"distinct value")
+        return None
+
+    @staticmethod
+    def _collect_static_argnums(mod: Module) -> Dict[str, Set[int]]:
+        out: Dict[str, Set[int]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if _terminal_name(call.func) != "jit":
+                continue
+            positions: Set[int] = set()
+            for kw in call.keywords:
+                if kw.arg != "static_argnums":
+                    continue
+                vals = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                for v in vals:
+                    if isinstance(v, ast.Constant) and \
+                            isinstance(v.value, int):
+                        positions.add(v.value)
+            if not positions:
+                continue
+            for t in node.targets:
+                key = _self_attr(t) or (
+                    t.id if isinstance(t, ast.Name) else None)
+                if key:
+                    out[key] = positions
+        return out
+
+
+# ------------------------------------------------------------------ RT104
+class AsyncBlockingRule(Rule):
+    """RT104: blocking calls inside ``async def`` bodies stall the
+    whole event loop (every connection, every health probe). Flags
+    ``time.sleep``, timeout-less ``.get()`` on queue-looking receivers,
+    and timeout-less ``.result()``. Calls under an ``await`` expression
+    are exempt (async protocols: ``await q.get()``,
+    ``await asyncio.wait_for(q.get(), t)``), as are nested sync ``def``
+    bodies (they run on executor threads)."""
+
+    id = "RT104"
+    summary = "blocking call inside an async def body"
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        sleep_names = self._time_sleep_names(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield from self._scan(mod, node, sleep_names)
+
+    @staticmethod
+    def _time_sleep_names(mod: Module) -> Set[str]:
+        names = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "sleep":
+                        names.add(a.asname or a.name)
+        return names
+
+    def _scan(self, mod: Module, fn: ast.AsyncFunctionDef, sleep_names):
+        def rec(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return             # nested defs have their own context
+            if isinstance(node, ast.Await):
+                return             # awaited subtree: async protocol
+            if isinstance(node, ast.Call):
+                bad = self._blocking(node, sleep_names)
+                if bad:
+                    yield Finding(
+                        mod.relpath, node.lineno, self.id,
+                        f"{bad} inside 'async def {fn.name}' blocks the "
+                        f"event loop; await an async equivalent, add a "
+                        f"timeout, or move the call to an executor "
+                        f"thread",
+                        f"{fn.name}.{bad.split('(')[0]}")
+            for c in ast.iter_child_nodes(node):
+                yield from rec(c)
+        for stmt in fn.body:
+            yield from rec(stmt)
+
+    @staticmethod
+    def _blocking(call: ast.Call, sleep_names) -> Optional[str]:
+        f = call.func
+        kws = {k.arg for k in call.keywords}
+        if isinstance(f, ast.Attribute) and f.attr == "sleep" and \
+                isinstance(f.value, ast.Name) and f.value.id == "time":
+            return "time.sleep(...)"
+        if isinstance(f, ast.Name) and f.id in sleep_names:
+            return f"{f.id}(...) [time.sleep]"
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "result" and not call.args and "timeout" not in kws:
+            return "timeout-less .result()"
+        if f.attr == "get" and "timeout" not in kws:
+            if len(call.args) >= 2:
+                # Queue.get(block, timeout) positional timeout — or a
+                # dict.get(key, default); bounded either way.
+                return None
+            nonblocking = any(
+                isinstance(a, ast.Constant) and a.value is False
+                for a in call.args[:1]) or any(
+                k.arg == "block" and isinstance(k.value, ast.Constant)
+                and k.value.value is False for k in call.keywords)
+            if call.args and not all(
+                    isinstance(a, ast.Constant) and a.value is True
+                    for a in call.args[:1]):
+                return None        # dict.get(key) shape
+            recv = f.value
+            rn = recv.attr if isinstance(recv, ast.Attribute) else (
+                recv.id if isinstance(recv, ast.Name) else "")
+            if not nonblocking and QUEUEISH_RE.search(rn or ""):
+                return f"timeout-less {rn}.get()"
+        return None
+
+
+# ------------------------------------------------------------------ RT105
+class RetryableWireRule(ProjectRule):
+    """RT105: the router re-picks on typed pushback two ways — the
+    ``retryable = True`` class attribute (local raises) and the
+    ``_PUSHBACK_CAUSES`` name tuple (errors that crossed the wire as
+    ``TaskError``, where only ``cause_type`` survives). Both must agree:
+
+    - a name listed in ``_PUSHBACK_CAUSES`` whose class does not set
+      ``retryable = True`` breaks the local-raise path;
+    - an exception class setting ``retryable = True`` that is missing
+      from ``_PUSHBACK_CAUSES`` breaks the cross-wire path.
+
+    Inheritance is resolved within the analyzed file set."""
+
+    id = "RT105"
+    summary = "retryable pushback class out of sync with _PUSHBACK_CAUSES"
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        causes: Set[str] = set()
+        cause_sites: List[Tuple[Module, int]] = []
+        classes: Dict[str, Tuple[Module, ast.ClassDef]] = {}
+        for mod in mods:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and \
+                                t.id == "_PUSHBACK_CAUSES" and \
+                                isinstance(node.value,
+                                           (ast.Tuple, ast.List)):
+                            for e in node.value.elts:
+                                if isinstance(e, ast.Constant) and \
+                                        isinstance(e.value, str):
+                                    causes.add(e.value)
+                            cause_sites.append((mod, node.lineno))
+                elif isinstance(node, ast.ClassDef):
+                    classes.setdefault(node.name, (mod, node))
+        if not cause_sites:
+            return                 # nothing to check against
+        for name in sorted(causes):
+            ent = classes.get(name)
+            if ent is None:
+                continue           # defined outside the analyzed set
+            mod, node = ent
+            if self._retryable(name, classes) is not True:
+                yield Finding(
+                    mod.relpath, node.lineno, self.id,
+                    f"{name} is listed in _PUSHBACK_CAUSES but does not "
+                    f"set 'retryable = True' — a LOCAL raise of it "
+                    f"would not be re-picked (only the wire-crossed "
+                    f"TaskError would)", name)
+        for name, (mod, node) in sorted(classes.items()):
+            if name in causes:
+                continue
+            if self._retryable(name, classes) is not True:
+                continue
+            if not self._looks_like_exception(name, classes):
+                continue
+            yield Finding(
+                mod.relpath, node.lineno, self.id,
+                f"{name} sets 'retryable = True' but is not listed in "
+                f"_PUSHBACK_CAUSES — after crossing the replica wire as "
+                f"a TaskError only its cause_type name survives, so the "
+                f"router would bury the replica instead of re-picking",
+                name)
+
+    @classmethod
+    def _retryable(cls, name, classes, seen=None) -> Optional[bool]:
+        seen = seen or set()
+        if name in seen or name not in classes:
+            return None
+        seen.add(name)
+        _mod, node = classes[name]
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id == "retryable" \
+                            and isinstance(stmt.value, ast.Constant):
+                        return bool(stmt.value.value)
+        for base in node.bases:
+            bn = _terminal_name(base)
+            got = cls._retryable(bn, classes, seen) if bn else None
+            if got is not None:
+                return got
+        return None
+
+    @classmethod
+    def _looks_like_exception(cls, name, classes, seen=None) -> bool:
+        seen = seen or set()
+        if name in seen:
+            return False
+        seen.add(name)
+        if name.endswith(("Error", "Exception")):
+            return True
+        if name not in classes:
+            return False
+        _mod, node = classes[name]
+        return any(
+            (bn := _terminal_name(base)) and (
+                bn.endswith(("Error", "Exception"))
+                or cls._looks_like_exception(bn, classes, seen))
+            for base in node.bases)
+
+
+# ------------------------------------------------------------------ RT106
+class MetricNameRule(Rule):
+    """RT106: the prometheus naming conventions, applied statically at
+    every ``Counter(...)`` / ``Gauge(...)`` / ``Histogram(...)``
+    construction site with a literal name. Shares ONE implementation
+    (:func:`tools.rtlint.metrics_names.lint_metric_name`) with the
+    runtime ``MetricsRegistry.register`` lint, so the static and
+    runtime checks cannot drift. ``collections.Counter`` is excluded
+    via the module's imports."""
+
+    id = "RT106"
+    summary = "metric name violates prometheus conventions"
+
+    KINDS = {"Counter": "counter", "Gauge": "gauge",
+             "Histogram": "histogram"}
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        collections_names = self._collections_imports(mod)
+        for node, scope in _calls_with_scope(mod.tree):
+            f = node.func
+            name = _terminal_name(f)
+            if name not in self.KINDS:
+                continue
+            if isinstance(f, ast.Name) and f.id in collections_names:
+                continue
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "collections":
+                continue
+            metric = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                metric = node.args[0].value
+            else:
+                for k in node.keywords:
+                    if k.arg == "name" and \
+                            isinstance(k.value, ast.Constant) and \
+                            isinstance(k.value.value, str):
+                        metric = k.value.value
+            if metric is None:
+                continue
+            for problem in lint_metric_name(metric, self.KINDS[name]):
+                yield Finding(mod.relpath, node.lineno, self.id,
+                              problem, metric)
+
+    @staticmethod
+    def _collections_imports(mod: Module) -> Set[str]:
+        out = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "collections":
+                for a in node.names:
+                    out.add(a.asname or a.name)
+        return out
+
+
+# ------------------------------------------------------------------ RT107
+class SwallowedExceptRule(Rule):
+    """RT107: exception hygiene in the serve control loops. Flags
+
+    - bare ``except:`` that does not re-raise (it catches
+      ``SystemExit``/``KeyboardInterrupt`` and can wedge a teardown);
+    - broad handlers (``Exception``/``BaseException``) whose body only
+      ``pass``/``continue``s, with NO justification comment — a control
+      loop that silently eats its own failures is how a dead driver
+      looks healthy.
+
+    A comment on the ``except`` line (or the first body line) counts as
+    the justification; the repo convention is
+    ``except Exception:  # noqa: BLE001 - <why swallowing is safe>``.
+    Scoped to ``ray_tpu/serve/`` — the driver/controller/replica
+    control loops this rule exists for."""
+
+    id = "RT107"
+    summary = "bare or silently-swallowed except in a serve control loop"
+
+    def applies(self, mod: Module) -> bool:
+        return "serve/" in mod.relpath
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for node, scope in _nodes_with_scope(mod.tree, ast.ExceptHandler):
+            bare = node.type is None
+            broad = bare or (
+                _terminal_name(node.type) in ("Exception", "BaseException")
+                if not isinstance(node.type, ast.Tuple) else False)
+            if not broad:
+                continue
+            reraises = any(isinstance(s, ast.Raise) and s.exc is None
+                           for s in ast.walk(node))
+            if bare and not reraises:
+                yield Finding(
+                    mod.relpath, node.lineno, self.id,
+                    f"bare 'except:' in {scope} (catches SystemExit/"
+                    f"KeyboardInterrupt); name the exception type",
+                    f"{scope}.bare_except")
+                continue
+            swallow = all(isinstance(s, (ast.Pass, ast.Continue))
+                          for s in node.body)
+            if not swallow or bare:
+                continue
+            justified = node.lineno in mod.comments or \
+                (node.body and node.body[0].lineno in mod.comments)
+            if not justified:
+                yield Finding(
+                    mod.relpath, node.lineno, self.id,
+                    f"broad except in {scope} swallows the error with "
+                    f"no justification comment; handle it, narrow the "
+                    f"type, or comment why dropping it is safe",
+                    f"{scope}.swallowed_except")
+
+
+# ----------------------------------------------------------------- shared
+def _nodes_with_scope(tree, node_type):
+    """Yield (node, qualified_scope) for every ``node_type`` in the
+    tree, tracking enclosing class/function names."""
+    def rec(node, scope):
+        for child in ast.iter_child_nodes(node):
+            s = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                s = f"{scope}.{child.name}" if scope != "<module>" \
+                    else child.name
+            if isinstance(child, node_type):
+                yield child, scope
+            yield from rec(child, s)
+    yield from rec(tree, "<module>")
+
+
+def _calls_with_scope(tree):
+    yield from _nodes_with_scope(tree, ast.Call)
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    LockGuardRule(), DriverOwnershipRule(), RecompileHazardRule(),
+    AsyncBlockingRule(), RetryableWireRule(), MetricNameRule(),
+    SwallowedExceptRule())
+
+RULE_TABLE = {r.id: r for r in ALL_RULES}
