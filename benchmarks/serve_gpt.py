@@ -57,6 +57,19 @@ whose system prompt is prefix-cached (page-table copy + short-suffix
 prefill) vs the flat pool's full prefill. ``--smoke`` shrinks it for
 tier-1 CI.
 
+``--spec`` (ISSUE 9) switches to the speculative-decoding A/B: the
+SAME saturating burst of repetitive-suffix prompts is driven through
+three engines built on identical weights — spec off, the n-gram
+drafter, and the tied-embedding model drafter — off/ngram driven
+back-to-back in every pass with best-of-5 per mode, the same one-sided
+noise discipline as ``--paged``/``--continuous``. The workload is
+SCREENED: candidate prompts' greedy continuations are simulated once
+against the n-gram drafter and the most predictable drive the A/B.
+Reports, per mode: decoded tok/s, TTFT p50, TPOT p50/p95, and — spec
+modes — accepted-tokens-per-target-forward and the acceptance rate
+from the engine's own accounting. ``--smoke`` shrinks it (off vs
+n-gram only) for tier-1 CI.
+
 ``--chaos`` (ISSUE 7) switches to the crash-safety acceptance run: a
 2-replica continuous-engine deployment serves seeded (deterministic)
 streams under load while a replica is KILLED mid-stream; every client
@@ -116,6 +129,17 @@ def main():
                              "2-replica engine deployment mid-load and "
                              "assert zero broken client streams "
                              "(deterministic replay resume)")
+    parser.add_argument("--spec", action="store_true",
+                        help="speculative-decoding A/B: spec off vs "
+                             "n-gram vs tied-embedding model drafter "
+                             "at equal offered load (direct engine "
+                             "drive, no serve stack)")
+    parser.add_argument("--draft-k", type=int, default=32,
+                        help="proposals per verify round for --spec (a "
+                             "verify forward's cost is dominated by the "
+                             "max_len attention sweep, so wide drafts "
+                             "are nearly free and locked-in repetitive "
+                             "streams commit k+1 tokens per forward)")
     parser.add_argument("--page-size", type=int, default=8)
     parser.add_argument("--smoke", action="store_true",
                         help="with --continuous/--paged: shrunk load "
@@ -141,6 +165,17 @@ def main():
         cfg_name = args.config or (
             "small" if _jax.devices()[0].platform == "tpu" else "nano")
         run_paged_ab(args, np, cfg_name, f"gpt_{cfg_name}")
+        return
+
+    if args.spec:
+        # Direct engine drive again: the A/B isolates the dispatch-loop
+        # arithmetic (k sequential target steps vs draft + one verify
+        # forward) from the serve transport.
+        import jax as _jax
+
+        cfg_name = args.config or (
+            "small" if _jax.devices()[0].platform == "tpu" else "nano")
+        run_spec_ab(args, np, cfg_name, f"gpt_{cfg_name}")
         return
 
     import ray_tpu as rt
@@ -1075,6 +1110,239 @@ def run_paged_ab(args, np, cfg_name, model):
         "kv_budget_positions": kv_positions,
         "smoke": bool(args.smoke),
     }))
+
+
+def run_spec_ab(args, np, cfg_name, model):
+    """ISSUE 9 acceptance A/B: identical saturating bursts of
+    repetitive-suffix prompts through three engines on the same
+    weights — spec off, n-gram drafter, tied-embedding model drafter —
+    INTERLEAVED passes with best-of-N per mode (same discipline as
+    --continuous/--paged: noise on a shared host is one-sided). The
+    workload is the one speculative decoding exists for — locally
+    repetitive continuations — and is SCREENED for it: candidate
+    repetitive-suffix prompts are generated, their greedy
+    continuations simulated once against the n-gram drafter
+    (host-side, deterministic), and the most predictable ones drive
+    the A/B; the screen's acceptance distribution is reported so the
+    selection is visible. Spec modes run with ``spec_threshold=2.5``
+    (pool-wide adaptive speculation — on CPU a verify forward costs a
+    sizable fraction of a fused chunk, so speculating through
+    unpredictable phases would only burn forwards; on
+    bandwidth-bound accelerators the threshold belongs at 0). Reports
+    per mode: tok/s, TTFT p50, TPOT p50/p95; spec modes add
+    accepted-tokens-per-target-forward and acceptance rate from the
+    engine's own accounting."""
+    import threading as _th
+
+    import jax
+
+    from ray_tpu.models import gpt, gpt_decode
+    from ray_tpu.serve.draft import NGramDrafter
+    from ray_tpu.serve.engine import DecodeEngine
+
+    cfg = gpt.CONFIGS[cfg_name]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    chunk = 8
+    draft_k = max(1, args.draft_k)
+    spec_threshold = 2.5
+    # Half the serving default: per-boundary host work is amortized
+    # over committed tokens, and the spec path runs ~3x the boundaries
+    # (cheaper each) — a leaner pool keeps the A/B measuring dispatch
+    # arithmetic rather than python bookkeeping.
+    slots = 4 if args.smoke else max(4, args.slots // 2)
+    plen = 24
+    mix = [12, 24] if args.smoke else [64, 88]
+    n_req = 2 * slots if args.smoke else 4 * slots
+    n_cand = n_req if args.smoke else 6 * n_req
+    max_len = min(cfg.max_seq,
+                  plen + mix[-1] + max(chunk, draft_k + 1))
+    buckets = (plen,)
+    modes = ("off", "ngram") if args.smoke else ("off", "ngram", "model")
+    # This box's throughput drifts ~2x minutes-to-minutes (see the
+    # --continuous calibration note): off/ngram run back-to-back in
+    # EVERY pass and best-of-5 discards the contention-slowed passes
+    # (noise on a shared host is one-sided). The model drafter is not
+    # the headline — one pass documents it.
+    passes = 1 if args.smoke else 5
+
+    def mk_candidate(cid):
+        # Repetitive-suffix prompt families: a repeated pattern of
+        # period 1, 2, or 4 — the structure prompt-lookup drafting
+        # feeds on.
+        r = np.random.default_rng(700 + cid)
+        kind = cid % 3
+        if kind == 0:
+            return np.full((plen,),
+                           r.integers(0, cfg.vocab_size), np.int32)
+        per = 2 if kind == 1 else 4
+        pat = r.integers(0, cfg.vocab_size, (per,)).astype(np.int32)
+        return np.concatenate([pat] * (plen // per))
+
+    def sim_acceptance(prompt, toks):
+        """Rounds of the n-gram drafter against a known greedy stream:
+        the deterministic host-side screen (and a preview of what the
+        engine's verify rounds will accept)."""
+        d = NGramDrafter()
+        d.configure(slots=1, max_len=max_len, prompt_buckets=buckets,
+                    draft_k=draft_k)
+        d.admit(0, prompt, int(toks[0]))
+        i, rounds, acc = 1, 0, 0
+        active = np.array([True])
+        last = np.array([toks[0]], np.int32)
+        while i < len(toks):
+            props = d.propose(active, last)[0]
+            a = 0
+            while a < draft_k and i + a < len(toks) \
+                    and props[a] == toks[i + a]:
+                a += 1
+            j = min(a + 1, len(toks) - i)
+            d.observe(0, np.asarray(toks[i:i + j]), min(a, j - 1))
+            last[0] = toks[i + j - 1]
+            i += j
+            rounds += 1
+            acc += a
+        d.free(0)
+        return acc / max(rounds, 1)
+
+    # Screen: greedy-decode every candidate once (also warms the
+    # library programs) and keep the n_req most n-gram-predictable.
+    scores = []
+    for cid in range(n_cand):
+        p = mk_candidate(cid)
+        toks = np.concatenate([s[0] for s in gpt_decode.generate_chunked(
+            params, p[None], cfg, mix[-1], chunk=chunk,
+            max_len=max_len)]).tolist()
+        scores.append((sim_acceptance(p, toks), cid))
+    scores.sort(reverse=True)
+    chosen = [cid for _score, cid in scores[:n_req]]
+    screen = [round(s, 2) for s, _cid in scores[:n_req]]
+
+    def mk_prompt(rid):
+        return mk_candidate(chosen[rid % len(chosen)])
+
+    max_news = np.random.default_rng(7).choice(mix, size=n_req)
+
+    def build(mode):
+        return DecodeEngine(
+            params, cfg, slots=slots, chunk=chunk, max_len=max_len,
+            prompt_buckets=buckets, draft_k=draft_k,
+            spec_decode=None if mode == "off" else mode,
+            spec_threshold=spec_threshold,
+            deployment=f"spec_{mode}_bench")
+
+    def drive(eng):
+        """Saturating burst: all n_req requests queued at t=0 — equal
+        offered load for every mode."""
+        ttfts = [None] * n_req
+        comps = [None] * n_req
+        toks = [0] * n_req
+
+        def one(i):
+            t0 = time.perf_counter()
+            first = None
+            n = 0
+            for s in eng.stream(mk_prompt(i), int(max_news[i]), seed=i):
+                if first is None:
+                    first = time.perf_counter() - t0
+                n += s.shape[0]
+            ttfts[i] = first
+            comps[i] = time.perf_counter() - t0
+            toks[i] = n
+
+        threads = [_th.Thread(target=one, args=(i,))
+                   for i in range(n_req)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        bad = [(i, toks[i], int(max_news[i]))
+               for i in range(n_req) if toks[i] != max_news[i]]
+        assert not bad, f"short streams (i, got, want): {bad}"
+        # Amortized TPOT per stream: decode time after the first token.
+        tpots = [(comps[i] - ttfts[i]) / max(toks[i] - 1, 1)
+                 for i in range(n_req)]
+        return ttfts, tpots, wall, sum(toks)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(int(len(xs) * q), len(xs) - 1)]
+
+    engines = {}
+    for mode in modes:
+        eng = build(mode)
+        # Warm every compile path (prefill bucket, chunk, verify, and
+        # the model drafter's own programs) before the clock starts.
+        list(eng.stream(mk_prompt(0), max(mix), seed=0))
+        engines[mode] = eng
+    runs = {m: [] for m in modes}
+    try:
+        for p in range(passes):
+            for mode in modes:
+                if mode == "model" and p > 0:
+                    continue
+                runs[mode].append(drive(engines[mode]))
+        results = {}
+        for mode in modes:
+            ttfts, tpots, wall, total = max(runs[mode],
+                                            key=lambda r: r[3] / r[2])
+            st = engines[mode].stats()
+            row = {
+                "metric": f"serve_{model}_spec_{mode}_mode",
+                "value": round(total / wall, 1), "unit": "tokens/s",
+                "ttft_p50_ms": round(pct(ttfts, 0.50) * 1000, 2),
+                "tpot_p50_ms": round(pct(tpots, 0.50) * 1000, 3),
+                "tpot_p95_ms": round(pct(tpots, 0.95) * 1000, 3),
+                "requests": n_req, "passes": passes,
+                "tok_s_per_pass": [round(r[3] / r[2], 1)
+                                   for r in runs[mode]],
+                "slots": slots, "chunk": chunk,
+                "output_len_mix": [int(m) for m in mix],
+                "offered_tokens": int(sum(max_news)),
+                "dispatches_per_token": round(
+                    st["dispatches_per_token"], 4),
+            }
+            if mode != "off":
+                sp = st["spec"]
+                row.update({
+                    "draft_k": draft_k,
+                    "spec_threshold": spec_threshold,
+                    "accepted_per_forward": round(
+                        sp["accepted_per_forward"], 3),
+                    "acceptance_rate": round(sp["acceptance_rate"], 4),
+                    "mean_accept_len": round(sp["mean_accept_len"], 3),
+                    "verify_rounds": sp["rounds"],
+                    "fallback_rounds": sp["fallback_rounds"],
+                })
+            print(json.dumps(row))
+            results[mode] = row
+    finally:
+        for eng in engines.values():
+            eng.shutdown()
+    off = results["off"]
+    ng = results["ngram"]
+    summary = {
+        "metric": f"serve_{model}_spec_ab",
+        "value": round(ng["value"] / max(off["value"], 1e-9), 2),
+        "unit": "x_tokens_s_ngram_vs_off",
+        "ngram_accepted_per_forward": ng["accepted_per_forward"],
+        "ngram_acceptance_rate": ng["acceptance_rate"],
+        "tpot_p50_ratio": round(off["tpot_p50_ms"]
+                                / max(ng["tpot_p50_ms"], 1e-9), 2),
+        "draft_k": draft_k,
+        "spec_threshold": spec_threshold,
+        "screen_sim_acceptance": screen,
+        "screened_from": n_cand,
+        "smoke": bool(args.smoke),
+    }
+    if "model" in results:
+        md = results["model"]
+        summary["model_x_tokens_s_vs_off"] = round(
+            md["value"] / max(off["value"], 1e-9), 2)
+        summary["model_accepted_per_forward"] = \
+            md["accepted_per_forward"]
+    print(json.dumps(summary))
 
 
 def run_chaos_mode(args, serve, np, cfg_name, model):

@@ -436,6 +436,20 @@ def serve_metrics() -> dict:
             engine_tokens=Counter(
                 "serve_engine_tokens_total",
                 "Tokens emitted to engine stream lanes"),
+            # ---- speculative decoding (ISSUE 9). Observed on the
+            # engine driver thread, once per draft->verify round.
+            engine_spec_proposed=Counter(
+                "serve_engine_spec_proposed_total",
+                "Draft tokens proposed to the verify step "
+                "(draft_k per active slot per round)"),
+            engine_spec_accepted=Counter(
+                "serve_engine_spec_accepted_total",
+                "Draft tokens the target accepted at verification"),
+            engine_spec_accept_len=Histogram(
+                "serve_engine_spec_accept_len",
+                "Per-slot accepted draft length per verify round "
+                "(0..draft_k; committed tokens are this + 1)",
+                bounds=(0, 1, 2, 3, 4, 6, 8, 12, 16)),
             # ---- paged KV pool (ISSUE 6). Set/incremented on the
             # engine driver thread as the allocator hands pages out.
             engine_pages_free=Gauge(

@@ -96,6 +96,24 @@ positions, extra masked positions contribute exact zeros to the softmax
 (``exp(-1e30 - max)`` underflows to 0.0), and the per-slot PRNG lanes
 are untouched — asserted at temperature 0 AND seeded temperature > 0 in
 ``tests/test_serve_engine_paged.py``.
+
+Speculative verify (ISSUE 9): chunked decode pays one TARGET forward
+per token (k sequential steps fused per dispatch). The verify twins —
+:func:`verify_chunk_slots` / :func:`verify_chunk_slots_paged` — replace
+those k sequential forwards with ONE batched forward over the k tokens
+a cheap drafter proposed per slot: the kernel feeds ``[last, d_1..d_k]``
+(k+1 positions), writes their K/V at each slot's own ``pos..pos+k``,
+scores all k+1 logit rows, computes the per-slot accepted length with
+rejection sampling (:func:`_spec_accept` — greedy exact-match at
+temperature 0, point-mass residual resampling above it, so the output
+distribution is the target's for ANY drafter), samples the
+bonus/correction token from the target's own row, and advances ``pos``
+by ``1 + n_acc`` per slot — the write cursor rolls back past rejected
+positions, whose garbage K/V is overwritten before it is ever attended
+(the same write-at-pos-before-reading-<=pos exactness argument as
+prompt right-padding). Everything is traced with chunk-static shapes:
+one verify program per (pool shape, k) on top of the usual
+``len(prompt_buckets) + 1``, for any acceptance pattern.
 """
 from __future__ import annotations
 
@@ -830,4 +848,228 @@ def jit_decode_chunk_slots_paged(cfg: GPTConfig, k: int, page_size: int,
                                      k=k, page_size=page_size,
                                      temperature=temperature,
                                      eos_token=eos_token),
+                   donate_argnums=(1,))
+
+
+# ------------------------------------------------------ speculative verify
+def _spec_accept(logits, draft, keys, temperature: float, k: int):
+    """Shared acceptance/correction math for the verify kernels.
+
+    ``logits`` ``[B, k+1, vocab]`` are the target's rows over the fed
+    sequence ``[last, d_1..d_k]`` (row i predicts the token AFTER input
+    i, so row i scores ``d_{i+1}`` and row k samples the bonus token);
+    ``draft`` ``[B, k]`` holds the proposals. Drafters propose POINT
+    tokens (deterministic), so lossless acceptance reduces to:
+
+    - temperature 0: accept ``d_{i+1}`` iff ``argmax(row_i) == d_{i+1}``;
+      the correction/bonus token is ``argmax(row_{n_acc})`` — committed
+      tokens are bitwise the greedy target stream for ANY drafter.
+    - temperature > 0: accept ``d`` with probability ``p_t(d)`` (the
+      point-mass proposal makes ``min(1, p/q) = p``); on rejection
+      sample the residual ``norm(max(p_t - q, 0))`` — ``p_t`` with
+      ``d``'s mass removed; on full acceptance sample the bonus from
+      row k unmasked. The committed distribution is exactly the
+      target's (the standard rejection-sampling identity), and PRNG
+      consumption is STATIC — ``k + 2`` splits per slot per verify —
+      so seeded streams replay deterministically through any
+      acceptance pattern.
+
+    Returns ``(committed [B, k+1], n_acc [B], keys')``:
+    ``committed[b, :n_acc[b]]`` are the accepted drafts,
+    ``committed[b, n_acc[b]]`` the correction/bonus token, and later
+    entries repeat it — hosts deliver ``committed[b, :n_acc[b]+1]``.
+    """
+    am = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, k+1]
+    if temperature <= 0.0:
+        acc = am[:, :k] == draft                             # [B, k]
+        samples = am
+    else:
+        def per_slot(key, lg, d):
+            ks = jax.random.split(key, k + 2)
+            carry, dec = ks[0], ks[1:]
+            sub = jax.vmap(jax.random.split)(dec)            # [k+1, 2, 2]
+            ukeys, skeys = sub[:, 0], sub[:, 1]
+            scaled = lg / temperature
+            p = jax.nn.softmax(scaled[:k], axis=-1)
+            pd = jnp.take_along_axis(p, d[:, None], axis=1)[:, 0]
+            u = jax.vmap(jax.random.uniform)(ukeys[:k])
+            a = u < pd
+            residual = scaled[:k].at[jnp.arange(k), d].set(-1e30)
+            corr = jax.vmap(jax.random.categorical)(skeys[:k], residual)
+            bonus = jax.random.categorical(skeys[k], scaled[k])
+            smp = jnp.concatenate([corr, bonus[None]]).astype(jnp.int32)
+            return carry, a, smp
+
+        keys, acc, samples = jax.vmap(per_slot)(keys, logits, draft)
+    n_acc = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
+    c = jnp.take_along_axis(samples, n_acc[:, None], axis=1)   # [B, 1]
+    committed = jnp.where(
+        jnp.arange(k + 1)[None, :] < n_acc[:, None],
+        jnp.concatenate([draft, c], axis=1), c)
+    return committed, n_acc.astype(jnp.int32), keys
+
+
+def verify_chunk_slots(params: Params, cache: Cache, token: jax.Array,
+                       draft: jax.Array, rngs: jax.Array,
+                       active: jax.Array, *, cfg: GPTConfig, k: int,
+                       temperature: float = 0.0):
+    """ONE batched target forward verifying k drafted tokens per active
+    slot (ISSUE 9 tentpole; the draft-k-verify-once step).
+
+    ``token`` ``[B]`` is each slot's last committed token, ``draft``
+    ``[B, k]`` its drafter proposals, ``rngs``/``active`` as in
+    :func:`decode_chunk_slots`. The kernel feeds ``[last, d_1..d_k]``
+    (k+1 positions per slot), writes their K/V at the slot's own
+    ``pos..pos+k`` (scatter; inactive slots and positions past
+    ``max_len`` are dropped, never clamped), scores all k+1 logit rows
+    against the proposals (:func:`_spec_accept`), and advances ``pos``
+    by ``1 + n_acc`` per active slot — the write cursor rolls back past
+    rejected positions in-program. Garbage K/V beyond the new ``pos``
+    is overwritten before any later query attends it (every decode and
+    verify step writes position ``pos`` before reading ``<= pos``), the
+    same exactness argument as prompt right-padding.
+
+    Returns ``(committed [B, k+1], n_acc [B], cache', rngs')``; rows of
+    inactive slots are garbage. The host delivers
+    ``committed[b, :n_acc[b]+1]`` trimmed by remaining/EOS and feeds
+    the LAST DELIVERED token next. EOS needs no in-kernel
+    mask-and-carry here: there is no sequential feedback inside the
+    verify (all inputs were proposed up front), and the engine frees
+    the lane at the chunk boundary where it trims."""
+    B = token.shape[0]
+    S = k + 1
+    max_len = cache["k"].shape[2]
+    pos = cache["pos"]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    seq = jnp.concatenate([token[:, None], draft], axis=1)     # [B, S]
+    positions = pos[:, None] + jnp.arange(S)[None, :]          # [B, S]
+    x = params["embed"]["kernel"].astype(cfg.dtype)[seq]
+    x = x + jnp.take(params["pos_embed"],
+                     jnp.clip(positions, 0,
+                              params["pos_embed"].shape[0] - 1),
+                     axis=0).astype(cfg.dtype)
+    ar = jnp.arange(max_len)
+    # Query i attends <= pos + i: the history plus the drafted prefix
+    # written at pos..pos+i this dispatch — causal within the block.
+    valid = ar[None, None, None, :] <= positions[:, None, :, None]
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, S))
+    # Inactive slots write at max_len: out of bounds, dropped.
+    wpos = jnp.where(active[:, None], positions, jnp.int32(max_len))
+
+    def body(carry, layer):
+        x = carry
+        p, kc, vc = layer                    # [B, max_len, H, hd]
+        q, kk, vv = _block_kv(x, p, cfg)     # [B, S, H, hd]
+        kc = kc.at[bidx, wpos].set(kk, mode="drop")
+        vc = vc.at[bidx, wpos].set(vv, mode="drop")
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vc,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["block"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = _project_vocab(x, params["embed"]["kernel"], cfg)
+    committed, n_acc, rngs = _spec_accept(logits, draft, rngs,
+                                          temperature, k)
+    pos2 = pos + (1 + n_acc) * active.astype(jnp.int32)
+    return committed, n_acc, {"k": k_new, "v": v_new, "pos": pos2}, rngs
+
+
+def verify_chunk_slots_paged(params: Params, cache: Cache,
+                             token: jax.Array, draft: jax.Array,
+                             rngs: jax.Array, active: jax.Array,
+                             pt: jax.Array, *, cfg: GPTConfig, k: int,
+                             page_size: int, temperature: float = 0.0):
+    """Paged twin of :func:`verify_chunk_slots`: K/V writes scatter at
+    ``(pt[b, (pos+i) // ps], (pos+i) % ps)`` with drop semantics (an
+    unmapped or inactive target is discarded, never clamped into
+    another slot's page — the engine never un-maps a page that still
+    holds committed tokens, so rollback is just the smaller ``pos``),
+    and each query attends its virtual sequence gathered through its
+    page-table row, valid ``<= pos + i``. Acceptance math, variable
+    advance, and PRNG discipline are identical to flat."""
+    B = token.shape[0]
+    S = k + 1
+    H, hd = cfg.n_head, cfg.head_dim
+    n_pages = cache["k"].shape[1]
+    ps = page_size
+    max_pages = pt.shape[1]
+    V = max_pages * ps
+    pos = cache["pos"]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    seq = jnp.concatenate([token[:, None], draft], axis=1)     # [B, S]
+    positions = pos[:, None] + jnp.arange(S)[None, :]          # [B, S]
+    x = params["embed"]["kernel"].astype(cfg.dtype)[seq]
+    x = x + jnp.take(params["pos_embed"],
+                     jnp.clip(positions, 0,
+                              params["pos_embed"].shape[0] - 1),
+                     axis=0).astype(cfg.dtype)
+    vp = positions // ps
+    page_idx = jnp.take_along_axis(
+        pt, jnp.clip(vp, 0, max_pages - 1), axis=1)            # [B, S]
+    page_w = jnp.where(active[:, None] & (vp < max_pages), page_idx,
+                       jnp.int32(PT_SENTINEL))
+    off = positions % ps
+    ptc = jnp.clip(pt, 0, n_pages - 1)                 # [B, max_pages]
+    arv = jnp.arange(V)
+    valid = arv[None, None, None, :] <= positions[:, None, :, None]
+
+    def body(carry, layer):
+        x = carry
+        p, kc, vc = layer                    # [n_pages, ps, H, hd]
+        q, kk, vv = _block_kv(x, p, cfg)     # [B, S, H, hd]
+        kc = kc.at[page_w, off].set(kk, mode="drop")
+        vc = vc.at[page_w, off].set(vv, mode="drop")
+        hk = kc[ptc].reshape(B, V, H, hd)
+        hv = vc[ptc].reshape(B, V, H, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, hk,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, hv,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
+        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        x = _ffn(x, p, cfg)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["block"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = _project_vocab(x, params["embed"]["kernel"], cfg)
+    committed, n_acc, rngs = _spec_accept(logits, draft, rngs,
+                                          temperature, k)
+    pos2 = pos + (1 + n_acc) * active.astype(jnp.int32)
+    return committed, n_acc, {"k": k_new, "v": v_new, "pos": pos2}, rngs
+
+
+@functools.lru_cache(maxsize=64)
+def jit_verify_chunk_slots(cfg: GPTConfig, k: int,
+                           temperature: float = 0.0):
+    """Jitted :func:`verify_chunk_slots`: ONE compiled program per
+    (pool shape, k) — draft contents, acceptance pattern, and per-slot
+    positions are all traced data, never retrace triggers (pinned by
+    the spec recompile-guard test). Pool donated as in
+    :func:`jit_prefill_into_slot`."""
+    return jax.jit(functools.partial(verify_chunk_slots, cfg=cfg, k=k,
+                                     temperature=temperature),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=64)
+def jit_verify_chunk_slots_paged(cfg: GPTConfig, k: int, page_size: int,
+                                 temperature: float = 0.0):
+    """Jitted :func:`verify_chunk_slots_paged`: ONE program per (pool
+    shape, k, page_size) — the page table is data. Pool donated."""
+    return jax.jit(functools.partial(verify_chunk_slots_paged, cfg=cfg,
+                                     k=k, page_size=page_size,
+                                     temperature=temperature),
                    donate_argnums=(1,))

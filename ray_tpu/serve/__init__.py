@@ -11,6 +11,7 @@ from .api import (Application, Deployment, delete, deployment,
                   start, status)
 from .batching import batch, default_buckets, pad_to_bucket
 from .config import (AutoscalingConfig, DeploymentConfig, HTTPOptions, gRPCOptions)
+from .draft import Drafter, ModelDrafter, NGramDrafter
 from .engine import DecodeEngine, EngineRestartError, EngineShutdownError
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
@@ -22,7 +23,7 @@ from .request import (BackPressureError, ReplicaDrainingError,
 
 __all__ = [
     "Application", "AutoscalingConfig", "BackPressureError", "DecodeEngine",
-    "Deployment",
+    "Deployment", "Drafter", "ModelDrafter", "NGramDrafter",
     "DeploymentConfig", "EngineRestartError", "EngineShutdownError",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "HTTPOptions", "gRPCOptions", "ReplicaDrainingError",

@@ -430,10 +430,27 @@ class ServeController:
                             engine[key] = engine.get(key, 0) + est[key]
                     engine["paged"] = engine.get("paged", False) \
                         or bool(est.get("paged"))
+                    sp = est.get("spec")
+                    if sp:
+                        agg = engine.setdefault(
+                            "spec", {"drafter": sp.get("drafter", "")})
+                        for key in ("rounds", "proposed", "accepted",
+                                    "lanes", "fallback_rounds"):
+                            agg[key] = agg.get(key, 0) + int(
+                                sp.get(key, 0))
             except Exception:  # noqa: BLE001 - totals dip this round
                 pass
         d["lifecycle"] = life
         if engine:
+            sp = engine.get("spec")
+            if sp:
+                # Deployment-wide acceptance: replica counters summed
+                # above, the rates derived once here.
+                sp["acceptance_rate"] = round(
+                    sp["accepted"] / max(sp["proposed"], 1), 4)
+                sp["accepted_per_forward"] = round(
+                    (sp["accepted"] + sp["lanes"])
+                    / max(sp["lanes"], 1), 3)
             d["engine"] = engine
         if dead:
             with self._lock:

@@ -406,12 +406,13 @@ class Replica:
                 if isinstance(v, DecodeEngine)]
 
     def _apply_engine_config(self, engine_config: dict):
-        """Push the deployment schema's ``engine:`` block (paged KV
-        knobs) into every DecodeEngine the user callable constructed —
-        applied right after ``__init__``, before any traffic, which is
-        the only window an engine may be repaged in."""
+        """Push the deployment schema's ``engine:`` block (paged-KV +
+        speculative-decoding knobs) into every DecodeEngine the user
+        callable constructed — applied right after ``__init__``, before
+        any traffic, which is the only window an engine may be repaged
+        or given a drafter in."""
         for eng in self._engines():
-            eng.ensure_paging(**engine_config)
+            eng.apply_config(**engine_config)
 
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:

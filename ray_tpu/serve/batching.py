@@ -330,7 +330,9 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01, pad_to_bucket: bool = False,
           buckets: Optional[Sequence[int]] = None, stream: bool = False,
           continuous: bool = False, page_size: Optional[int] = None,
-          prefix_cache: Optional[bool] = None):
+          prefix_cache: Optional[bool] = None, spec_decode=None,
+          draft_k: Optional[int] = None,
+          spec_threshold: Optional[float] = None):
     """Decorator: turn a ``List[T] -> List[R]`` handler into a ``T -> R``
     callable that transparently batches concurrent callers.
 
@@ -368,10 +370,11 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
             return self.decode(request)       # iterator of [j] slices
 
     ``page_size=`` / ``prefix_cache=`` (continuous only) are the paged
-    KV-cache knobs, applied to the handler's engine via
-    :meth:`~.engine.DecodeEngine.ensure_paging` on first use: a
-    flat-constructed engine is repaged before traffic (an already-paged
-    engine just validates), so deployments can opt into paging
+    KV-cache knobs, and ``spec_decode=`` / ``draft_k=`` the speculative
+    decoding knobs, applied to the handler's engine via
+    :meth:`~.engine.DecodeEngine.apply_config` on first use: a
+    flat-constructed engine is repaged / given a drafter before traffic
+    (a matching engine just validates), so deployments can opt in
     declaratively without touching their ``__init__``.
     """
     if continuous and (stream or pad_to_bucket or buckets is not None):
@@ -379,10 +382,13 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
             "continuous=True replaces the flusher with an engine slot "
             "pool; stream/pad_to_bucket/buckets do not apply")
     if not continuous and (page_size is not None
-                           or prefix_cache is not None):
+                           or prefix_cache is not None
+                           or spec_decode is not None
+                           or draft_k is not None
+                           or spec_threshold is not None):
         raise ValueError(
-            "page_size/prefix_cache are paged-KV engine knobs; they "
-            "require continuous=True")
+            "page_size/prefix_cache/spec_decode/draft_k/spec_threshold "
+            "are decode-engine knobs; they require continuous=True")
     if buckets is not None:
         bs = sorted(int(b) for b in buckets)
         if not bs or bs[0] < 1:
@@ -401,7 +407,9 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
     def decorate(fn):
         is_method = _looks_like_method(fn)
         if continuous:
-            return _decorate_continuous(fn, page_size, prefix_cache)
+            return _decorate_continuous(fn, page_size, prefix_cache,
+                                        spec_decode, draft_k,
+                                        spec_threshold)
         cfg = (max_batch_size, batch_wait_timeout_s, pad_to_bucket,
                tuple(buckets) if buckets else None, stream)
         key = (getattr(fn, "__module__", ""), getattr(fn, "__qualname__", ""))
@@ -435,16 +443,19 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
 
 
 def _decorate_continuous(fn, page_size: Optional[int] = None,
-                         prefix_cache: Optional[bool] = None):
+                         prefix_cache: Optional[bool] = None,
+                         spec_decode=None, draft_k: Optional[int] = None,
+                         spec_threshold: Optional[float] = None):
     """Engine-backed admission path: per request, the handler maps the
     item to ``(engine, submit_kwargs)`` and the wrapper feeds the
     engine's admission queue, inheriting the request's deadline (so the
     engine can drop it unstarted or free its slot mid-generation) and
     trace context (so ``engine.admission`` / per-dispatch
     ``decode.chunk`` spans join the request's trace). Decorator-level
-    ``page_size``/``prefix_cache`` are pushed into the engine via
-    ``ensure_paging`` the first time each engine instance passes
-    through (a cheap identity check afterwards)."""
+    ``page_size``/``prefix_cache``/``spec_decode``/``draft_k`` are
+    pushed into the engine via ``apply_config`` the first time each
+    engine instance passes through (a cheap identity check
+    afterwards)."""
 
     import weakref
 
@@ -461,10 +472,15 @@ def _decorate_continuous(fn, page_size: Optional[int] = None,
                 f"@serve.batch(continuous=True) handler "
                 f"{fn.__qualname__} must return (engine, submit_kwargs),"
                 f" got {type(out).__name__}") from None
-        if (page_size is not None or prefix_cache is not None) \
+        if (page_size is not None or prefix_cache is not None
+                or spec_decode is not None or draft_k is not None
+                or spec_threshold is not None) \
                 and engine not in configured:
-            engine.ensure_paging(page_size=page_size,
-                                 prefix_cache=prefix_cache)
+            engine.apply_config(page_size=page_size,
+                                prefix_cache=prefix_cache,
+                                spec_decode=spec_decode,
+                                draft_k=draft_k,
+                                spec_threshold=spec_threshold)
             configured.add(engine)
         # Mid-stream failover replay token: a resumed request (its first
         # replica died after delivering n tokens) replays the SAME

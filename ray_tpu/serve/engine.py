@@ -97,6 +97,40 @@ three ways:
 - :meth:`inject_fault` arms the chaos harness (driver death / wedge /
   process kill at token N) driven by ``tests/test_serve_chaos.py`` and
   ``benchmarks/serve_gpt.py --chaos``.
+
+**Speculative decoding** (ISSUE 9 tentpole, ``spec_decode=..``): the
+chunk path above pays one full target forward per generated token —
+decode stays memory-bandwidth-bound on weights/KV per token. With a
+drafter configured (``spec_decode="ngram"`` / ``"model"`` / a
+:class:`~.draft.Drafter` instance; ``draft_k`` proposals per round),
+the driver interleaves **draft → verify** per chunk boundary instead:
+
+- the drafter proposes ``draft_k`` tokens per active slot (host-side
+  n-gram table, or a small GPT on its own slot pool — see
+  :mod:`~.draft`);
+- ONE batched target forward
+  (:func:`~ray_tpu.models.gpt_decode.verify_chunk_slots`, paged twin
+  included) scores all ``draft_k + 1`` logit rows, computes each
+  slot's accepted length with exact rejection sampling (greedy match
+  at temperature 0; point-mass residual resampling above it — the
+  committed stream is the target's own distribution for ANY drafter,
+  and bitwise the greedy stream at temperature 0), samples the
+  bonus/correction token, and rolls each slot's KV write cursor back
+  past its rejected positions in-program;
+- each slot advances by its OWN ``accepted + 1`` — the variable
+  per-slot advance rides the same EOS/deadline/freeing/``resume_from``
+  replay logic as the fixed-k path (replay tokens count DELIVERED
+  tokens, so crash-resume stays token-identical through any acceptance
+  pattern).
+
+The compiled-program set grows by exactly ONE verify program per
+``draft_k`` (``len(prompt_buckets) + 1 + 1`` with the n-gram drafter);
+accepted-token throughput multiplies by the mean committed tokens per
+verify forward (``1 + mean_accept_len``) while the per-forward cost
+stays one weight sweep. Wired through the config plane as
+``@serve.batch(continuous=True, spec_decode=.., draft_k=..)`` and the
+deployment schema's ``engine:`` block; A/B'd in
+``benchmarks/serve_gpt.py --spec``.
 """
 from __future__ import annotations
 
@@ -343,8 +377,11 @@ class DecodeEngine:
                  paged: bool = False, page_size: int = 16,
                  n_pages: int = 0, prefix_cache: bool = True,
                  wedge_timeout_s: float = 30.0,
-                 max_driver_restarts: int = 1):
+                 max_driver_restarts: int = 1,
+                 spec_decode=None, draft_k: int = 4,
+                 spec_threshold: float = 0.0):
         from ..models import gpt_decode
+        from .draft import make_drafter
 
         self.params = params
         self.cfg = cfg
@@ -369,6 +406,37 @@ class DecodeEngine:
                 f"length {self.max_len}")
         self.prompt_buckets = buckets
         self._gd = gpt_decode
+        # ---- speculative decoding (ISSUE 9): an optional drafter turns
+        # the dispatch loop into draft -> verify; draft_k is the
+        # chunk-static proposal width (one verify program per value).
+        # spec_threshold > 0 enables POOL-WIDE adaptive speculation: a
+        # boundary verifies only while the drafters' self-assessed mean
+        # expected acceptance clears the threshold, else it runs ONE
+        # plain chunk dispatch — all-or-nothing, because the chunk
+        # program's cost is paid once for the whole pool, so a mixed
+        # boundary would pay both programs and always lose. Pool-wide
+        # decisions depend on pool COMPOSITION, which is only
+        # replay-safe when sampling consumes no randomness — hence
+        # greedy engines only (enforced below); temperature > 0 keeps
+        # threshold 0 (always verify), whose per-slot PRNG chains are
+        # independent of pool-mates.
+        self.draft_k = int(draft_k)
+        self.spec_threshold = float(spec_threshold)
+        if self.draft_k < 1:
+            raise ValueError("draft_k must be >= 1")
+        if self.spec_threshold > 0.0 and self.temperature > 0.0:
+            raise ValueError(
+                "spec_threshold > 0 (adaptive speculation) requires "
+                "temperature 0: the pool-wide verify-or-chunk decision "
+                "depends on which lanes share the pool, and a sampled "
+                "stream replayed on another pool would consume a "
+                "different PRNG chain — breaking crash-resume replay")
+        self._drafter = make_drafter(spec_decode, params, cfg)
+        if self._drafter is not None:
+            self._drafter.configure(
+                slots=self.slots, max_len=self.max_len,
+                prompt_buckets=self.prompt_buckets,
+                draft_k=self.draft_k)
         # Guards the put-vs-final-drain race: once _fail_all flips
         # _draining under this lock, no new submission can land in a
         # queue nobody will ever read again. Created BEFORE the pool so
@@ -398,7 +466,10 @@ class DecodeEngine:
                        "peak_active": 0, "prefix_hits": 0,
                        "prefix_tokens_reused": 0, "cow_copies": 0,
                        "admissions_deferred": 0, "lane_parks": 0,
-                       "preempted": 0, "resumed": 0, "driver_restarts": 0}
+                       "preempted": 0, "resumed": 0, "driver_restarts": 0,
+                       "spec_rounds": 0, "spec_proposed": 0,
+                       "spec_accepted": 0, "spec_fallback_rounds": 0,
+                       "spec_lanes": 0}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # ---- driver supervision (ISSUE 7): the driver stamps _beat at
@@ -444,6 +515,7 @@ class DecodeEngine:
                 cfg, self.chunk, self.temperature, self.eos_token)
             self._cache = gpt_decode.init_slot_cache(cfg, self.slots,
                                                      self.max_len)
+            self._bind_verify()
             return
         self.page_size = int(page_size)
         if self.page_size < 1:
@@ -468,6 +540,22 @@ class DecodeEngine:
             self.eos_token)
         self._cache = gpt_decode.init_paged_cache(
             cfg, self.slots, self.n_pages, self.page_size)
+        self._bind_verify()
+
+    def _bind_verify(self):  # rtlint: holds=_admit_lock
+        """(Re)bind the verify program to the current pool layout and
+        drafter — ONE compiled program per (pool shape, draft_k), or
+        None with speculative decoding off. Called from
+        :meth:`_build_pool` and :meth:`ensure_spec`, both of which hold
+        ``_admit_lock``."""
+        if self._drafter is None:
+            self._verify = None
+        elif self.paged:
+            self._verify = self._gd.jit_verify_chunk_slots_paged(
+                self.cfg, self.draft_k, self.page_size, self.temperature)
+        else:
+            self._verify = self._gd.jit_verify_chunk_slots(
+                self.cfg, self.draft_k, self.temperature)
 
     def ensure_paging(self, page_size: Optional[int] = None,
                       prefix_cache: Optional[bool] = None,
@@ -514,6 +602,83 @@ class DecodeEngine:
                 elif not prefix_cache and self._prefix is not None:
                     self._prefix.clear()
                     self._prefix = None
+        return self
+
+    def ensure_spec(self, spec_decode=None, draft_k: Optional[int] = None,
+                    spec_threshold: Optional[float] = None):
+        """Idempotently apply the speculative-decoding knobs from the
+        config plane (``@serve.batch(continuous=True, spec_decode=..)``
+        or the deployment schema's ``engine:`` block). A matching
+        engine is a no-op; a mismatched engine is reconfigured IF it
+        has never admitted a request, else this raises — the drafter's
+        per-slot state and the verify program are load-bearing, not
+        something to swap under live lanes."""
+        from .draft import make_drafter
+
+        if draft_k is not None and int(draft_k) < 1:
+            raise ValueError("draft_k must be >= 1")
+        with self._admit_lock:
+            want_k = int(draft_k) if draft_k is not None else self.draft_k
+            cur = self._drafter
+            if spec_decode is None:
+                want = cur
+            elif isinstance(spec_decode, str) and cur is not None \
+                    and cur.name == spec_decode:
+                want = cur
+            elif spec_decode is True and cur is not None:
+                want = cur
+            else:
+                want = make_drafter(spec_decode, self.params, self.cfg)
+            want_thr = float(spec_threshold) \
+                if spec_threshold is not None else self.spec_threshold
+            if want_thr > 0.0 and self.temperature > 0.0:
+                raise ValueError(
+                    "spec_threshold > 0 (adaptive speculation) "
+                    "requires temperature 0 — see DecodeEngine")
+            if want is cur and want_k == self.draft_k \
+                    and want_thr == self.spec_threshold:
+                return self
+            with self._stats_lock:
+                used = self._stats["admitted"]
+            if used or self._queue.qsize() or self._pending or \
+                    any(s is not None for s in self._state):
+                raise ValueError(
+                    "cannot change spec_decode/draft_k on a live "
+                    "engine; construct it with the knobs or apply the "
+                    "config before traffic")
+            self.draft_k = want_k
+            self.spec_threshold = want_thr
+            self._drafter = want
+            if want is not None:
+                want.configure(slots=self.slots, max_len=self.max_len,
+                               prompt_buckets=self.prompt_buckets,
+                               draft_k=self.draft_k)
+            self._bind_verify()
+        return self
+
+    #: Config-plane knob split for :meth:`apply_config`.
+    _PAGE_KEYS = ("page_size", "prefix_cache", "n_pages")
+    _SPEC_KEYS = ("spec_decode", "draft_k", "spec_threshold")
+
+    def apply_config(self, **knobs):
+        """Route a deployment ``engine:`` config block to the right
+        idempotent applier: paged-KV knobs to :meth:`ensure_paging`,
+        speculative-decoding knobs to :meth:`ensure_spec`. Unknown keys
+        raise (the schema validates too — this guards direct callers).
+        """
+        unknown = set(knobs) - set(self._PAGE_KEYS) - set(self._SPEC_KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown engine config keys {sorted(unknown)}; known: "
+                f"{sorted(self._PAGE_KEYS + self._SPEC_KEYS)}")
+        page = {k: v for k, v in knobs.items()
+                if k in self._PAGE_KEYS and v is not None}
+        spec = {k: v for k, v in knobs.items()
+                if k in self._SPEC_KEYS and v is not None}
+        if page:
+            self.ensure_paging(**page)
+        if spec:
+            self.ensure_spec(**spec)
         return self
 
     # ------------------------------------------------------------- admission
@@ -693,6 +858,10 @@ class DecodeEngine:
             with self._admit_lock:
                 self._build_pool(self.paged, self.page_size or 16,
                                  self.n_pages, self._prefix is not None)
+                if self._drafter is not None:
+                    # The pool was rebuilt from scratch and every lane
+                    # failed; per-slot drafter state must follow.
+                    self._drafter.reset()
                 self._state = [None] * self.slots
                 self._token = np.zeros((self.slots,), np.int32)
                 self._rngs = np.zeros((self.slots, 2), np.uint32)
@@ -769,6 +938,25 @@ class DecodeEngine:
             (out["dispatches"] + out["prefills"]) / max(out["tokens"], 1))
         out["paged"] = self.paged
         out["deployment"] = self.deployment
+        sp_r = out.pop("spec_rounds")
+        sp_p = out.pop("spec_proposed")
+        sp_a = out.pop("spec_accepted")
+        sp_f = out.pop("spec_fallback_rounds")
+        sp_l = out.pop("spec_lanes")
+        if self._drafter is not None:
+            out["spec"] = {
+                "drafter": self._drafter.name,
+                "draft_k": self.draft_k,
+                "threshold": self.spec_threshold,
+                "rounds": sp_r, "proposed": sp_p, "accepted": sp_a,
+                "lanes": sp_l, "fallback_rounds": sp_f,
+                "acceptance_rate": sp_a / max(sp_p, 1),
+                # Per LANE per verify forward (the literature's
+                # numbers): a lane commits its accepted prefix PLUS
+                # the correction/bonus token every round it verifies.
+                "mean_accept_len": sp_a / max(sp_l, 1),
+                "accepted_per_forward": (sp_a + sp_l) / max(sp_l, 1),
+            }
         t = self._thread
         out["driver_alive"] = bool(t is not None and t.is_alive())
         out["heartbeat_age_s"] = round(time.monotonic() - self._beat, 3)
@@ -828,7 +1016,10 @@ class DecodeEngine:
                     except queue.Empty:
                         continue
                     continue  # boundary: admission pass first
-                self._dispatch_chunk(epoch)
+                if self._drafter is not None:
+                    self._dispatch_spec(epoch)
+                else:
+                    self._dispatch_chunk(epoch)
             self._fail_all(EngineShutdownError("engine shut down"),
                            epoch=epoch)
         except BaseException as e:  # noqa: BLE001 - driver died: fan out
@@ -896,6 +1087,8 @@ class DecodeEngine:
         if st is not None and st.pages:
             self._pool.unref(st.pages)
             self._pt[i, :] = self._gd.PT_SENTINEL
+        if st is not None and self._drafter is not None:
+            self._drafter.free(i)
         self._state[i] = None
 
     def _alloc_pages(self, n: int, pool: Optional[_PagePool] = None,
@@ -1051,6 +1244,10 @@ class DecodeEngine:
             deadline_s=req.deadline_s, trace_ctx=req.trace_ctx,
             req=req, emitted=1 if req.skip == 0 else req.skip,
             pos=P, pages=pages, skip=skip)
+        if self._drafter is not None:
+            # Deterministic per-slot drafter state from the prompt +
+            # first token — a resume_from replay rebuilds it bit-equal.
+            self._drafter.admit(slot, req.prompt, first)
         self._observe_pages(sm)
         return True
 
@@ -1177,7 +1374,18 @@ class DecodeEngine:
         for i, st in enumerate(self._state):
             if st is None:
                 continue
-            need = st.pos + min(self.chunk, st.remaining)
+            if self._drafter is not None:
+                # Verify writes K/V at pos..pos+draft_k (the fed token
+                # plus every proposal); writes past the covered pages
+                # drop, which is only safe for positions a CONTINUING
+                # lane can never commit — i.e. beyond remaining. Under
+                # adaptive speculation the slot may instead run a chunk
+                # round this boundary, so cover the max of both modes.
+                need = st.pos + max(
+                    min(self.draft_k, st.remaining) + 1,
+                    min(self.chunk, st.remaining))
+            else:
+                need = st.pos + min(self.chunk, st.remaining)
             while len(st.pages) * ps < need:
                 got = self._alloc_pages(1)
                 if got is None:
@@ -1212,13 +1420,18 @@ class DecodeEngine:
         self._observe_pages()
         return False
 
-    def _dispatch_chunk(self, epoch: int = -1):  # rtlint: owner=driver
+    # rtlint: owner=driver
+    def _dispatch_chunk(self, epoch: int = -1, cover: bool = True):
         """ONE fused device dispatch decoding every active slot, then
         per-slot routing/trimming and boundary frees. A stale driver —
         one whose dispatch was stuck on the device while the supervisor
         restarted past it — drops the whole result at the post-dispatch
         epoch guard: its lanes were already failed retryably and the
-        pool rebuilt."""
+        pool rebuilt.
+
+        ``cover=False`` serves adaptive speculation: the spec
+        dispatcher already ran the coverage pass for this boundary
+        before deciding to fall back to a chunk round."""
         from .._private.metrics import serve_metrics
 
         if epoch >= 0 and epoch != self._epoch:
@@ -1226,7 +1439,7 @@ class DecodeEngine:
             # it against the NEW driver's pool would preempt a healthy
             # restarted lane.
             return
-        if self.paged and not self._cover_pages():
+        if cover and self.paged and not self._cover_pages():
             return                    # re-run admission/coverage pass
         active = np.array([s is not None and not s.parked
                            for s in self._state], bool)
@@ -1302,8 +1515,147 @@ class DecodeEngine:
                 st.lane.q.put((_STREAM_END, None))
                 self._free_slot(i)
                 self._count(completed=1)
+            elif self._drafter is not None:
+                # Adaptive fallback round: keep the drafter's history
+                # (and its self-assessment) current; -1 marks "nothing
+                # was proposed this round".
+                self._drafter.observe(i, row[:j], -1)
         if emitted:
             sm["engine_tokens"].inc(
                 emitted, labels={"deployment": self.deployment})
+            self._count(tokens=emitted)
+        self._observe_pages(sm)
+
+    def _dispatch_spec(self, epoch: int = -1):  # rtlint: owner=driver
+        """Draft-k-verify-once twin of :meth:`_dispatch_chunk`
+        (ISSUE 9): the drafter proposes ``draft_k`` tokens per active
+        slot, ONE batched target forward verifies them all, and each
+        slot advances by its OWN ``accepted + 1`` (the target's
+        correction/bonus token rides along) — variable per-slot advance
+        flowing through the same EOS/deadline/freeing/``resume_from``
+        replay logic as the fixed-k path. A stale driver drops the
+        whole result at the post-dispatch epoch guard.
+
+        ``spec_threshold > 0`` makes speculation POOL-WIDE adaptive:
+        the boundary verifies only when the drafters' mean
+        self-assessed acceptance over the runnable lanes clears the
+        threshold, and runs ONE plain chunk dispatch otherwise. The
+        decision is all-or-nothing because the chunk program's cost is
+        paid once for the whole pool — a boundary that dispatched both
+        programs for a split pool would always commit fewer tokens per
+        wall-second than chunking everyone. Greedy engines only (the
+        constructor enforces it): the decision depends on pool
+        composition, which is replay-safe only when sampling consumes
+        no randomness."""
+        from .._private.metrics import serve_metrics
+
+        if epoch >= 0 and epoch != self._epoch:
+            return
+        if self.paged and not self._cover_pages():
+            return                    # re-run admission/coverage pass
+        active = np.array([s is not None and not s.parked
+                           for s in self._state], bool)
+        n_active = int(active.sum())
+        if not n_active:
+            return
+        if self.spec_threshold > 0.0:
+            ests = [self._drafter.estimate(i)
+                    for i in range(self.slots) if active[i]]
+            if not any(e is None for e in ests) \
+                    and sum(ests) / n_active < self.spec_threshold:
+                # Unpredictable pool: one chunk dispatch beats a verify
+                # that would mostly commit correction tokens. The
+                # drafter still observes (chunk path) so its estimate
+                # recovers the moment streams turn repetitive.
+                self._count(spec_fallback_rounds=1)
+                self._dispatch_chunk(epoch, cover=False)
+                return
+        draft = self._drafter.propose(active, self._token)
+        t0 = time.time()
+        if self.paged:
+            committed, n_acc, cache, rngs = self._verify(
+                self.params, self._cache, self._token, draft,
+                self._rngs, active, self._pt)
+        else:
+            committed, n_acc, cache, rngs = self._verify(
+                self.params, self._cache, self._token, draft,
+                self._rngs, active)
+        com_np = np.asarray(committed)    # ONE transfer per verify
+        acc_np = np.asarray(n_acc)
+        rngs_np = np.asarray(rngs)
+        t1 = time.time()
+        if epoch >= 0 and epoch != self._epoch:
+            return                    # stale driver: drop on the floor
+        self._cache = cache
+        sm = serve_metrics()
+        labels = {"deployment": self.deployment}
+        sm["engine_slot_occupancy"].observe(n_active / self.slots,
+                                            labels=labels)
+        sm["engine_dispatches"].inc(labels=labels)
+        accepted_total = int(acc_np[active].sum()) if n_active else 0
+        sm["engine_spec_proposed"].inc(self.draft_k * n_active,
+                                       labels=labels)
+        if accepted_total:
+            sm["engine_spec_accepted"].inc(accepted_total, labels=labels)
+        self._count(dispatches=1, occupancy_sum=n_active / self.slots,
+                    spec_rounds=1, spec_proposed=self.draft_k * n_active,
+                    spec_accepted=accepted_total, spec_lanes=n_active)
+        with self._stats_lock:
+            self._stats["peak_active"] = max(self._stats["peak_active"],
+                                             n_active)
+        emitted = 0
+        for i, st in enumerate(self._state):
+            if st is None or st.parked or not active[i]:
+                continue                     # parked or chunk-mode slot
+            na = int(acc_np[i])
+            adv = na + 1
+            sm["engine_spec_accept_len"].observe(na, labels=labels)
+            self._rngs[i] = rngs_np[i]
+            st.pos += adv                    # mirrors the device pos
+            if st.lane.closed:               # consumer left: free now
+                self._free_slot(i)
+                self._count(abandoned=1)
+                continue
+            if deadline_expired(st.deadline_s):
+                st.lane.q.put(("err", RequestDeadlineExceeded(
+                    "request deadline passed mid-generation")))
+                self._free_slot(i)
+                self._count(expired=1)
+                sm["requests_expired"].inc(
+                    labels={"where": "engine",
+                            "deployment": self.deployment})
+                continue
+            row = com_np[i]
+            j = min(adv, st.remaining)
+            finished = st.remaining <= adv
+            if self.eos_token >= 0:
+                hits = np.flatnonzero(row[:j] == self.eos_token)
+                if hits.size:                # free at the EOS
+                    j = int(hits[0]) + 1
+                    finished = True
+            self._token[i] = row[j - 1]      # last DELIVERED token
+            if st.trace_ctx is not None:
+                tracing.record_span("decode.chunk", t0, t1,
+                                    parent_ctx=st.trace_ctx, slot=i,
+                                    active_slots=n_active, tokens=j,
+                                    accepted=na,
+                                    deployment=self.deployment)
+            # Replay suppression counts DELIVERED tokens — variable
+            # advance changes nothing about the token arithmetic.
+            cut = min(st.skip, j)
+            st.skip -= cut
+            if j > cut:
+                st.lane.q.put(("item", row[cut:j].copy()))
+                st.emitted += j - cut
+                emitted += j - cut
+            st.remaining -= j
+            if finished:
+                st.lane.q.put((_STREAM_END, None))
+                self._free_slot(i)           # drafter.free rides along
+                self._count(completed=1)
+            else:
+                self._drafter.observe(i, row[:j], na)
+        if emitted:
+            sm["engine_tokens"].inc(emitted, labels=labels)
             self._count(tokens=emitted)
         self._observe_pages(sm)

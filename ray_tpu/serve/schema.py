@@ -38,13 +38,17 @@ class DeploymentSchema:
     autoscaling_config: Optional[Dict[str, Any]] = None
     user_config: Any = None
     ray_actor_options: Optional[Dict[str, Any]] = None
-    #: Paged KV-cache block for continuous-batching deployments:
-    #: ``engine: {page_size: 16, prefix_cache: true, n_pages: 512}``.
-    #: The replica applies it to every DecodeEngine the deployment
-    #: constructs (see ``DeploymentConfig.engine_config``).
+    #: Decode-engine block for continuous-batching deployments:
+    #: ``engine: {page_size: 16, prefix_cache: true, n_pages: 512,
+    #: spec_decode: ngram, draft_k: 4}`` — paged-KV knobs plus the
+    #: speculative-decoding knobs. The replica applies it to every
+    #: DecodeEngine the deployment constructs (see
+    #: ``DeploymentConfig.engine_config``).
     engine: Optional[Dict[str, Any]] = None
 
-    _ENGINE_KEYS = frozenset({"page_size", "prefix_cache", "n_pages"})
+    _ENGINE_KEYS = frozenset({"page_size", "prefix_cache", "n_pages",
+                              "spec_decode", "draft_k",
+                              "spec_threshold"})
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSchema":

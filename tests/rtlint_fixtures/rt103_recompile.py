@@ -40,3 +40,39 @@ def static_argnums_flow(jax, fn, x):
     bad = jitted(x, len(x))  # FIRES RT103
     also_ok = jitted(len(x), 8)            # pos 0 is traced, not static
     return ok, bad, also_ok
+
+
+@functools.lru_cache(maxsize=64)
+def jit_verify_chunk_slots(cfg, k, temperature=0.0):
+    return lambda *a: a
+
+
+@functools.lru_cache(maxsize=64)
+def jit_verify_chunk_slots_paged(cfg, k, page_size, temperature=0.0):
+    return lambda *a: a
+
+
+class SpecDriver:
+    """ISSUE 9: the verify factories obey the same static-knob
+    discipline as the decode factories — draft_k must be a bounded
+    config value, never derived from the draft batch itself."""
+
+    def __init__(self, cfg, draft_k, page_size):
+        # Bounded, hashable static knobs: clean.
+        self.verify = jit_verify_chunk_slots(cfg, draft_k)
+        self.verify_paged = jit_verify_chunk_slots_paged(
+            cfg, draft_k, page_size)
+
+    def hazard_draft_width(self, cfg, draft):
+        return jit_verify_chunk_slots(cfg, draft.shape[1])  # FIRES RT103
+
+    def hazard_paged_unhashable(self, cfg, sizes):
+        return jit_verify_chunk_slots_paged(cfg, 4, [16])  # FIRES RT103
+
+    def hazard_paged_len(self, cfg, draft, pages):
+        return jit_verify_chunk_slots_paged(
+            cfg, 4, len(pages))  # FIRES RT103
+
+    def suppressed(self, cfg, draft):
+        # rtlint: disable=RT103 bounded: draft is always [slots, draft_k]
+        return jit_verify_chunk_slots(cfg, draft.shape[1])

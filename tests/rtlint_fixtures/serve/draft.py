@@ -1,0 +1,33 @@
+"""RT102 fixture: driver-thread dispatch ownership extends to the
+speculative-decoding surface (ISSUE 9) — ``serve/draft.py`` is in the
+rule's path scope and ``self._verify`` / ``self._ingest`` are dispatch
+attrs. Never imported."""
+
+
+def jit_verify_fixture(cfg, k):
+    def step(params):
+        return params
+    return step
+
+
+class FixtureDrafter:
+    def __init__(self, cfg, k):
+        # Binding a factory result is construction, not a dispatch.
+        self._verify = jit_verify_fixture(cfg, k)
+        self._ingest = jit_verify_fixture(cfg, 1)
+
+    # rtlint: owner=driver
+    def _dispatch_spec(self, params):
+        a = self._verify(params)
+        b = self._ingest(params)
+        return a, b
+
+    def rogue_verify(self, params):
+        return self._verify(params)  # FIRES RT102
+
+    def rogue_ingest(self, params):
+        return self._ingest(params)  # FIRES RT102
+
+    def suppressed(self, params):
+        # rtlint: disable=RT102 test-only synchronous probe
+        return self._verify(params)

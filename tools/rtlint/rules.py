@@ -166,9 +166,10 @@ class LockGuardRule(Rule):
 
 # ------------------------------------------------------------------ RT102
 class DriverOwnershipRule(Rule):
-    """RT102: device-dispatch calls in the decode engine must run on
-    the driver thread. Lexically: calls to the bound jit wrappers
-    (``self._prefill`` / ``self._step``) or an immediately-invoked
+    """RT102: device-dispatch calls in the decode engine (and its
+    drafters — ISSUE 9) must run on the driver thread. Lexically: calls
+    to the bound jit wrappers (``self._prefill`` / ``self._step`` /
+    ``self._verify`` / ``self._ingest``) or an immediately-invoked
     ``jit_*`` factory (``jit_x(...)(...)``) are only allowed inside
     methods annotated ``# rtlint: owner=driver``. Binding a factory
     (``self._prefill = jit_prefill(...)``) is construction, not a
@@ -177,10 +178,11 @@ class DriverOwnershipRule(Rule):
     id = "RT102"
     summary = "device dispatch outside a driver-annotated method"
 
-    DISPATCH_ATTRS = ("_prefill", "_step")
+    DISPATCH_ATTRS = ("_prefill", "_step", "_verify", "_ingest")
 
     def applies(self, mod: Module) -> bool:
-        return mod.relpath.endswith("serve/engine.py")
+        return mod.relpath.endswith(("serve/engine.py",
+                                     "serve/draft.py"))
 
     def check(self, mod: Module) -> Iterable[Finding]:
         yield from self._walk(mod, mod.tree, scope="<module>",
