@@ -436,6 +436,11 @@ def serve_metrics() -> dict:
             engine_tokens=Counter(
                 "serve_engine_tokens_total",
                 "Tokens emitted to engine stream lanes"),
+            engine_queue_depth=Gauge(
+                "serve_engine_queue_depth",
+                "Requests accepted by the engine but not yet admitted "
+                "to a slot (admission backlog), set once per driver "
+                "loop — the offline batch-inference throttle signal"),
             # ---- speculative decoding (ISSUE 9). Observed on the
             # engine driver thread, once per draft->verify round.
             engine_spec_proposed=Counter(

@@ -28,6 +28,11 @@ from .executor import (  # noqa: F401
     DataContext,
     DataIterator,
 )
+from .llm import (  # noqa: F401
+    BatchInferencer,
+    EngineSaturationPolicy,
+    ProgressLog,
+)
 
 from ray_tpu._private.usage_stats import record_feature as _rf  # noqa: E402
 _rf("data")

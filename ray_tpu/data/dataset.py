@@ -85,6 +85,44 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    def generate(self, model, prompts_col: str = "prompt", *,
+                 output_col: str = "generated", max_new: int = 32,
+                 max_new_col: Optional[str] = None, seed: int = 0,
+                 num_engines: int = 1, queue_factor: float = 2.0,
+                 progress_path: Optional[str] = None,
+                 fingerprint_extra: Optional[Dict[str, Any]] = None,
+                 max_retries: int = 4, **engine_knobs) -> "Dataset":
+        """Offline batch inference (ISSUE 11): stream this dataset's
+        blocks through one or more continuous-batching DecodeEngines at
+        maximum slot occupancy; every row gains an ``output_col`` token
+        column. ``model`` is a ``DecodeEngine``, a list of them, or a
+        ``(params, cfg)`` tuple (then ``num_engines`` engines are built
+        from ``engine_knobs`` and torn down when the iterator closes).
+        With ``progress_path``, completed blocks commit durably and a
+        killed run resumes exactly-once with token-identical output —
+        see :class:`ray_tpu.data.llm.BatchInferencer`."""
+        src = Dataset(list(self._ops))
+
+        def make():
+            from .llm import BatchInferencer, resolve_engines
+
+            engines, owned = resolve_engines(
+                model, num_engines=num_engines, **engine_knobs)
+            bi = BatchInferencer(
+                engines, prompts_col=prompts_col, output_col=output_col,
+                max_new=max_new, max_new_col=max_new_col, seed=seed,
+                queue_factor=queue_factor, progress_path=progress_path,
+                fingerprint_extra=fingerprint_extra,
+                max_retries=max_retries)
+            try:
+                yield from bi.run(src)
+            finally:
+                if owned:
+                    for eng in engines:
+                        eng.shutdown()
+
+        return Dataset([_Op("read", make_blocks=make)])
+
     # ------------------------------------------------------- execution
     def _exec_blocks(self) -> Iterator[B.Block]:
         """Execute the plan; yields materialized blocks (streamed)."""

@@ -1,0 +1,711 @@
+"""Offline batch inference: a checkpointed streaming Data → DecodeEngine
+pipeline (ISSUE 11 tentpole).
+
+Online serving (``ray_tpu.serve``) sheds load past capacity; the
+complementary production scenario is "run 10M prompts overnight at
+maximum occupancy". This module bridges the two planes the repo already
+has — the pull-based block pipeline (:mod:`ray_tpu.data.executor`) and
+the continuous-batching :class:`~ray_tpu.serve.engine.DecodeEngine` —
+into one driver:
+
+- **Streaming**: input blocks flow from any :class:`Dataset` plan; rows
+  are admitted to one or more engines via ``engine.submit(...)`` and
+  their token streams collected concurrently. Nothing materializes the
+  dataset: driver memory holds only the in-flight window plus completed
+  blocks awaiting their in-order yield.
+- **Backpressure**: admission is throttled by
+  :class:`EngineSaturationPolicy`, a
+  :class:`~ray_tpu.data.executor.BackpressurePolicy` driven by the
+  engines' live ``queue_depth()`` signal — keep enough backlog queued
+  that the slot pool never starves (occupancy stays ~1.0), but never
+  more than ``queue_factor`` slots' worth, so admission queues stay
+  bounded no matter how large the dataset is.
+- **Checkpointing**: with ``progress_path`` set, every completed block
+  commits durably (atomic directory rename; payload via
+  :class:`~ray_tpu.train.checkpoint.Checkpoint`, retention via
+  :class:`~ray_tpu.train.checkpoint.CheckpointManager`) before it is
+  yielded. A killed driver resumes **exactly-once**: committed blocks
+  are served from the log without resubmitting a single row, and
+  uncommitted blocks regenerate deterministically (per-row seeds are a
+  pure function of the global row index, and the engine's generation is
+  a pure function of prompt + knobs + seed), so the resumed output is
+  token-identical to an uninterrupted run — temp 0 AND seeded temp > 0.
+- **Fault tolerance in-run**: a retryable engine failure mid-stream
+  (driver death/restart, drain) resubmits the row with
+  ``resume_from=<delivered count>`` — the PR 7 replay machinery — after
+  giving the engine's supervisor a chance to restart a dead driver, so
+  one crashed engine costs a replay, not the run.
+
+Determinism contract: exactly-once resume assumes the upstream dataset
+plan re-executes deterministically (same blocks, same row order). All
+the built-in sources and stateless transforms do; a nondeterministic
+``random_shuffle(seed=None)`` upstream of ``generate`` forfeits resume
+identity (commit a materialized dataset first).
+
+The pipeline driver is single-threaded by design: the thread iterating
+:meth:`BatchInferencer.run` owns every submit/collect/commit, mirroring
+the engine's own one-driver-thread dispatch discipline (methods are
+annotated ``# rtlint: owner=driver`` and ``data/llm.py`` is in rtlint's
+RT102/RT107 scope).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from . import block as B
+from .executor import BackpressurePolicy
+
+
+class EngineSaturationPolicy(BackpressurePolicy):
+    """Admission throttle driven by live engine occupancy signals.
+
+    The pull pipeline's stock policies bound *task* concurrency; batch
+    inference needs to bound *engine backlog*: enough queued requests
+    that a freed slot re-fills at the very next chunk boundary (the pool
+    stays saturated), but no more than ``queue_factor * slots`` per
+    engine, so a 10M-row dataset never piles into an unbounded admission
+    queue. The signal is :meth:`DecodeEngine.queue_depth` — the same
+    number exported as the ``serve_engine_queue_depth`` gauge.
+    """
+
+    def __init__(self, engines: Sequence, queue_factor: float = 2.0):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("EngineSaturationPolicy needs >= 1 engine")
+        if queue_factor <= 0:
+            raise ValueError(
+                f"queue_factor must be > 0, got {queue_factor}")
+        self.engines = engines
+        self.queue_factor = float(queue_factor)
+
+    def _limit(self, eng) -> int:
+        return max(1, int(round(self.queue_factor * eng.slots)))
+
+    def can_add_input(self, num_in_flight: int) -> bool:
+        return any(e.queue_depth() < self._limit(e) for e in self.engines)
+
+    def pick(self):
+        """The least-backlogged engine with queue headroom, or None
+        (every engine's backlog is at its bound — the caller waits for
+        a chunk boundary to drain some)."""
+        best, best_depth = None, None
+        for e in self.engines:
+            d = e.queue_depth()
+            if d >= self._limit(e):
+                continue
+            if best is None or d < best_depth:
+                best, best_depth = e, d
+        return best
+
+
+class ProgressLog:
+    """Durable per-block completion log backing exactly-once resume.
+
+    Layout under ``path``::
+
+        manifest.json          run fingerprint (knobs that determine
+                               output); a resume with different knobs
+                               raises instead of silently mixing runs
+        block_000007/          one committed block (atomic rename from
+            gen.npz            _staging): generated tokens per row via
+            meta.json          Checkpoint.from_state, plus the output
+            rows.npy           rows (sans tokens) as a pickled object
+        _staging/              array — python/numpy types round-trip
+                               EXACTLY, so a resumed block's rows are
+                               indistinguishable from freshly
+                               generated ones downstream.
+                               _staging/ holds in-progress payloads;
+                               wiped on open.
+
+    A block directory either exists completely (the rename is atomic on
+    one filesystem) or not at all — SIGKILL at any instant leaves the
+    log consistent. Committed dirs are re-registered into a
+    :class:`~ray_tpu.train.checkpoint.CheckpointManager` on open, so
+    retention/latest/best semantics stay available to callers.
+    """
+
+    _BLOCK_RE = re.compile(r"^block_(\d+)$")
+
+    def __init__(self, path: str, fingerprint: Optional[dict] = None):
+        from ..train.checkpoint import Checkpoint, CheckpointManager
+
+        self.path = os.path.abspath(path)
+        os.makedirs(self.path, exist_ok=True)
+        self._staging = os.path.join(self.path, "_staging")
+        shutil.rmtree(self._staging, ignore_errors=True)
+        os.makedirs(self._staging, exist_ok=True)
+        fp = _canonical_fingerprint(fingerprint or {})
+        man = os.path.join(self.path, "manifest.json")
+        if os.path.exists(man):
+            with open(man) as f:
+                prev = json.load(f).get("fingerprint")
+            if prev != fp:
+                raise ValueError(
+                    f"progress log {self.path} was written by a run with "
+                    f"different generation knobs ({prev} != {fp}); "
+                    f"resuming would mix token streams from two "
+                    f"configurations — use a fresh progress_path or "
+                    f"delete the old log")
+        else:
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump({"fingerprint": fp}, f)
+            os.replace(tmp, man)
+        self._ckpt_cls = Checkpoint
+        self._mgr = CheckpointManager(storage_dir=self.path)
+        self._blocks: Dict[int, str] = {}
+        for name in sorted(os.listdir(self.path)):
+            m = self._BLOCK_RE.match(name)
+            if not m:
+                continue
+            d = os.path.join(self.path, name)
+            if not os.path.exists(os.path.join(d, "rows.npy")):
+                # Torn dir (unreachable via the atomic rename, but a
+                # partially deleted log or a dead writer's leftovers
+                # could leave one): never half-trust it, and never let
+                # it block this run's own commit rename later.
+                shutil.rmtree(d, ignore_errors=True)
+                continue
+            idx = int(m.group(1))
+            self._blocks[idx] = d
+            self._mgr.register(Checkpoint(d), {"block": idx})
+
+    @staticmethod
+    def scan(path: str) -> set:
+        """Committed block indices under ``path`` WITHOUT opening the
+        log (no manifest check) — the preemption harness polls this
+        from the watching process while the driver runs."""
+        out = set()
+        try:
+            names = os.listdir(path)
+        except OSError:
+            return out
+        for name in names:
+            m = ProgressLog._BLOCK_RE.match(name)
+            if m and os.path.exists(os.path.join(path, name, "rows.npy")):
+                out.add(int(m.group(1)))
+        return out
+
+    def committed(self) -> set:
+        return set(self._blocks)
+
+    def commit(self, idx: int, out_rows: List[Any],
+               gen: List[np.ndarray]) -> str:
+        """Durably record block ``idx``: token arrays through the
+        Checkpoint npz payload, rows (sans the token column) as a
+        pickled object array (exact type round-trip — a resumed block's
+        rows keep their np.ndarray/int/str identity), then ONE atomic
+        rename into place. ``rows.npy`` is written LAST inside staging,
+        so its presence inside a renamed dir marks a complete commit."""
+        ck = self._ckpt_cls.from_state(
+            [np.asarray(g, np.int32) for g in gen],
+            base_dir=self._staging, name="gen")
+        arr = np.empty((len(out_rows),), dtype=object)
+        for i, r in enumerate(out_rows):
+            arr[i] = r
+        np.save(os.path.join(ck.path, "rows.npy"), arr,
+                allow_pickle=True)
+        final = os.path.join(self.path, f"block_{idx:06d}")
+        if os.path.exists(final):
+            # Single-driver contract, but never crash a resumed run on
+            # leftovers: a COMPLETE dir means another writer already
+            # made this block durable (deterministic content — keep
+            # theirs); anything else is garbage os.replace would trip
+            # over (ENOTEMPTY).
+            if os.path.exists(os.path.join(final, "rows.npy")):
+                shutil.rmtree(ck.path, ignore_errors=True)
+                self._blocks[idx] = final
+                return final
+            shutil.rmtree(final)
+        os.replace(ck.path, final)
+        self._blocks[idx] = final
+        self._mgr.register(self._ckpt_cls(final), {"block": idx})
+        return final
+
+    def load(self, idx: int, output_col: str) -> B.Block:
+        """Reconstruct the committed output block for ``idx``."""
+        d = self._blocks[idx]
+        gen = self._ckpt_cls(d).load_state(name="gen")
+        rows = np.load(os.path.join(d, "rows.npy"),
+                       allow_pickle=True).tolist()
+        out = []
+        for r, g in zip(rows, gen):
+            row = dict(r)
+            row[output_col] = np.asarray(g, np.int32)
+            out.append(row)
+        return B.rows_to_block(out)
+
+
+def _canonical_fingerprint(d: dict) -> str:
+    return json.dumps(d, sort_keys=True, default=str)
+
+
+def _model_fingerprint(params) -> str:
+    """Cheap stable digest of the model weights: every leaf's shape,
+    dtype, and a bounded content sample (first 128 elements — a few
+    hundred bytes of device→host traffic per leaf, never the full
+    tensor). Enough to catch resuming a progress log against retrained
+    weights, which would silently mix two models' token streams."""
+    import hashlib
+
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(params)
+    except Exception:  # noqa: BLE001 - not a pytree: hash it alone
+        leaves = [params]
+    h = hashlib.sha1()
+    for leaf in leaves:
+        h.update(str(getattr(leaf, "shape", None)).encode())
+        h.update(str(getattr(leaf, "dtype", None)).encode())
+        try:
+            sample = np.asarray(leaf.ravel()[:128])
+        except Exception:  # noqa: BLE001 - unsliceable leaf (scalar)
+            sample = np.asarray(leaf)
+        h.update(np.ascontiguousarray(sample).tobytes())
+    return h.hexdigest()
+
+
+def _engine_generation_signature(eng) -> dict:
+    """The engine state that determines a stream's TOKENS for a given
+    (prompt, max_new, seed) — what a heterogeneous pool must agree on
+    and what the progress-log manifest fingerprints: the weights
+    themselves (sampled digest) and the sampling knobs. Speculative
+    decoding is exact (committed tokens match the plain path at temp 0,
+    and the target's distribution above it) but consumes the per-slot
+    PRNG on a different schedule, so at temp > 0 its knobs are
+    stream-determining too."""
+    drafter = getattr(eng, "_drafter", None)
+    return {
+        "model": _model_fingerprint(getattr(eng, "params", None)),
+        "temperature": getattr(eng, "temperature", 0.0),
+        "eos_token": getattr(eng, "eos_token", -1),
+        "spec_decode": getattr(drafter, "name", None)
+        if drafter is not None else None,
+        "draft_k": getattr(eng, "draft_k", None)
+        if drafter is not None else None,
+        "spec_threshold": getattr(eng, "spec_threshold", 0.0)
+        if drafter is not None else None,
+    }
+
+
+@dataclass
+class _Flight:
+    """One in-flight row: its engine stream plus everything needed to
+    replay it on another engine after a retryable failure."""
+
+    block_idx: int
+    row_pos: int
+    prompt: np.ndarray
+    max_new: int
+    seed: int
+    stream: Any = None            # _EngineStream
+    engine: Any = None
+    delivered: List[np.ndarray] = field(default_factory=list)
+    n_tok: int = 0                # tokens delivered (the replay token)
+    retries: int = 0
+
+
+@dataclass
+class _BlockState:
+    """A partially generated input block: rows submitted in order,
+    outputs land out of order, committed when the last row finishes."""
+
+    rows: List[Any]
+    outs: List[Optional[np.ndarray]]
+    done: int = 0
+
+
+class BatchInferencer:
+    """Stream dataset blocks through DecodeEngines at full occupancy.
+
+    Usage::
+
+        eng = DecodeEngine(params, cfg, slots=8, ...)
+        bi = BatchInferencer(eng, prompts_col="prompt", max_new=64,
+                             progress_path="/ckpt/run1")
+        for out_block in bi.run(dataset):
+            ...   # rows carry an extra ``generated`` token column
+
+    (or, one level up, ``dataset.generate(engine, "prompt", ...)``).
+
+    The thread iterating :meth:`run` is the pipeline driver: it owns
+    every submit, collect, and commit. Abandoning the iterator (normal
+    exhaustion, an exception, or ``gen.close()``) closes every in-flight
+    engine stream, so the engines free their slots/pages at the next
+    chunk boundary and stay admissible for the next run.
+    """
+
+    def __init__(self, engines, *, prompts_col: str = "prompt",
+                 output_col: str = "generated", max_new: int = 32,
+                 max_new_col: Optional[str] = None, seed: int = 0,
+                 queue_factor: float = 2.0,
+                 policy: Optional[EngineSaturationPolicy] = None,
+                 progress_path: Optional[str] = None,
+                 fingerprint_extra: Optional[dict] = None,
+                 max_retries: int = 4):
+        if not isinstance(engines, (list, tuple)):
+            engines = [engines]
+        if not engines:
+            raise ValueError("BatchInferencer needs >= 1 engine")
+        self.engines = list(engines)
+        # Rows route to whichever engine is least backlogged, so every
+        # generation-determining knob must agree across the pool — a
+        # heterogeneous pool would make output depend on load timing
+        # (and break resume identity with no error). The signature
+        # includes a sampled weight digest (device→host traffic per
+        # leaf), so compute it only when something consumes it: pool
+        # validation or the progress-log manifest.
+        sig0 = None
+        if len(self.engines) > 1 or progress_path:
+            sig0 = _engine_generation_signature(self.engines[0])
+        cap0 = (self.engines[0].prompt_buckets[-1],
+                self.engines[0].max_len)
+        for e in self.engines[1:]:
+            sig = _engine_generation_signature(e)
+            if sig != sig0:
+                raise ValueError(
+                    f"engines disagree on generation-determining knobs "
+                    f"({sig0} != {sig}); batch inference routes rows by "
+                    f"load, so every engine must produce identical "
+                    f"streams for the same (prompt, seed)")
+            cap = (e.prompt_buckets[-1], e.max_len)
+            if cap != cap0:
+                # Capacity doesn't change tokens, but routing is
+                # load-dependent: a row that fits one engine and not
+                # another would succeed or abort the run depending on
+                # timing.
+                raise ValueError(
+                    f"engines disagree on admission capacity (max "
+                    f"prompt bucket, max_len): {cap0} != {cap}; every "
+                    f"engine must admit every row")
+        self.prompts_col = prompts_col
+        self.output_col = output_col
+        self.max_new = int(max_new)
+        self.max_new_col = max_new_col
+        self.seed = int(seed)
+        self.max_retries = int(max_retries)
+        self.policy = policy or EngineSaturationPolicy(
+            self.engines, queue_factor)
+        self._log: Optional[ProgressLog] = None
+        if progress_path:
+            fp = {"prompts_col": prompts_col, "output_col": output_col,
+                  "max_new": self.max_new, "max_new_col": max_new_col,
+                  "seed": self.seed}
+            fp.update(sig0)
+            if fingerprint_extra:
+                fp.update(fingerprint_extra)
+            self._log = ProgressLog(progress_path, fp)
+        self._flights: Dict[int, _Flight] = {}
+        self._uid = 0
+        self.stats: Dict[str, Any] = {
+            "rows": 0, "rows_resumed_from_log": 0, "blocks": 0,
+            "blocks_from_log": 0, "tokens": 0, "retries": 0,
+            "stream_resumes": 0, "wall_s": 0.0}
+
+    # ------------------------------------------------------------ plumbing
+    def _row_prompt(self, row) -> np.ndarray:
+        val = row[self.prompts_col] if isinstance(row, dict) else row
+        return np.asarray(val, np.int32).reshape(-1)
+
+    def _row_max_new(self, row) -> int:
+        if self.max_new_col and isinstance(row, dict) \
+                and self.max_new_col in row:
+            return int(row[self.max_new_col])
+        return self.max_new
+
+    def _out_row(self, row, tokens: np.ndarray) -> dict:
+        out = dict(row) if isinstance(row, dict) \
+            else {self.prompts_col: row}
+        out[self.output_col] = np.asarray(tokens, np.int32)
+        return out
+
+    # rtlint: owner=driver
+    def _submit(self, fl: _Flight, engine=None):
+        """Hand one row (or its replay) to an engine. ``resume_from``
+        carries the delivered-token count, so a retried row continues
+        token-identically instead of re-streaming its prefix."""
+        eng = engine or self.policy.pick() or min(
+            self.engines, key=lambda e: e.queue_depth())
+        fl.engine = eng
+        fl.stream = eng.stream(fl.prompt, fl.max_new, seed=fl.seed,
+                               resume_from=fl.n_tok)
+
+    # rtlint: owner=driver
+    def _retry(self, fl: _Flight, exc: BaseException):
+        """Replay a retryably-failed row (PR 7 machinery): give each
+        engine's supervisor a chance to restart a dead driver, then
+        resubmit with ``resume_from`` — on the healthiest engine first.
+        A row that exhausts its budget re-raises the triggering error,
+        chained to the last resubmission failure (the one that actually
+        blocked recovery).
+        """
+        last_err: Optional[BaseException] = None
+        while fl.retries < self.max_retries:
+            fl.retries += 1
+            self.stats["retries"] += 1
+            errs = []
+            for eng in sorted(self.engines,
+                              key=lambda e: e.queue_depth()):
+                # Offline runs have no replica health pass, so the
+                # pipeline driver doubles as the engine supervisor:
+                # give a dead driver its one-shot restart before
+                # resubmitting.
+                try:
+                    eng.supervise()
+                except Exception:  # noqa: BLE001 - supervisor failed;
+                    pass           # engine stays down, try the next one
+                try:
+                    self._submit(fl, engine=eng)
+                    if fl.n_tok:
+                        self.stats["stream_resumes"] += 1
+                    return
+                except Exception as e:  # noqa: BLE001 - try next engine
+                    errs.append(e)
+            if errs:
+                last_err = errs[-1]
+            if not any(getattr(e, "retryable", False) for e in errs):
+                break
+            time.sleep(0.05)
+        raise exc from last_err
+
+    # rtlint: owner=driver
+    def _drain_flight(self, uid: int, fl: _Flight,
+                      pending: Dict[int, _BlockState]) -> bool:
+        """Non-blocking pull of everything this flight's lane holds.
+        Returns True if the row completed (and was folded into its
+        block)."""
+        while True:
+            try:
+                evt = fl.stream.poll()
+            except Exception as val:  # noqa: BLE001 - classified below
+                if getattr(val, "retryable", False) \
+                        and fl.retries < self.max_retries:
+                    self._retry(fl, val)
+                    # The flight now reads from a FRESH lane; anything
+                    # the dead lane still held was pulled above (errors
+                    # trail items), so hand control back to the loop.
+                    return False
+                raise
+            if evt is None:
+                return False
+            kind, val = evt
+            if kind == "item":
+                fl.delivered.append(np.asarray(val, np.int32))
+                fl.n_tok += len(val)
+                continue
+            # kind == "end"
+            toks = (np.concatenate(fl.delivered)
+                    if fl.delivered else np.zeros((0,), np.int32))
+            bs = pending[fl.block_idx]
+            bs.outs[fl.row_pos] = toks
+            bs.done += 1
+            self.stats["rows"] += 1
+            self.stats["tokens"] += int(toks.shape[0])
+            del self._flights[uid]
+            return True
+
+    # rtlint: owner=driver
+    def _commit_block(self, idx: int, bs: _BlockState) -> B.Block:
+        out_rows = [self._out_row(r, t) for r, t in zip(bs.rows, bs.outs)]
+        if self._log is not None:
+            skeletons = []
+            for r in out_rows:
+                sk = dict(r)
+                sk.pop(self.output_col, None)
+                skeletons.append(sk)
+            self._log.commit(idx, skeletons, list(bs.outs))
+        self.stats["blocks"] += 1
+        return B.rows_to_block(out_rows)
+
+    # -------------------------------------------------------------- driving
+    def run(self, source) -> Iterator[B.Block]:
+        """Generate for every row of ``source`` (a Dataset or an
+        iterable of blocks); yields output blocks in input order. The
+        consumer's thread is the pipeline driver."""
+        blocks = source._exec_blocks() if hasattr(source, "_exec_blocks") \
+            else iter(source)
+        try:
+            yield from self._drive(blocks)
+        finally:
+            self.close()
+
+    def run_refs(self, source) -> Iterator:
+        """:meth:`run`, with each committed output block written back
+        through the object plane: yields ``(block_idx, ObjectRef)``.
+        Downstream stages (or other workers) pull the blocks from the
+        object store; the driver drops its copy immediately."""
+        import ray_tpu as rt
+
+        for idx, blk in enumerate(self.run(source)):
+            yield idx, rt.put(blk)
+
+    # rtlint: owner=driver
+    def _drive(self, blocks: Iterator[B.Block]) -> Iterator[B.Block]:
+        t0 = time.time()
+        committed = self._log.committed() if self._log else set()
+        pending: Dict[int, _BlockState] = {}
+        ready: Dict[int, B.Block] = {}
+        next_emit = 0
+        row_counter = 0           # global row index -> per-row seed
+        cur: Optional[tuple] = None   # (idx, rows, pos)
+        block_iter = enumerate(blocks)
+        exhausted = False
+        while True:
+            progressed = False
+            # 1. Admission: feed rows while the policy sees headroom.
+            while not exhausted and self.policy.can_add_input(
+                    len(self._flights)):
+                if cur is None:
+                    try:
+                        idx, blk = next(block_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    if idx in committed:
+                        # Exactly-once: the log already holds this
+                        # block — zero rows resubmitted. The seed
+                        # cursor still advances past its rows. Break
+                        # to the emission step so a long committed run
+                        # streams out instead of accumulating in
+                        # driver memory.
+                        n = B.block_len(blk)
+                        loaded = self._log.load(idx, self.output_col)
+                        if B.block_len(loaded) != n:
+                            # The manifest pins generation knobs, but
+                            # block SHAPE comes from the dataset plan:
+                            # a resume under a different block size
+                            # would duplicate or drop rows silently.
+                            raise ValueError(
+                                f"progress log block {idx} holds "
+                                f"{B.block_len(loaded)} rows but the "
+                                f"dataset plan now yields {n}; the "
+                                f"input must re-execute with the SAME "
+                                f"blocking for exactly-once resume — "
+                                f"use a fresh progress_path for a "
+                                f"changed plan")
+                        row_counter += n
+                        ready[idx] = loaded
+                        self.stats["blocks_from_log"] += 1
+                        self.stats["rows_resumed_from_log"] += n
+                        progressed = True
+                        break
+                    rows = list(B.iter_rows(blk))
+                    if not rows:
+                        ready[idx] = blk     # empty block passes through
+                        progressed = True
+                        continue
+                    pending[idx] = _BlockState(
+                        rows=rows, outs=[None] * len(rows))
+                    cur = (idx, rows, 0)
+                idx, rows, pos = cur
+                fl = _Flight(
+                    block_idx=idx, row_pos=pos,
+                    prompt=self._row_prompt(rows[pos]),
+                    max_new=self._row_max_new(rows[pos]),
+                    seed=self.seed + row_counter)
+                row_counter += 1
+                try:
+                    self._submit(fl)
+                except Exception as e:
+                    # A just-crashed (draining) engine rejects fresh
+                    # admissions retryably; route through the same
+                    # supervise-and-replay path mid-stream errors take.
+                    if not getattr(e, "retryable", False):
+                        raise
+                    self._retry(fl, e)
+                self._flights[self._uid] = fl
+                self._uid += 1
+                progressed = True
+                pos += 1
+                cur = (idx, rows, pos) if pos < len(rows) else None
+            # 2. Collection: drain every flight's lane without blocking.
+            for uid in list(self._flights):
+                fl = self._flights[uid]
+                if self._drain_flight(uid, fl, pending):
+                    bs = pending[fl.block_idx]
+                    if bs.done == len(bs.rows):
+                        ready[fl.block_idx] = self._commit_block(
+                            fl.block_idx, pending.pop(fl.block_idx))
+                    progressed = True
+            # 3. Emission: committed blocks leave in input order.
+            while next_emit in ready:
+                blk = ready.pop(next_emit)
+                next_emit += 1
+                self.stats["wall_s"] = time.time() - t0
+                yield blk
+                progressed = True
+            if exhausted and not self._flights and not pending \
+                    and not ready:
+                break
+            if not progressed:
+                # Every lane is mid-chunk on the device: wait a beat
+                # instead of spinning on empty queues.
+                time.sleep(0.001)
+        self.stats["wall_s"] = time.time() - t0
+
+    def close(self):
+        """Close every in-flight engine stream (abandonment): engines
+        free the slots/pages at their next chunk boundary and stay
+        admissible. Idempotent; called automatically when :meth:`run`'s
+        generator exits for ANY reason."""
+        for fl in self._flights.values():
+            if fl.stream is not None:
+                fl.stream.close()
+        self._flights.clear()
+
+    def engine_stats(self) -> List[dict]:
+        return [e.stats() for e in self.engines]
+
+
+def resolve_engines(model, num_engines: int = 1, **engine_knobs):
+    """Normalize ``Dataset.generate``'s ``model`` argument to a list of
+    engines plus an ownership flag (owned engines are shut down when the
+    generation iterator closes):
+
+    - a ``DecodeEngine`` (or a list of them) → used as-is, not owned;
+    - a ``(params, cfg)`` tuple → ``num_engines`` fresh engines built
+      with ``engine_knobs``, owned.
+    """
+    from ..serve.engine import DecodeEngine
+
+    live = None
+    if isinstance(model, DecodeEngine):
+        live = [model]
+    elif isinstance(model, (list, tuple)) \
+            and model and all(isinstance(m, DecodeEngine) for m in model):
+        live = list(model)
+    if live is not None:
+        if engine_knobs or num_engines != 1:
+            # Silently ignoring the knobs would run the job with the
+            # engine's EXISTING configuration — wrong temperature or
+            # pool size with nothing to flag it.
+            raise ValueError(
+                f"engine_knobs {sorted(engine_knobs)} / num_engines="
+                f"{num_engines} only apply when engines are built from "
+                f"a (params, cfg) model; configure live engines at "
+                f"construction instead")
+        return live, False
+    if isinstance(model, (list, tuple)) and len(model) == 2:
+        params, cfg = model
+        # Distinct deployment labels per engine, or their queue-depth /
+        # occupancy / page gauges would overwrite each other
+        # (last-writer-wins on the shared default label).
+        base = engine_knobs.pop("deployment", "batch_gen")
+        n = max(1, int(num_engines))
+        return [DecodeEngine(params, cfg,
+                             deployment=base if n == 1 else f"{base}_{i}",
+                             **engine_knobs)
+                for i in range(n)], True
+    raise TypeError(
+        "model must be a DecodeEngine, a list of DecodeEngines, or a "
+        f"(params, cfg) tuple; got {type(model)}")

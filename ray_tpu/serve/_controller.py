@@ -424,7 +424,7 @@ class ServeController:
                                 "prefix_hits", "cow_copies",
                                 "admissions_deferred", "lane_parks",
                                 "preempted", "prefix_tokens_reused",
-                                "active_slots", "slots",
+                                "active_slots", "slots", "queue_depth",
                                 "resumed", "driver_restarts"):
                         if key in est:
                             engine[key] = engine.get(key, 0) + est[key]

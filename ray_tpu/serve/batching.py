@@ -321,6 +321,24 @@ class _EngineStream:
     def __next__(self):
         return next(self._it)
 
+    def poll(self):
+        """Non-blocking pull of one event: ``("item", chunk)``,
+        ``("end", None)``, or None when nothing is queued; a failed
+        stream raises its error. Keeps the lane wire protocol private
+        to this module — the offline batch pipeline (``data/llm.py``)
+        drains many streams from ONE driver thread and cannot block on
+        any single one. Do not interleave with iteration: one consumer,
+        one access mode."""
+        try:
+            kind, val = self._lane.q.get_nowait()
+        except queue.Empty:
+            return None
+        if kind is _STREAM_END:
+            return ("end", None)
+        if kind == "err":
+            raise val
+        return ("item", val)
+
     def close(self):
         self._lane.closed = True
         self._it.close()

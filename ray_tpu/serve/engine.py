@@ -745,6 +745,16 @@ class DecodeEngine:
         marks the lane abandoned even before the first pull."""
         return _EngineStream(self.submit(prompt, max_new, **kw))
 
+    def queue_depth(self) -> int:
+        """Requests accepted but not yet admitted to a slot (submit
+        queue + the driver's deferred FIFO). THE offline-pipeline
+        throttle signal (ISSUE 11): a saturated pool wants this small
+        but nonzero — zero risks an idle boundary, unbounded means the
+        admission queue is absorbing the whole dataset. Exported as the
+        ``serve_engine_queue_depth`` gauge once per driver loop.
+        Safe from any thread (both reads are approximate by nature)."""
+        return self._queue.qsize() + len(self._pending)
+
     # ------------------------------------------------------------- lifecycle
     def start(self):
         if self._thread is not None and self._thread.is_alive():
@@ -931,7 +941,7 @@ class DecodeEngine:
             out = dict(self._stats)
         out["active_slots"] = sum(s is not None for s in self._state)
         out["slots"] = self.slots
-        out["queued"] = self._queue.qsize() + len(self._pending)
+        out["queue_depth"] = out["queued"] = self.queue_depth()
         d = max(out["dispatches"], 1)
         out["avg_occupancy"] = out.pop("occupancy_sum") / d
         out["dispatches_per_token"] = (
@@ -1001,6 +1011,7 @@ class DecodeEngine:
                     # exit before touching the rebuilt structures.
                     break
                 self._admit_pending(epoch)
+                self._observe_queue_depth()
                 if not any(s is not None for s in self._state):
                     if self._pending:
                         # Deferred head with an empty pool and ZERO
@@ -1116,6 +1127,15 @@ class DecodeEngine:
         labels = {"deployment": self.deployment}
         sm["engine_pages_free"].set(free, labels=labels)
         sm["engine_pages_used"].set(self.n_pages - free, labels=labels)
+
+    def _observe_queue_depth(self):  # rtlint: owner=driver
+        """Export the admission backlog once per driver loop (gauge
+        semantics want one writer: the driver, same as the page
+        gauges)."""
+        from .._private.metrics import serve_metrics
+
+        serve_metrics()["engine_queue_depth"].set(
+            self.queue_depth(), labels={"deployment": self.deployment})
 
     def _admit_pending(self, epoch: int = -1):  # rtlint: owner=driver
         """Chunk-boundary admission: fill every free slot in FIFO order.

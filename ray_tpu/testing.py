@@ -160,6 +160,50 @@ class WorkerKiller:
         self.stop()
 
 
+def sigkill_when(proc, predicate, *, poll_s: float = 0.02,
+                 timeout_s: float = 120.0) -> bool:
+    """Preemption harness (ISSUE 11): watch ``predicate()`` and SIGKILL
+    ``proc`` — a ``subprocess.Popen`` or a bare pid — the moment it
+    turns true, simulating an overnight batch-inference driver dying
+    mid-run (spot preemption, OOM kill). The canonical predicate is
+    ``lambda: len(ProgressLog.scan(progress_dir)) >= k`` — kill once k
+    blocks committed, then assert the resumed run loses nothing,
+    duplicates nothing, and is byte-identical to an uninterrupted run.
+
+    Returns True if the kill landed; False if the process exited first
+    (the workload outran the predicate — enlarge it or throttle the
+    engine with ``inject_fault("driver_slow", ...)``) or ``timeout_s``
+    passed."""
+    import signal
+    import time
+
+    pid = proc.pid if hasattr(proc, "pid") else int(proc)
+
+    def alive() -> bool:
+        if hasattr(proc, "poll"):
+            return proc.poll() is None
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if not alive():
+            return False
+        if predicate():
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                return False      # exited between the poll and the kill
+            if hasattr(proc, "wait"):
+                proc.wait(timeout=30)
+            return True
+        time.sleep(poll_s)
+    return False
+
+
 def _serve_replica_handles(app_name: str, deployment_name: str,
                            timeout: float = 10.0) -> dict:
     """Live replica handles ({rid: ActorHandle}) of one serve deployment,

@@ -407,6 +407,9 @@ def test_wedged_driver_recovers_without_replacement(rt_cluster, nano,
                 break
             time.sleep(0.3)
         assert agg.get("driver_restarts", 0) >= 2, agg
+        # queue_depth rides the same controller aggregation (ISSUE 11
+        # satellite): present whenever engine stats flow at all.
+        assert "queue_depth" in agg, agg
         serve.delete(name)
     finally:
         serve.shutdown()

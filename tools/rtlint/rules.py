@@ -166,14 +166,15 @@ class LockGuardRule(Rule):
 
 # ------------------------------------------------------------------ RT102
 class DriverOwnershipRule(Rule):
-    """RT102: device-dispatch calls in the decode engine (and its
-    drafters — ISSUE 9) must run on the driver thread. Lexically: calls
-    to the bound jit wrappers (``self._prefill`` / ``self._step`` /
-    ``self._verify`` / ``self._ingest``) or an immediately-invoked
-    ``jit_*`` factory (``jit_x(...)(...)``) are only allowed inside
-    methods annotated ``# rtlint: owner=driver``. Binding a factory
-    (``self._prefill = jit_prefill(...)``) is construction, not a
-    dispatch, and is not flagged."""
+    """RT102: device-dispatch calls in the decode engine (its drafters
+    — ISSUE 9 — and the offline batch-inference pipeline driver,
+    ``data/llm.py`` — ISSUE 11) must run on the driver thread.
+    Lexically: calls to the bound jit wrappers (``self._prefill`` /
+    ``self._step`` / ``self._verify`` / ``self._ingest``) or an
+    immediately-invoked ``jit_*`` factory (``jit_x(...)(...)``) are
+    only allowed inside methods annotated ``# rtlint: owner=driver``.
+    Binding a factory (``self._prefill = jit_prefill(...)``) is
+    construction, not a dispatch, and is not flagged."""
 
     id = "RT102"
     summary = "device dispatch outside a driver-annotated method"
@@ -182,7 +183,8 @@ class DriverOwnershipRule(Rule):
 
     def applies(self, mod: Module) -> bool:
         return mod.relpath.endswith(("serve/engine.py",
-                                     "serve/draft.py"))
+                                     "serve/draft.py",
+                                     "data/llm.py"))
 
     def check(self, mod: Module) -> Iterable[Finding]:
         yield from self._walk(mod, mod.tree, scope="<module>",
@@ -579,13 +581,16 @@ class SwallowedExceptRule(Rule):
     the justification; the repo convention is
     ``except Exception:  # noqa: BLE001 - <why swallowing is safe>``.
     Scoped to ``ray_tpu/serve/`` — the driver/controller/replica
-    control loops this rule exists for."""
+    control loops this rule exists for — plus ``data/llm.py``, the
+    offline batch-inference pipeline driver (ISSUE 11), which runs the
+    same submit/collect/commit control loop against the engines."""
 
     id = "RT107"
     summary = "bare or silently-swallowed except in a serve control loop"
 
     def applies(self, mod: Module) -> bool:
-        return "serve/" in mod.relpath
+        return "serve/" in mod.relpath \
+            or mod.relpath.endswith("data/llm.py")
 
     def check(self, mod: Module) -> Iterable[Finding]:
         for node, scope in _nodes_with_scope(mod.tree, ast.ExceptHandler):
