@@ -1524,6 +1524,13 @@ def run_chaos_mode(args, serve, np, cfg_name, model):
                               f" want {len(refs[i])}"))
     resumes = count_resumes()
     completed = sum(r is not None for r in results)
+    # Runtime-sanitizer verdict from the SURVIVING replicas (ISSUE 13):
+    # under RT_SAN=1 every replica engine carries a sanitizer block in
+    # stats(); a chaos run that recovered cleanly must also have zero
+    # runtime findings (no lock-order cycles, no blocking-under-lock).
+    from ray_tpu.testing import engine_sanitizer_findings
+
+    san_findings = engine_sanitizer_findings("gpt_chaos", "ChaosGPT")
     row = {
         "metric": f"serve_{model}_chaos_recovery",
         "value": len(broken), "unit": "broken_streams",
@@ -1540,6 +1547,7 @@ def run_chaos_mode(args, serve, np, cfg_name, model):
         "tokens_total": int(sum(len(r) for r in results
                                 if r is not None)),
         "output_tokens": [int(m) for m in max_news],
+        "sanitizer_findings": san_findings,
         "smoke": bool(args.smoke),
     }
     print(json.dumps(row))
@@ -1547,6 +1555,8 @@ def run_chaos_mode(args, serve, np, cfg_name, model):
                        f"{broken[:4]}"
     assert resumes >= 1, \
         "the kill interrupted no stream — chaos run proved nothing"
+    assert san_findings in (None, 0), \
+        f"rtsan found {san_findings} runtime findings during chaos"
     serve.delete("gpt_chaos")
 
 

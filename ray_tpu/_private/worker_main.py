@@ -15,6 +15,25 @@ import time
 
 
 def main():
+    # Runtime sanitizer (tools/rtsan, ISSUE 13): RT_SAN=1 in the
+    # spawning environment sanitizes worker processes too — replica
+    # engines live HERE, not in the test process. Runs as early as
+    # main() can: everything constructed from here on (CoreWorker,
+    # engines, controllers — all instance locks) goes through the
+    # patched factories. The package-import chain that `-m` already
+    # executed (ray_tpu/__init__ -> api/ids) created its few
+    # module-level locks raw; those are outside rtsan's coverage in
+    # workers. Gated: a deployment without the tools/ tree just runs
+    # unsanitized (the sanitizer is a dev/CI harness, not a runtime
+    # dependency).
+    if os.environ.get("RT_SAN") == "1":
+        try:
+            import tools.rtsan as _rtsan
+
+            _rtsan.enable(active=True)
+        except Exception:  # noqa: BLE001 - tools/ tree absent: run plain
+            pass
+
     import faulthandler
 
     # `kill -USR1 <worker pid>` dumps thread stacks to the worker log —

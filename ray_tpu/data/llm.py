@@ -549,7 +549,11 @@ class BatchInferencer:
         for idx, blk in enumerate(self.run(source)):
             yield idx, rt.put(blk)
 
-    # rtlint: owner=driver
+    # entry=driver: the CONSUMING thread is the pipeline driver — no
+    # thread is spawned here; whoever iterates run() owns every
+    # submit/collect/commit. rtsan registers that thread at this call
+    # and asserts the other owner=driver methods stay on it.
+    # rtlint: owner=driver entry=driver
     def _drive(self, blocks: Iterator[B.Block]) -> Iterator[B.Block]:
         t0 = time.time()
         committed = self._log.committed() if self._log else set()

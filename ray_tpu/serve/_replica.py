@@ -522,6 +522,10 @@ class Replica:
         # Condition wait, not a poll: the last finishing request
         # notifies, so an idle replica returns immediately and a busy
         # one wakes the moment its in-flight count hits zero.
+        # rtsan RS104 audit (ISSUE 13): the wait is deadline-bounded
+        # AND re-checks the predicate (_ongoing) each wake — a lost
+        # notify degrades to the drain budget, never a hang; the only
+        # lock held is the condition's own (_idle_cond shares _lock).
         with self._idle_cond:
             while self._ongoing and time.time() < deadline:
                 self._idle_cond.wait(

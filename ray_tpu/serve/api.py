@@ -26,6 +26,36 @@ from .handle import DeploymentHandle, _HandleMarker, reset_routers
 
 _client_lock = threading.Lock()
 _client: Dict[str, Any] = {"controller": None, "proxy": None, "http": None}
+#: Single-flight bootstrap gate (rtsan RS104 real finding, ISSUE 13):
+#: start() used to hold _client_lock across the WHOLE control-plane
+#: bootstrap — controller creation, 60 s proxy RPCs, and get_actor's
+#: retry-sleep loop — so a concurrent status()/_controller()/shutdown()
+#: stalled behind a full bootstrap instead of its own short timeout.
+#: Now _client_lock only ever guards the state dict; the slow work runs
+#: outside it, serialized by this leader Event (followers wait, then
+#: re-run the now-fast idempotent body).
+_boot: Dict[str, Any] = {"ev": None}
+
+
+def _boot_enter() -> "threading.Event":
+    """Become the bootstrap leader, waiting out any in-flight one.
+    Callers MUST pair with :func:`_boot_exit` (try/finally)."""
+    while True:
+        with _client_lock:
+            ev = _boot["ev"]
+            if ev is None:
+                ev = _boot["ev"] = threading.Event()
+                return ev
+        # Bounded: the leader's finally publishes and clears; on the
+        # pathological timeout we loop and re-contend.
+        ev.wait(timeout=120)
+
+
+def _boot_exit(ev: "threading.Event"):
+    with _client_lock:
+        if _boot["ev"] is ev:
+            _boot["ev"] = None
+    ev.set()
 
 
 class Deployment:
@@ -178,47 +208,65 @@ def start(http_options: Union[None, dict, HTTPOptions] = None,
     http_options = http_options or HTTPOptions()
     if isinstance(grpc_options, dict):
         grpc_options = gRPCOptions(**grpc_options)
-    with _client_lock:
-        if _client["controller"] is None:
-            _client["controller"] = _get_or_create_controller()
-        if proxy and _client["proxy"] is None:
+    # Bootstrap runs OUTSIDE _client_lock (single-flighted by the boot
+    # gate): the RPCs below block for up to 60 s and get_actor retries
+    # with sleeps — holding the state lock across them starved every
+    # other serve entry point (rtsan RS104 real finding).
+    ev = _boot_enter()
+    try:
+        with _client_lock:
+            ctrl = _client["controller"]
+        if ctrl is None:
+            ctrl = _get_or_create_controller()
+            with _client_lock:
+                _client["controller"] = ctrl
+        with _client_lock:
+            need_proxy = proxy and _client["proxy"] is None
+        if need_proxy:
             # The CONTROLLER owns the proxy fleet — one per alive node
             # (reference: proxy_state_manager / proxy.py:1116) — and
             # keeps it reconciled as nodes join/leave. ensure_proxies is
             # get-or-create: an already-running fleet (a previous driver
             # or CLI invocation) is adopted, with its recorded bind info.
             info = dict(rt.get(
-                _client["controller"].ensure_proxies.remote({
+                ctrl.ensure_proxies.remote({
                     "host": http_options.host,
                     "port": http_options.port,
                     "request_timeout_s": http_options.request_timeout_s,
                 }), timeout=60) or {})
-            _client["proxy"] = rt.get_actor("SERVE_PROXY", timeout=10)
-            _client["http"] = info
-        if grpc_options is not None and _client["proxy"] is not None \
-                and "grpc_port" not in (_client["http"] or {}):
+            pr = rt.get_actor("SERVE_PROXY", timeout=10)
+            with _client_lock:
+                _client["proxy"] = pr
+                _client["http"] = info
+        with _client_lock:
+            pr = _client["proxy"]
+            http = _client["http"]
+        if grpc_options is not None and pr is not None \
+                and "grpc_port" not in (http or {}):
             # Bind the gRPC ingress on the running proxy (whether it was
             # just created or already existed) rather than silently
             # dropping the request.
-            info = dict(_client["http"] or {})
-            info.update(rt.get(_client["proxy"].start_grpc.remote(
+            info = dict(http or {})
+            info.update(rt.get(pr.start_grpc.remote(
                 grpc_options.host, grpc_options.port), timeout=30))
-            _client["http"] = info
-        if _client["http"] is not None:
-            rt.get(_client["controller"].set_http_info.remote(
-                _client["http"]), timeout=10)
-        if _client["proxy"] is not None:
+            with _client_lock:
+                _client["http"] = http = info
+        if http is not None:
+            rt.get(ctrl.set_http_info.remote(http), timeout=10)
+        if pr is not None:
             from ..util import tracing
 
             # Mirror the driver's tracing state (both directions) so
             # per-request server spans record exactly when the driver
             # traces; picked up on every serve.start()/serve.run().
             try:
-                rt.get(_client["proxy"].set_tracing.remote(
+                rt.get(pr.set_tracing.remote(
                     tracing.enabled()), timeout=10)
             except Exception:  # noqa: BLE001 - adopted older proxy
                 pass
-    return _client["controller"]
+    finally:
+        _boot_exit(ev)
+    return ctrl
 
 
 def _get_or_create_controller():
@@ -330,9 +378,18 @@ def delete(name: str):
 
 
 def shutdown():
-    """Tear down all apps, the proxy, and the controller."""
-    with _client_lock:
-        ctrl = _client["controller"]
+    """Tear down all apps, the proxy, and the controller. Serialized
+    against an in-flight :func:`start` by the boot gate (so a teardown
+    never interleaves a half-built control plane), with the teardown
+    RPCs themselves OUTSIDE ``_client_lock`` — same rtsan RS104 fix as
+    ``start``: the state lock is for the dict, never for the wire."""
+    ev = _boot_enter()
+    try:
+        with _client_lock:
+            ctrl = _client["controller"]
+            proxy = _client["proxy"]
+            _client.update({"controller": None, "proxy": None,
+                            "http": None})
         if ctrl is None:
             try:
                 ctrl = rt.get_actor(SERVE_CONTROLLER_NAME, timeout=0.5)
@@ -347,7 +404,6 @@ def shutdown():
                 rt.kill(ctrl)
             except Exception:  # noqa: BLE001
                 pass
-        proxy = _client["proxy"]
         if proxy is None:
             # A fresh process (the CLI) has no cached handle — the
             # named actor is the source of truth.
@@ -360,7 +416,8 @@ def shutdown():
                 rt.kill(proxy)
             except Exception:  # noqa: BLE001
                 pass
-        _client.update({"controller": None, "proxy": None, "http": None})
+    finally:
+        _boot_exit(ev)
     reset_routers()
 
 

@@ -289,7 +289,11 @@ class ModelDrafter(Drafter):
         self._pending = np.full((self.slots,), -1, np.int64)
         self._rngs = np.zeros((self.slots, 2), np.uint32)
 
-    # rtlint: owner=driver
+    # entry=driver: admission is the engine driver's first touch of a
+    # slot — rtsan re-registers the drafter's owner thread here, so a
+    # supervisor-restarted engine (new driver thread, drafter reset)
+    # rebinds on its first admission instead of tripping RS103.
+    # rtlint: owner=driver entry=driver
     def admit(self, slot: int, prompt: np.ndarray, first_token: int):
         import jax
 

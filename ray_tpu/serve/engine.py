@@ -802,6 +802,11 @@ class DecodeEngine:
         with self._admit_lock:
             self._draining = True
         deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        # rtsan RS104 audit (ISSUE 13): a 10 ms poll, NOT a condition —
+        # the state it watches (_state/_pending) is driver-thread-owned
+        # by contract, so a condition here would need the driver to
+        # notify under a lock it deliberately never takes on its hot
+        # loop. Deadline-bounded, and no lock is held across the sleep.
         while time.monotonic() < deadline:
             if not any(s is not None for s in self._state) \
                     and not self._queue.qsize() and not self._pending:
@@ -971,6 +976,15 @@ class DecodeEngine:
         out["driver_alive"] = bool(t is not None and t.is_alive())
         out["heartbeat_age_s"] = round(time.monotonic() - self._beat, 3)
         out["draining"] = self._draining
+        # Runtime-sanitizer block (ISSUE 13): only when tools/rtsan is
+        # already loaded AND active in this process — checked via
+        # sys.modules so ray_tpu never imports the analyzer tree into
+        # workers on its own (same boundary as the rtlint metrics
+        # lint). Chaos benchmarks assert findings == 0 here.
+        import sys as _sys
+        _rtsan = _sys.modules.get("tools.rtsan")
+        if _rtsan is not None and _rtsan.is_active():
+            out["sanitizer"] = _rtsan.stats_block("serve/")
         if self.paged:
             out["page_size"] = self.page_size
             out["n_pages"] = self.n_pages
@@ -996,8 +1010,12 @@ class DecodeEngine:
     # ---------------------------------------------------------- driver loop
     # THE driver loop: everything it calls below dispatches against
     # pool structures only this thread (or a supervisor that already
-    # fenced it off by epoch) may touch.
-    # rtlint: owner=driver
+    # fenced it off by epoch) may touch. entry=driver: the thread that
+    # enters _run IS the driver — rtsan (tools/rtsan) registers it here
+    # and asserts every other owner=driver method runs on it (a
+    # supervisor restart re-registers automatically on the new thread's
+    # first loop).
+    # rtlint: owner=driver entry=driver
     def _run(self, stop: threading.Event, epoch: int):
         try:
             while not stop.is_set():

@@ -724,6 +724,10 @@ class Router:
                                 f"{self._max_queued})")
                         self._pending += 1
                         queued = True
+                    # rtsan RS104 audit (ISSUE 13): bounded wait inside
+                    # a predicate loop — backoff caps at 1 s, the loop
+                    # re-picks and re-checks the deadline every wake,
+                    # so a lost notify costs one backoff, never a hang.
                     notified = self._cond.wait(timeout=backoff)
                 if deadline_expired(deadline_s):
                     raise TimeoutError(

@@ -312,6 +312,35 @@ def drain_replicas(app_name: str, deployment_name: str,
     return out
 
 
+def engine_sanitizer_findings(app_name: str,
+                              deployment_name: str) -> "int | None":
+    """Total runtime-sanitizer (tools/rtsan, ISSUE 13) findings across
+    a deployment's live replica engines — the ``sanitizer`` block
+    ``engine.stats()`` carries while rtsan is active in the replica
+    process (``RT_SAN=1``). Returns None when NO replica reports the
+    block (sanitizer inactive), so callers can assert
+    ``findings in (None, 0)`` and stay meaningful in both modes."""
+    import ray_tpu as rt
+
+    total, seen = 0, False
+    for _rid, h in _serve_replica_handles(app_name,
+                                          deployment_name).items():
+        try:
+            m = rt.get(h.get_metrics.remote(), timeout=10)
+        except Exception:  # noqa: BLE001 - dead replica: nothing to read
+            continue
+        # The block's count is PER PROCESS: every engine in one replica
+        # reports the same number, so take the max per replica (not the
+        # sum) and add across replicas (distinct processes).
+        per_replica = [int(est["sanitizer"].get("findings", 0))
+                       for est in (m.get("engines") or [])
+                       if est.get("sanitizer") is not None]
+        if per_replica:
+            seen = True
+            total += max(per_replica)
+    return total if seen else None
+
+
 class ReplicaKiller:
     """Serve-aware sibling of ``WorkerKiller``: kills random replica
     ACTORS of one deployment while traffic runs, exercising the serve

@@ -26,8 +26,125 @@ os.environ.setdefault("RT_DRYRUN_SKIP_1B", "1")
 # st_mtime).
 
 import faulthandler  # noqa: E402
+import sys  # noqa: E402
 
 import pytest  # noqa: E402
+
+# ---- runtime sanitizer (tools/rtsan, ISSUE 13) -------------------------
+# RT_SAN=1  -> sanitize EVERY test (and worker processes, which read the
+#              same env in worker_main);
+# unset     -> patch dormant, enforce only inside the opt-in modules
+#              below (the highest-concurrency paths, sanitized on every
+#              tier-1 run at ~one flag check of overhead elsewhere);
+# RT_SAN=0  -> fully off: no patching at all (zero overhead).
+_RT_SAN_MODE = os.environ.get("RT_SAN", "")
+_RTSAN = None
+if _RT_SAN_MODE != "0":
+    _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _repo_root not in sys.path:
+        sys.path.insert(0, _repo_root)
+    import tempfile  # noqa: E402
+
+    import tools.rtsan as _rtsan_mod  # noqa: E402
+
+    _RTSAN = _rtsan_mod
+    if _RT_SAN_MODE == "1":
+        if not os.environ.get("RT_SAN_DIR"):
+            # Worker processes drop their run artifacts here
+            # (best-effort); the session gate merges them.
+            os.environ["RT_SAN_DIR"] = tempfile.mkdtemp(prefix="rtsan-")
+        else:
+            # A caller-supplied dir may hold a PREVIOUS run's artifacts;
+            # merging those would fail a now-clean suite with phantom
+            # findings, so this run starts from an empty dir.
+            import glob as _glob
+
+            for _p in _glob.glob(
+                    os.path.join(os.environ["RT_SAN_DIR"], "*.json")):
+                try:
+                    os.unlink(_p)
+                except OSError:
+                    pass
+    _RTSAN.enable(active=(_RT_SAN_MODE == "1"))
+
+#: Modules whose tests always run with enforcement on (and a per-test
+#: leaked-thread watch over engine/drafter/pipeline start sites).
+_RTSAN_OPT_IN = {
+    "test_serve_engine", "test_serve_engine_paged",
+    "test_serve_engine_spec", "test_serve_chaos", "test_data_llm",
+    "test_rtsan",
+}
+
+
+@pytest.fixture(autouse=True)
+def _rtsan_window(request):
+    if _RTSAN is None:
+        yield
+        return
+    name = getattr(getattr(request, "module", None), "__name__", "")
+    if _RT_SAN_MODE == "1" or name.rpartition(".")[-1] in _RTSAN_OPT_IN:
+        # thread_watch exits (and flags leaked drivers) while the
+        # activation window is still open.
+        with _RTSAN.activated(), _RTSAN.thread_watch():
+            yield
+    else:
+        yield
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The rtsan --check-style gate: any NEW runtime finding (not
+    inline-suppressed, not in the EMPTY-by-policy baseline) fails the
+    suite, exactly like a new rtlint finding does."""
+    if _RTSAN is None or not _RTSAN.is_enabled():
+        return
+    import glob
+    import json
+
+    if _RT_SAN_MODE == "1":
+        # Worker artifacts are written by each worker's atexit hook —
+        # which only runs once the worker EXITS. The reused rt_cluster
+        # deliberately outlives the tests, so flush it now (idempotent;
+        # the session atexit teardown becomes a no-op) and give the
+        # dying workers a beat to dump before the merge below. Workers
+        # killed uncleanly (SIGKILL chaos) still lose theirs — that
+        # path is covered by the in-test engine stats sanitizer block.
+        try:
+            import ray_tpu as _rt
+
+            if _rt.is_initialized():
+                _rt.shutdown()
+                import time as _time
+
+                _time.sleep(0.5)
+        except Exception:  # noqa: BLE001 - gate must never wedge exit
+            pass
+
+    extra = []
+    d = os.environ.get("RT_SAN_DIR")
+    if d and os.path.isdir(d):
+        for p in sorted(glob.glob(os.path.join(d, "*.json"))):
+            try:
+                with open(p) as f:
+                    extra.extend(json.load(f).get("findings", []))
+            except Exception:  # noqa: BLE001 - torn worker artifact
+                pass
+    verdict = _RTSAN.gate(extra=extra)
+    art = os.path.join(d, f"rtsan-{os.getpid()}.json") if d \
+        else f"/tmp/rtsan-{os.getpid()}.json"
+    try:
+        _RTSAN.dump(art)
+    except Exception:  # noqa: BLE001 - report-only path
+        art = None
+    if verdict["new"]:
+        print("\nrtsan: NEW runtime findings — the gate fails the "
+              "suite; fix them (preferred) or suppress inline with "
+              "'# rtsan: disable=RSxxx <why>':")
+        for f in verdict["new"]:
+            print("  " + f.render().splitlines()[0])
+        if art:
+            print(f"rtsan: full report: "
+                  f"python -m tools.rtsan --report {art}")
+        session.exitstatus = 1
 
 
 def pytest_configure(config):

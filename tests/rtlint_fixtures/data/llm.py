@@ -16,7 +16,7 @@ class FixturePipeline:
         # Binding a factory result is construction, not a dispatch.
         self._step = jit_pump_fixture(cfg)
 
-    # rtlint: owner=driver
+    # rtlint: owner=driver entry=driver
     def _drive(self, x):
         return self._step(x)        # driver-annotated: clean
 

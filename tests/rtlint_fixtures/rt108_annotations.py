@@ -1,0 +1,59 @@
+"""RT108 fixture: annotation drift — ``holds=`` naming a lock that no
+method of the class ever assigns. (The ``owner=driver`` driver-entry
+half of RT108 is path-scoped; its fixtures live in ``serve/engine.py``.)
+Never imported."""
+import threading
+
+
+class Dangling:
+    """holds= names a lock attribute that does not exist."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    # FIRES-BELOW RT108
+    def typo(self):  # rtlint: holds=_lokc
+        self._n += 1
+
+    # One dangling name inside a comma list: only it fires.
+    # FIRES-BELOW RT108
+    def partial(self):  # rtlint: holds=_lock,_gone
+        self._n += 1
+
+
+class Resolved:
+    """Negative: every holds= resolves to an assigned attribute —
+    including class-body assignments and ones outside __init__."""
+
+    _cls_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def ok_class_level(self):  # rtlint: holds=_cls_lock
+        self._n += 1
+
+    def reset(self):
+        self._late_lock = threading.Lock()
+        # Tuple-unpacking targets count as assignments too.
+        self._pair_lock, self._n = threading.Lock(), 0
+
+    def ok(self):  # rtlint: holds=_lock
+        self._n += 1
+
+    def ok_late(self):  # rtlint: holds=_late_lock
+        self._n += 1
+
+    def ok_pair(self):  # rtlint: holds=_pair_lock
+        self._n += 1
+
+
+class Suppressed:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    # rtlint: disable=RT108 lock lives on the runtime-injected mixin
+    def shim(self):  # rtlint: holds=_mixin_lock
+        return 1

@@ -16,7 +16,7 @@ class FixtureDrafter:
         self._verify = jit_verify_fixture(cfg, k)
         self._ingest = jit_verify_fixture(cfg, 1)
 
-    # rtlint: owner=driver
+    # rtlint: owner=driver entry=driver
     def _dispatch_spec(self, params):
         a = self._verify(params)
         b = self._ingest(params)

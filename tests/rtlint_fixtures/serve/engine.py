@@ -1,5 +1,6 @@
-"""RT102 fixture: driver-thread dispatch ownership. Path-scoped — the
-rule only looks at files named ``serve/engine.py``. Never imported.
+"""RT102/RT108 fixture: driver-thread dispatch ownership and the
+driver-entry registration requirement. Path-scoped — the rules only
+look at files named ``serve/engine.py``. Never imported.
 """
 
 
@@ -14,6 +15,12 @@ class FixtureEngine:
         # Binding a factory result is construction, not a dispatch.
         self._prefill = jit_fake_factory(cfg)
         self._step = jit_fake_factory(cfg)
+
+    # entry=driver satisfies RT108: the caller of _run registers as
+    # the driver thread (negative case for the driver-entry check).
+    # rtlint: owner=driver entry=driver
+    def _run(self, params):
+        return self._dispatch(params)
 
     # rtlint: owner=driver
     def _dispatch(self, params):
@@ -37,3 +44,23 @@ class FixtureEngine:
     def helper(self, cfg):
         # Factory call WITHOUT immediate invocation: construction only.
         return jit_fake_factory(cfg)
+
+
+class EntrylessEngine:
+    """owner=driver methods but NO entry=driver registration: neither a
+    reviewer nor the runtime sanitizer can tell which thread is the
+    driver."""
+
+    # rtlint: owner=driver
+    def _dispatch(self, params):  # FIRES RT108
+        return params
+
+    # rtlint: owner=driver
+    def _admit(self, params):
+        return params
+
+
+class SuppressedEntryless:
+    # rtlint: owner=driver disable=RT108 ownership bound by the harness
+    def _dispatch(self, params):
+        return params

@@ -1,0 +1,382 @@
+"""rtsan (tools/rtsan): runtime enforcement of rtlint's concurrency
+contracts (ISSUE 13).
+
+Scenario tests run in SUBPROCESSES: the sanitizer patches
+process-global state (``threading.Lock`` et al), and the session's own
+sanitizer — enabled by conftest for this module — must never see the
+deliberately broken locks these tests construct (its gate would fail
+the suite on them). Each scenario script enables its own sanitizer,
+exercises one check, and prints its findings as JSON.
+
+In-process tests cover the shared-annotation-loader identity pin (ONE
+parse for rtlint and rtsan), the RT108 static half of the contract, and
+the ``engine.stats()`` sanitizer block.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = (
+    "import json, os, sys, threading, time\n"
+    f"sys.path.insert(0, {REPO!r})\n"
+    "import tools.rtsan as rtsan\n"
+)
+
+_EPILOGUE = (
+    "\nprint('FINDINGS=' + json.dumps("
+    "[f.to_dict() for f in rtsan.findings()]))\n"
+)
+
+
+def _run_scenario(tmp_path, body, name="scenario.py", extra_env=None,
+                  timeout=120):
+    p = tmp_path / name
+    p.write_text(_PRELUDE + textwrap.dedent(body) + _EPILOGUE)
+    env = {**os.environ, "RT_SAN_ROOTS": str(tmp_path), "RT_SAN": "0"}
+    # Never let a scenario's atexit artifact land in the session's
+    # merge dir — its deliberate findings would fail the real gate.
+    env.pop("RT_SAN_DIR", None)
+    return subprocess.run([sys.executable, str(p)], env=env, cwd=REPO,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def _findings(proc):
+    for line in proc.stdout.splitlines():
+        if line.startswith("FINDINGS="):
+            return json.loads(line[len("FINDINGS="):])
+    raise AssertionError(
+        f"no FINDINGS line:\n{proc.stdout}\n{proc.stderr}")
+
+
+# --------------------------------------------------------------- scenarios
+def test_abba_cycle_detected_without_hang(tmp_path):
+    """The acceptance scenario: a synthetic ABBA lock order is flagged
+    as RS101 — with both stacks — even though the two orders run
+    SEQUENTIALLY (the deadlock never fires) and the process exits
+    promptly (the subprocess timeout is the no-hang assertion)."""
+    proc = _run_scenario(tmp_path, """
+        rtsan.enable(modules=(), active=True, wrap_dispatch=False)
+        A = threading.Lock()
+        B = threading.Lock()
+        def ab():
+            with A:
+                with B:
+                    pass
+        def ba():
+            with B:
+                with A:
+                    pass
+        t = threading.Thread(target=ab); t.start(); t.join()
+        t = threading.Thread(target=ba); t.start(); t.join()
+    """, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    found = _findings(proc)
+    cycles = [f for f in found if f["rule"] == "RS101"]
+    assert len(cycles) == 1, found
+    msg = cycles[0]["message"]
+    assert "lock-order cycle" in msg
+    # Both acquisition stacks ride the finding.
+    assert msg.count("scenario.py") >= 2
+    assert "Opposite-order stack" in msg
+
+
+def test_holds_violation_raises_and_dangling_is_hard_error(tmp_path):
+    proc = _run_scenario(tmp_path, """
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def locked_op(self):  # rtlint: holds=_lock
+                return 1
+            def dangling(self):  # rtlint: holds=_missing
+                return 2
+        rtsan.enable(modules=("__main__",), active=True,
+                     wrap_dispatch=False)
+        b = Box()
+        try:
+            b.locked_op()
+            print("VERDICT=missed")
+        except rtsan.RTSanViolation as e:
+            assert "RS102" in str(e) and "_lock" in str(e)
+            print("VERDICT=raised")
+        with b._lock:
+            assert b.locked_op() == 1   # held: clean
+        try:
+            b.dangling()
+            print("DANGLING=missed")
+        except rtsan.RTSanViolation as e:
+            assert "does not exist" in str(e)
+            print("DANGLING=hard-error")
+    """)
+    assert proc.returncode == 0, proc.stderr
+    assert "VERDICT=raised" in proc.stdout
+    assert "DANGLING=hard-error" in proc.stdout
+    rules = {f["rule"] for f in _findings(proc)}
+    assert rules == {"RS102"}
+
+
+def test_owner_violation_raises_from_foreign_thread(tmp_path):
+    """entry=driver binds the calling thread; a foreign thread hitting
+    an owner=driver method raises RS103 while the driver lives, and
+    ownership rebinds once the driver is dead (the engine's documented
+    ownership-transfer rule)."""
+    proc = _run_scenario(tmp_path, """
+        class Eng:
+            # rtlint: owner=driver entry=driver
+            def run_entry(self):
+                return 1
+            # rtlint: owner=driver
+            def step(self):
+                return 2
+        rtsan.enable(modules=("__main__",), active=True,
+                     wrap_dispatch=False)
+        e = Eng()
+        park, bound = threading.Event(), threading.Event()
+        def driver():
+            e.run_entry(); e.step(); bound.set(); park.wait()
+        t = threading.Thread(target=driver); t.start(); bound.wait()
+        try:
+            e.step()
+            print("VERDICT=missed")
+        except rtsan.RTSanViolation as ex:
+            assert "RS103" in str(ex)
+            print("VERDICT=raised")
+        park.set(); t.join()
+        assert e.step() == 2           # owner dead -> rebind
+        print("REBIND=ok")
+    """)
+    assert proc.returncode == 0, proc.stderr
+    assert "VERDICT=raised" in proc.stdout
+    assert "REBIND=ok" in proc.stdout
+    assert {f["rule"] for f in _findings(proc)} == {"RS103"}
+
+
+def test_leaked_thread_detected(tmp_path):
+    proc = _run_scenario(tmp_path, """
+        rtsan.enable(modules=(), active=True, wrap_dispatch=False)
+        ev = threading.Event()
+        with rtsan.thread_watch(targets=("scenario.py",)):
+            t = threading.Thread(target=ev.wait, daemon=True)
+            t.start()
+        ev.set()
+    """)
+    assert proc.returncode == 0, proc.stderr
+    leaks = [f for f in _findings(proc) if f["rule"] == "RS105"]
+    assert len(leaks) == 1
+    assert "still alive at watch teardown" in leaks[0]["message"]
+
+
+def test_disabled_mode_is_a_noop(tmp_path):
+    """disable() restores every patched identity — threading factories,
+    time.sleep, Thread.start — so production processes pay zero."""
+    proc = _run_scenario(tmp_path, """
+        orig = (threading.Lock, threading.RLock, threading.Condition,
+                time.sleep, threading.Thread.start)
+        rtsan.enable(modules=(), active=True, wrap_dispatch=False)
+        assert threading.Lock is not orig[0]
+        assert time.sleep is not orig[3]
+        lk = threading.Lock()
+        assert isinstance(lk, rtsan.SanLock)
+        rtsan.disable()
+        now = (threading.Lock, threading.RLock, threading.Condition,
+               time.sleep, threading.Thread.start)
+        assert now == orig, (now, orig)
+        assert type(threading.Lock()) is type(orig[0]())
+        print("IDENTITY=restored")
+    """)
+    assert proc.returncode == 0, proc.stderr
+    assert "IDENTITY=restored" in proc.stdout
+    assert _findings(proc) == []
+
+
+def test_inline_suppression_honored(tmp_path):
+    """``# rtsan: disable=RS101 <why>`` at the reported line silences
+    the finding (same placement grammar as rtlint suppressions)."""
+    proc = _run_scenario(tmp_path, """
+        rtsan.enable(modules=(), active=True, wrap_dispatch=False)
+        A = threading.Lock()
+        B = threading.Lock()
+        with A:
+            with B:
+                pass
+        with B:
+            with A:  # rtsan: disable=RS101 test-only deliberate ABBA
+                pass
+        print("SUPPRESSED=" + str(len(rtsan.SANITIZER.suppressed)))
+    """)
+    assert proc.returncode == 0, proc.stderr
+    assert _findings(proc) == []
+    assert "SUPPRESSED=1" in proc.stdout
+
+
+def test_report_cli_renders_graph_and_hold_table(tmp_path):
+    """``python -m tools.rtsan --report <artifact>`` prints the
+    accumulated lock-order graph and per-site hold-time table; exit 1
+    flags new-vs-baseline findings (the rtlint --check contract)."""
+    proc = _run_scenario(tmp_path, f"""
+        rtsan.enable(modules=(), active=True, wrap_dispatch=False)
+        A = threading.Lock()
+        B = threading.Lock()
+        def ab():
+            with A:
+                with B:
+                    time.sleep(0.002)
+        def ba():
+            with B:
+                with A:
+                    pass
+        t = threading.Thread(target=ab); t.start(); t.join()
+        t = threading.Thread(target=ba); t.start(); t.join()
+        rtsan.dump({str(tmp_path / "artifact.json")!r})
+    """)
+    assert proc.returncode == 0, proc.stderr
+    rep = subprocess.run(
+        [sys.executable, "-m", "tools.rtsan", "--report",
+         str(tmp_path / "artifact.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert rep.returncode == 1, rep.stdout + rep.stderr  # new findings
+    assert "lock-order graph" in rep.stdout
+    assert "->" in rep.stdout
+    assert "hold times" in rep.stdout
+    assert "max=" in rep.stdout and "mean=" in rep.stdout
+    assert "RS101" in rep.stdout
+
+
+def test_gate_fails_suite_on_new_finding(tmp_path):
+    """THE tier-1 hook, end to end: a pytest session (running this
+    repo's conftest under RT_SAN=1) whose tests produce a new rtsan
+    finding exits 1 even though every TEST passed — the sessionfinish
+    gate flips the exit status, exactly like a new rtlint finding."""
+    shutil.copy(os.path.join(REPO, "tests", "conftest.py"),
+                tmp_path / "conftest.py")
+    (tmp_path / "test_gate_canary.py").write_text(textwrap.dedent("""
+        import threading
+
+        def test_abba_but_green():
+            A = threading.Lock()
+            B = threading.Lock()
+            def ab():
+                with A:
+                    with B:
+                        pass
+            def ba():
+                with B:
+                    with A:
+                        pass
+            t = threading.Thread(target=ab); t.start(); t.join()
+            t = threading.Thread(target=ba); t.start(); t.join()
+    """))
+    env = {**os.environ,
+           "RT_SAN": "1",
+           "RT_SAN_ROOTS": str(tmp_path),
+           "RT_SAN_DIR": str(tmp_path / "artifacts"),
+           "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", str(tmp_path), "-q",
+         "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert "1 passed" in proc.stdout, proc.stdout + proc.stderr
+    assert proc.returncode == 1, (proc.returncode, proc.stdout)
+    assert "RS101" in proc.stdout
+    assert "rtsan: NEW runtime findings" in proc.stdout
+
+
+# ---------------------------------------------------------------- in-process
+def test_shared_annotation_loader_identity():
+    """The acceptance pin: rtlint and rtsan consume the IDENTICAL
+    annotation parse — one loader module, imported (not copied) by
+    both, so a grammar change can never make the static and dynamic
+    checks disagree about what a contract says."""
+    from tools.rtlint import annotations as ann
+    from tools.rtlint import core as lint_core
+    from tools.rtsan import core as san_core
+
+    assert san_core.load_annotations is ann.load_annotations
+    assert san_core.parse_directives is ann.parse_directives
+    assert lint_core.parse_directives is ann.parse_directives
+    assert lint_core.func_directives is ann.func_directives
+
+    # Behavioral agreement on a real contract comment: the Module path
+    # (rtlint rules) and the loader path (rtsan instrumentation) see
+    # the same owner/holds/entry facts.
+    src = ("class C:\n"
+           "    # rtlint: owner=driver entry=driver holds=_lock\n"
+           "    def f(self):\n"
+           "        pass\n")
+    mod = lint_core.Module("x.py", "x.py", src)
+    import ast
+
+    fdef = mod.tree.body[0].body[0]
+    d = mod.func_directives(fdef)
+    loaded = ann.load_annotations(src)
+    assert len(loaded) == 1
+    fa = loaded[0]
+    assert (d["owner"], d["entry"], d["holds"]) == ("driver", "driver",
+                                                    "_lock")
+    assert (fa.owner, fa.entry, fa.holds) == ("driver", "driver",
+                                              ("_lock",))
+    assert isinstance(fdef, ast.FunctionDef)
+
+
+def test_rt108_fires_on_dangling_holds(tmp_path):
+    """Acceptance: the static half of the same contract — a holds=
+    naming a lock no method assigns is an RT108 finding."""
+    from tools.rtlint import run_paths
+
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def ok(self):  # rtlint: holds=_lock\n"
+        "        pass\n"
+        "    def bad(self):  # rtlint: holds=_gone\n"
+        "        pass\n")
+    report = run_paths([str(p)])
+    assert [(f.rule, f.line) for f in report.findings] == [("RT108", 7)]
+    assert "_gone" in report.findings[0].message
+
+
+@pytest.mark.skipif(os.environ.get("RT_SAN") == "0",
+                    reason="sanitizer disabled for this run")
+def test_engine_stats_sanitizer_block(rt_cluster):
+    """engine.stats() carries a ``sanitizer`` block while rtsan is
+    active (this module is on the conftest opt-in list): process
+    findings count — zero on a healthy engine — and max hold time per
+    named serve lock, so chaos benchmarks can assert cleanliness."""
+    import tools.rtsan as rtsan
+
+    assert rtsan.is_active()
+    import jax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import DecodeEngine
+
+    cfg = gpt.CONFIGS["nano"]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, slots=2, chunk=4, max_len=64,
+                       prompt_buckets=(8,))
+    try:
+        prompt = np.arange(5, dtype=np.int32) % cfg.vocab_size
+        out = np.concatenate(list(eng.stream(prompt, 6)))
+        assert out.shape == (6,)
+        st = eng.stats()
+        assert "sanitizer" in st, sorted(st)
+        san = st["sanitizer"]
+        assert san["findings"] == 0
+        # The admission lock was named via its holds= contract and
+        # held during construction/submit: it must show a hold time.
+        assert any("_admit_lock" in k or "engine.py" in k
+                   for k in san["max_hold_s"]), san
+        assert all(v >= 0 for v in san["max_hold_s"].values())
+    finally:
+        eng.shutdown()

@@ -18,6 +18,9 @@ RT105     retryable pushback classes out of sync with _PUSHBACK_CAUSES
 RT106     metric names violating prometheus conventions (shared with
           the runtime MetricsRegistry.register lint)
 RT107     bare / silently-swallowed except in serve control loops
+RT108     owner=/holds= annotations naming a lock / driver
+          registration that does not exist (the same contracts the
+          runtime sanitizer tools/rtsan enforces dynamically)
 ========  ============================================================
 
 Suppression: ``# rtlint: disable=RT101[,RT104]`` on the offending line
@@ -25,6 +28,8 @@ Suppression: ``# rtlint: disable=RT101[,RT104]`` on the offending line
 after the directive. Grandfathered findings live in
 ``tools/rtlint/baseline.json``; ``--update-baseline`` regenerates it.
 """
+from .annotations import (FuncAnn, load_annotations,  # noqa: F401
+                          parse_directives)
 from .core import (Finding, Module, ProjectRule, Report, Rule,
                    load_baseline, run, write_baseline)
 from .metrics_names import lint_metric_name
@@ -40,7 +45,7 @@ def run_paths(paths, baseline_path=None, rule_filter=None) -> Report:
                rule_filter=rule_filter)
 
 
-__all__ = ["Finding", "Module", "ProjectRule", "Report", "Rule",
-           "ALL_RULES", "RULE_TABLE", "DEFAULT_BASELINE",
-           "lint_metric_name", "load_baseline", "run", "run_paths",
-           "write_baseline"]
+__all__ = ["Finding", "FuncAnn", "Module", "ProjectRule", "Report",
+           "Rule", "ALL_RULES", "RULE_TABLE", "DEFAULT_BASELINE",
+           "lint_metric_name", "load_annotations", "load_baseline",
+           "parse_directives", "run", "run_paths", "write_baseline"]

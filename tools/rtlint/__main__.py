@@ -19,7 +19,7 @@ from . import DEFAULT_BASELINE, RULE_TABLE, run_paths, write_baseline
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.rtlint",
-        description="repo-native static analysis (rules RT101-RT107)")
+        description="repo-native static analysis (rules RT101-RT108)")
     ap.add_argument("paths", nargs="+", help="files or directories")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")

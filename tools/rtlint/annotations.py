@@ -1,0 +1,158 @@
+"""THE shared annotation loader: one parse consumed by both the static
+analyzer (rtlint) and the runtime sanitizer (tools/rtsan).
+
+rtlint's directives are *contracts*, not comments: ``owner=driver``
+promises a method only ever runs on its object's driver thread,
+``holds=<lock>`` promises every caller enters with ``self.<lock>``
+held, and ``entry=driver`` marks the method whose CALLER registers as
+the driver thread (rtsan binds ownership there; RT108 requires one per
+driver-owned class). A contract checked by two tools must be parsed by
+ONE loader — if the static and dynamic sides ever read the same
+comment differently, an annotation could pass review while enforcing
+nothing — so this module owns the grammar and both
+``tools/rtlint/core.py`` and ``tools/rtsan/core.py`` import it
+(identity pinned by ``tests/test_rtsan.py``).
+
+Grammar (one comment, any number of ``key=value`` tokens separated by
+whitespace; prose after the tokens is ignored so directives can carry a
+justification)::
+
+    # rtlint: disable=RT101,RT104   <why this is safe>
+    # rtlint: owner=driver entry=driver
+    # rtlint: holds=_lock           <every caller holds self._lock>
+    # rtsan: disable=RS104          <why this blocking call is safe>
+
+Placement: a directive on a line (or the line directly above, for
+wrapped statements) attaches to that line; a directive anywhere on a
+(possibly multi-line) ``def`` signature, or on the line directly above
+it, applies to the whole function.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@functools.lru_cache(maxsize=8)
+def _tag_re(tag: str) -> "re.Pattern":
+    return re.compile(re.escape(tag) + r":\s*(.*)")
+
+
+def parse_directives(comment: str, tag: str = "rtlint") -> Dict[str, str]:
+    """``# <tag>: k=v[,v2] [k=v ...] prose`` -> ``{k: v[,v2]}``. Tokens
+    split on whitespace ONLY, so comma-joined values
+    (``disable=RT101,RT104``) stay intact; the first non ``k=v`` token
+    starts the prose. Non-directive comments return ``{}``."""
+    m = _tag_re(tag).search(comment)
+    if not m:
+        return {}
+    out: Dict[str, str] = {}
+    for tok in m.group(1).split():
+        if "=" not in tok:
+            break      # first non k=v token starts the prose
+        k, _, v = tok.partition("=")
+        if not k or not v:
+            break
+        out[k] = out[k] + "," + v if k in out else v
+    return out
+
+
+def comment_map(source: str) -> Dict[int, str]:
+    """line -> full comment text (without the leading ``#``), built
+    with ``tokenize`` so comments survive into analysis — ``ast`` alone
+    drops them. Partial on TokenError (the caller already parsed)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string.lstrip("#")
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+def directive_map(source: str, tag: str = "rtlint"
+                  ) -> Dict[int, Dict[str, str]]:
+    """line -> parsed directives on that line (empty lines omitted)."""
+    return {ln: d for ln, c in comment_map(source).items()
+            if (d := parse_directives(c, tag))}
+
+
+def line_directives(directives: Dict[int, Dict[str, str]],
+                    line: int) -> Dict[str, str]:
+    """Directives attached to ``line``: on the line itself or the line
+    directly above (wrapped statements)."""
+    out = dict(directives.get(line - 1, ()))
+    out.update(directives.get(line, ()))
+    return out
+
+
+def func_directives(directives: Dict[int, Dict[str, str]],
+                    funcdef) -> Dict[str, str]:
+    """Directives anywhere on the (possibly multi-line) ``def``
+    signature, or on the line directly above it."""
+    out = dict(directives.get(funcdef.lineno - 1, ()))
+    sig_end = (funcdef.body[0].lineno - 1 if funcdef.body
+               else funcdef.lineno)
+    for ln in range(funcdef.lineno, sig_end + 1):
+        out.update(directives.get(ln, ()))
+    return out
+
+
+@dataclass(frozen=True)
+class FuncAnn:
+    """One annotated function: the contract rtsan enforces at runtime
+    and RT108 checks statically."""
+    cls: Optional[str]     # dotted enclosing-class path; None = module
+    name: str
+    lineno: int
+    end_lineno: int
+    owner: Optional[str]   # owner=<who> (``driver``)
+    holds: Tuple[str, ...]  # holds=<lock[,lock2]> attribute names
+    entry: Optional[str]   # entry=<who>: caller registers as the owner
+    directives: Dict[str, str] = None  # the full directive dict
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def load_annotations(source: str, tag: str = "rtlint") -> List[FuncAnn]:
+    """Parse ``source`` and return every function carrying an
+    ``owner=`` / ``holds=`` / ``entry=`` contract. Raises SyntaxError
+    on unparseable source (callers gate)."""
+    tree = ast.parse(source)
+    directives = directive_map(source, tag)
+    out: List[FuncAnn] = []
+
+    def rec(node, cls_path: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                nested = (f"{cls_path}.{child.name}" if cls_path
+                          else child.name)
+                rec(child, nested)
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                d = func_directives(directives, child)
+                holds = tuple(h.strip() for h in
+                              d.get("holds", "").split(",") if h.strip())
+                owner = d.get("owner")
+                entry = d.get("entry")
+                if owner or holds or entry:
+                    out.append(FuncAnn(
+                        cls=cls_path, name=child.name,
+                        lineno=child.lineno,
+                        end_lineno=child.end_lineno or child.lineno,
+                        owner=owner, holds=holds, entry=entry,
+                        directives=d))
+                rec(child, cls_path)  # nested defs share the class path
+                continue
+            rec(child, cls_path)
+
+    rec(tree, None)
+    return out

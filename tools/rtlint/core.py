@@ -12,18 +12,22 @@ resolution. Everything a rule learns comes from three places:
 
 Directive grammar (one comment, any number of ``key=value`` tokens
 separated by whitespace or commas; prose after the tokens is ignored so
-directives can carry a justification)::
+directives can carry a justification; the parse itself lives in
+:mod:`tools.rtlint.annotations` — THE loader shared with the runtime
+sanitizer, tools/rtsan)::
 
     # rtlint: disable=RT101,RT104   <why this is safe>
     # rtlint: disable=all
     # rtlint: owner=driver          <single-thread-owned method>
     # rtlint: holds=_lock           <every caller holds self._lock>
+    # rtlint: entry=driver          <caller registers as the driver>
 
 Placement: a ``disable`` on the finding line (or the line directly
 above, for wrapped statements) suppresses that line; any directive on a
 ``def`` line (or the line directly above the ``def``) applies to the
-whole function body. ``owner``/``holds`` are function-level facts used
-by RT101/RT102.
+whole function body. ``owner``/``holds``/``entry`` are function-level
+contracts used by RT101/RT102/RT108 statically and enforced at runtime
+by tools/rtsan.
 
 Findings carry a stable **key** (``rule:path:symbol``) that does not
 include the line number, so the checked-in baseline survives unrelated
@@ -33,13 +37,14 @@ suffixes in source order.
 from __future__ import annotations
 
 import ast
-import io
 import json
 import os
 import re
-import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .annotations import (comment_map, func_directives, line_directives,
+                          parse_directives)
 
 RULE_ID_RE = re.compile(r"^RT\d{3}$")
 
@@ -69,47 +74,28 @@ class Finding:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
 
 
-def _parse_directives(comment: str) -> Dict[str, str]:
-    """``# rtlint: k=v[,v2] [k=v ...] prose`` -> {k: v[,v2]}. Tokens
-    split on whitespace ONLY, so comma-joined values
-    (``disable=RT101,RT104``) stay intact; the first non ``k=v`` token
-    starts the prose. Non-directive comments return {}."""
-    m = re.search(r"rtlint:\s*(.*)", comment)
-    if not m:
-        return {}
-    out: Dict[str, str] = {}
-    for tok in m.group(1).split():
-        if "=" not in tok:
-            break      # first non k=v token starts the prose
-        k, _, v = tok.partition("=")
-        if not k or not v:
-            break
-        out[k] = out[k] + "," + v if k in out else v
-    return out
-
-
 class Module:
-    """One parsed source file plus its comment/directive maps."""
+    """One parsed source file plus its comment/directive maps. The
+    directive parse lives in :mod:`tools.rtlint.annotations` — THE
+    shared loader the runtime sanitizer (tools/rtsan) reads the same
+    contracts through; ``tag`` selects whose directives this module
+    resolves (rtlint suppressions by default, ``"rtsan"`` for the
+    sanitizer's ``# rtsan: disable=RSxxx`` suppressions)."""
 
-    def __init__(self, path: str, relpath: str, source: str):
+    def __init__(self, path: str, relpath: str, source: str,
+                 tag: str = "rtlint"):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.source = source
+        self.tag = tag
         self.lines = source.splitlines()
         self.tree = ast.parse(source)       # caller handles SyntaxError
         #: line -> full comment text (without the leading '#')
-        self.comments: Dict[int, str] = {}
-        try:
-            for tok in tokenize.generate_tokens(
-                    io.StringIO(source).readline):
-                if tok.type == tokenize.COMMENT:
-                    self.comments[tok.start[0]] = tok.string.lstrip("#")
-        except tokenize.TokenError:
-            pass  # comment map stays partial; ast.parse already passed
+        self.comments: Dict[int, str] = comment_map(source)
         #: line -> directives on that line
         self.directives: Dict[int, Dict[str, str]] = {
             ln: d for ln, c in self.comments.items()
-            if (d := _parse_directives(c))}
+            if (d := parse_directives(c, tag))}
         # Function-level directive intervals (innermost last so lookups
         # can prefer the tightest enclosing def).
         self._func_spans: List[Tuple[int, int, Dict[str, str]]] = []
@@ -125,19 +111,12 @@ class Module:
     def line_directives(self, line: int) -> Dict[str, str]:
         """Directives attached to ``line``: on the line itself or the
         line directly above (wrapped statements)."""
-        out = dict(self.directives.get(line - 1, ()))
-        out.update(self.directives.get(line, ()))
-        return out
+        return line_directives(self.directives, line)
 
     def func_directives(self, funcdef) -> Dict[str, str]:
         """Directives anywhere on the (possibly multi-line) ``def``
         signature, or on the line directly above it."""
-        out = dict(self.directives.get(funcdef.lineno - 1, ()))
-        sig_end = (funcdef.body[0].lineno - 1 if funcdef.body
-                   else funcdef.lineno)
-        for ln in range(funcdef.lineno, sig_end + 1):
-            out.update(self.directives.get(ln, ()))
-        return out
+        return func_directives(self.directives, funcdef)
 
     def _disabled_rules(self, d: Dict[str, str]) -> Set[str]:
         raw = d.get("disable", "")

@@ -624,6 +624,116 @@ class SwallowedExceptRule(Rule):
                     f"{scope}.swallowed_except")
 
 
+# ------------------------------------------------------------------ RT108
+class AnnotationDriftRule(Rule):
+    """RT108: annotation drift — an ``owner=``/``holds=`` contract
+    whose named lock or driver registration does not exist. The
+    annotations are enforced BOTH statically (RT101/RT102 trust them)
+    and dynamically (tools/rtsan asserts them at runtime), so a
+    dangling name is a contract nobody can check: it was true the day
+    it was written and rotted as the class grew. Flags:
+
+    - ``holds=<name>`` on a method where no method of the enclosing
+      class ever assigns ``self.<name>`` — the promised lock attribute
+      does not exist (rtsan escalates this to a hard error at runtime);
+    - in the driver-owned files (RT102's path scope, where rtsan binds
+      thread ownership) a class with ``owner=driver`` methods but no
+      method annotated ``# rtlint: entry=driver`` — nothing registers
+      WHICH thread is the driver, so the ownership contract is
+      unanchored both for the reader and for the runtime check.
+    """
+
+    id = "RT108"
+    summary = "owner=/holds= annotation names a lock/registration that does not exist"
+
+    ENTRY_SCOPE = ("serve/engine.py", "serve/draft.py", "data/llm.py")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        in_entry_scope = mod.relpath.endswith(self.ENTRY_SCOPE)
+        for cls in [n for n in ast.walk(mod.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            assigned = self._assigned_attrs(methods) \
+                | self._class_body_attrs(cls)
+            owners, entries = [], []
+            for m in methods:
+                d = mod.func_directives(m)
+                if d.get("owner") == "driver":
+                    owners.append(m)
+                if d.get("entry") == "driver":
+                    entries.append(m)
+                for name in (h.strip() for h in
+                             d.get("holds", "").split(",") if h.strip()):
+                    if name not in assigned:
+                        yield Finding(
+                            mod.relpath, m.lineno, self.id,
+                            f"{cls.name}.{m.name} is annotated "
+                            f"'holds={name}' but no method of "
+                            f"{cls.name} assigns self.{name} — the "
+                            f"contract names a lock that does not "
+                            f"exist; fix the name or drop the "
+                            f"annotation",
+                            f"{cls.name}.{m.name}.holds.{name}")
+            if in_entry_scope and owners and not entries:
+                m0 = owners[0]
+                yield Finding(
+                    mod.relpath, m0.lineno, self.id,
+                    f"{cls.name} has owner=driver methods (first: "
+                    f"{m0.name}) but no method annotated "
+                    f"'# rtlint: entry=driver' — nothing registers the "
+                    f"driver thread, so neither reviewers nor the "
+                    f"runtime sanitizer can tell who the owner is; "
+                    f"annotate the method whose caller becomes the "
+                    f"driver (the thread target / the consume loop)",
+                    f"{cls.name}.driver_entry")
+
+    @staticmethod
+    def _class_body_attrs(cls: ast.ClassDef) -> Set[str]:
+        """Class-level attribute assignments (``class X: _lock = ...``)
+        — reachable as ``self.<name>`` and therefore valid ``holds=``
+        targets."""
+        out: Set[str] = set()
+        for node in cls.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    @staticmethod
+    def _assigned_attrs(methods) -> Set[str]:
+        """Every ``self.X`` assigned anywhere in the class's methods —
+        including tuple/list unpacking targets. Lexical only: an
+        attribute assigned by a BASE class is invisible here (suppress
+        with a justification in that rare case)."""
+        out: Set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                while targets:
+                    t = targets.pop()
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        targets.extend(t.elts)
+                        continue
+                    if isinstance(t, ast.Starred):
+                        targets.append(t.value)
+                        continue
+                    a = _self_attr(t)
+                    if a:
+                        out.add(a)
+        return out
+
+
 # ----------------------------------------------------------------- shared
 def _nodes_with_scope(tree, node_type):
     """Yield (node, qualified_scope) for every ``node_type`` in the
@@ -648,6 +758,6 @@ def _calls_with_scope(tree):
 ALL_RULES: Tuple[Rule, ...] = (
     LockGuardRule(), DriverOwnershipRule(), RecompileHazardRule(),
     AsyncBlockingRule(), RetryableWireRule(), MetricNameRule(),
-    SwallowedExceptRule())
+    SwallowedExceptRule(), AnnotationDriftRule())
 
 RULE_TABLE = {r.id: r for r in ALL_RULES}

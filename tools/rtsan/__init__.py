@@ -1,0 +1,93 @@
+"""rtsan: runtime enforcement of rtlint's concurrency contracts.
+
+rtlint (tools/rtlint) checks the annotations *statically*; rtsan checks
+that execution actually honors them — the same ``# rtlint:
+owner=driver`` / ``holds=<lock>`` / ``entry=driver`` comments, read
+through the same loader (:mod:`tools.rtlint.annotations`), become
+runtime assertions, and a global lock-acquisition-order graph catches
+ABBA deadlocks that never fire in the run that reveals them.
+
+Usage::
+
+    RT_SAN=1 pytest tests/            # sanitize the whole suite
+    pytest tests/                     # engine/chaos/data-llm modules
+                                      # sanitized via the conftest
+                                      # opt-in list (tier-1 default)
+    RT_SAN=0 pytest tests/            # fully off (no patching at all)
+    python -m tools.rtsan --report    # lock-order graph + hold times
+                                      # from the last run's artifact
+
+Checks RS101 (lock-order cycle), RS102 (holds= violated / dangling —
+raises), RS103 (owner=driver violated — raises), RS104 (blocking under
+a lock: time.sleep, timeout-less Condition.wait, device dispatch),
+RS105 (leaked thread at watch teardown). Suppress with ``# rtsan:
+disable=RSxxx <why>`` on the reported line (or the line above / the
+enclosing ``def``); grandfathered keys live in
+``tools/rtsan/baseline.json`` — shipped EMPTY and expected to stay so.
+"""
+from .core import (DEFAULT_BASELINE, DEFAULT_MODULES, REPO_ROOT,
+                   SANITIZER, RTSanViolation, SanCondition, Sanitizer,
+                   SanLock)
+
+RULES = {
+    "RS101": "lock-order cycle (potential ABBA deadlock)",
+    "RS102": "holds=<lock> contract violated or names a missing attr",
+    "RS103": "owner=driver method ran off the registered driver thread",
+    "RS104": "blocking under a lock (sleep / timeout-less wait / "
+             "device dispatch)",
+    "RS105": "thread leaked past its watch scope",
+}
+
+
+def enable(modules=DEFAULT_MODULES, active: bool = True,
+           wrap_dispatch: bool = True) -> Sanitizer:
+    return SANITIZER.enable(modules=modules, active=active,
+                            wrap_dispatch=wrap_dispatch)
+
+
+def disable() -> Sanitizer:
+    return SANITIZER.disable()
+
+
+def is_enabled() -> bool:
+    return SANITIZER.enabled
+
+
+def is_active() -> bool:
+    return SANITIZER.enabled and SANITIZER.active
+
+
+def activated():
+    return SANITIZER.activated()
+
+
+def thread_watch(targets=None, allow=(), grace_s: float = 0.2):
+    return SANITIZER.thread_watch(targets=targets, allow=allow,
+                                  grace_s=grace_s)
+
+
+def findings():
+    return list(SANITIZER.findings)
+
+
+def gate(extra=None, baseline_path: str = DEFAULT_BASELINE) -> dict:
+    return SANITIZER.gate(extra=extra, baseline_path=baseline_path)
+
+
+def snapshot() -> dict:
+    return SANITIZER.snapshot()
+
+
+def dump(path: str) -> str:
+    return SANITIZER.dump(path)
+
+
+def stats_block(path_filter: str = "serve/") -> dict:
+    return SANITIZER.stats_block(path_filter)
+
+
+__all__ = ["DEFAULT_BASELINE", "DEFAULT_MODULES", "REPO_ROOT", "RULES",
+           "RTSanViolation", "SANITIZER", "SanCondition", "Sanitizer",
+           "SanLock", "activated", "disable", "dump", "enable",
+           "findings", "gate", "is_active", "is_enabled", "snapshot",
+           "stats_block", "thread_watch"]

@@ -1,0 +1,124 @@
+"""CLI: ``python -m tools.rtsan --report [artifacts...]``.
+
+Renders a run artifact (written by the conftest gate at session end,
+or by any process via ``tools.rtsan.dump``): findings, the accumulated
+lock-acquisition-order graph, and the per-site hold-time table.
+Multiple artifacts (e.g. one per worker process from ``RT_SAN_DIR``)
+are merged. With no paths, reads ``$RT_SAN_DIR`` or the newest
+``/tmp/rtsan-*.json``. Exit code 1 when any merged finding is missing
+from the baseline (the same --check semantics as rtlint), else 0.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from ..rtlint.core import load_baseline
+from .core import DEFAULT_BASELINE, HOLD_BUCKETS
+
+
+def _default_paths():
+    d = os.environ.get("RT_SAN_DIR")
+    if d and os.path.isdir(d):
+        return sorted(glob.glob(os.path.join(d, "*.json")))
+    cands = glob.glob("/tmp/rtsan-*.json")
+    return [max(cands, key=os.path.getmtime)] if cands else []
+
+
+def _merge(paths):
+    findings, edges, holds = {}, {}, {}
+    for p in paths:
+        with open(p) as f:
+            data = json.load(f)
+        for fd in data.get("findings", ()):
+            findings.setdefault(fd["key"], fd)
+        for e in data.get("edges", ()):
+            key = (e["from"], e["to"])
+            cur = edges.get(key)
+            if cur is None:
+                edges[key] = dict(e)
+            else:
+                cur["count"] += e.get("count", 0)
+        for h in data.get("holds", ()):
+            cur = holds.get(h["site"])
+            if cur is None:
+                holds[h["site"]] = dict(h)
+            else:
+                cur["count"] += h["count"]
+                cur["total_s"] += h["total_s"]
+                cur["max_s"] = max(cur["max_s"], h["max_s"])
+                cur["buckets"] = [x + y for x, y in
+                                  zip(cur["buckets"], h["buckets"])]
+                cur["name"] = cur["name"] or h.get("name")
+    return findings, edges, holds
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rtsan",
+        description="runtime sanitizer report (rules RS101-RS105)")
+    ap.add_argument("paths", nargs="*",
+                    help="run artifact json files (default: $RT_SAN_DIR "
+                         "or the newest /tmp/rtsan-*.json)")
+    ap.add_argument("--report", action="store_true",
+                    help="print findings + lock-order graph + hold-time "
+                         "table (the default action)")
+    ap.add_argument("--json", action="store_true",
+                    help="merged machine-readable report on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    args = ap.parse_args(argv)
+
+    paths = args.paths or _default_paths()
+    if not paths:
+        print("rtsan: no run artifact found (run the suite first, or "
+              "pass artifact paths)", file=sys.stderr)
+        return 2
+    findings, edges, holds = _merge(paths)
+    baseline = load_baseline(args.baseline)
+    new = sorted(k for k in findings if k not in baseline)
+
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "artifacts": [os.path.abspath(p) for p in sorted(paths)],
+            "findings": [findings[k] for k in sorted(findings)],
+            "new": new,
+            "edges": [edges[k] for k in sorted(edges)],
+            "holds": [holds[k] for k in sorted(holds)],
+        }, indent=2, sort_keys=True))
+        return 1 if new else 0
+
+    print(f"rtsan report ({len(paths)} artifact"
+          f"{'s' if len(paths) != 1 else ''})")
+    print(f"\n== findings: {len(findings)} ({len(new)} new) ==")
+    for k in sorted(findings):
+        fd = findings[k]
+        mark = "" if k in baseline else " [NEW]"
+        first = fd["message"].splitlines()[0]
+        print(f"  {fd['path']}:{fd['line']}: {fd['rule']} {first}{mark}")
+
+    print(f"\n== lock-order graph: {len(edges)} edges ==")
+    for (a, b) in sorted(edges):
+        e = edges[(a, b)]
+        print(f"  {a} -> {b}  (x{e['count']}, first at "
+              f"{e.get('acquire_site', '?')})")
+
+    print(f"\n== hold times: {len(holds)} lock sites ==")
+    labels = [f"<{ub * 1000:g}ms" for ub in HOLD_BUCKETS] + [
+        f">={HOLD_BUCKETS[-1]:g}s"]
+    for site in sorted(holds):
+        h = holds[site]
+        mean = h["total_s"] / max(h["count"], 1)
+        hist = " ".join(f"{lb}:{n}" for lb, n in
+                        zip(labels, h["buckets"]))
+        name = f" ({h['name']})" if h.get("name") else ""
+        print(f"  {site}{name}  n={h['count']} mean={mean * 1000:.3f}ms "
+              f"max={h['max_s'] * 1000:.3f}ms  [{hist}]")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
