@@ -70,6 +70,19 @@ modes — accepted-tokens-per-target-forward and the acceptance rate
 from the engine's own accounting. ``--smoke`` shrinks it (off vs
 n-gram only) for tier-1 CI.
 
+``--disagg`` (ISSUE 14) switches to the disaggregated prefill/decode
+A/B: the SAME bursty-prefill Poisson mix — steady long decode streams
+plus bursts of long-prompt/2-token requests — is driven through a
+colocated 2-replica deployment and a roles-split one (1 prefill + 1
+decode) at equal offered load. Colocated, every burst's prefill
+dispatch lands between decode chunk dispatches and inflates decode
+TPOT; disaggregated, bursts prefill on the prefill replica and reach
+the decode engine as a cheap KV import. Reports decode TPOT p50/p95
+isolation per mode, handoff latency/bytes from the engines' own
+accounting, and asserts ZERO broken streams and NO handoff leaks
+(pages free back to baseline, no outstanding leases). ``--smoke``
+shrinks it for tier-1 CI.
+
 ``--chaos`` (ISSUE 7) switches to the crash-safety acceptance run: a
 2-replica continuous-engine deployment serves seeded (deterministic)
 streams under load while a replica is KILLED mid-stream; every client
@@ -129,6 +142,15 @@ def main():
                              "2-replica engine deployment mid-load and "
                              "assert zero broken client streams "
                              "(deterministic replay resume)")
+    parser.add_argument("--disagg", action="store_true",
+                        help="disaggregated prefill/decode A/B "
+                             "(ISSUE 14): the same bursty-prefill "
+                             "Poisson mix driven through a colocated "
+                             "deployment and a roles-split one at "
+                             "equal offered load; reports decode TPOT "
+                             "p50/p95 isolation, handoff latency, and "
+                             "asserts zero broken streams and no "
+                             "handoff leaks")
     parser.add_argument("--spec", action="store_true",
                         help="speculative-decoding A/B: spec off vs "
                              "n-gram vs tied-embedding model drafter "
@@ -302,6 +324,11 @@ def main():
     # Cache sized for the worst chunk over-run: the last fused chunk may
     # execute up to (chunk - 1) steps past max_new before truncation.
     max_len = 16 + max_new + max(max(chunks), 8)
+    if args.disagg:
+        run_disagg_ab(args, serve, np, cfg_name, f"gpt_{cfg_name}")
+        serve.shutdown()
+        rt.shutdown()
+        return
     if args.chaos:
         run_chaos_mode(args, serve, np, cfg_name, f"gpt_{cfg_name}")
         serve.shutdown()
@@ -653,6 +680,14 @@ def run_trace_mode(args, rt, serve, np, cfg_name, chunk, model):
         "value": 1, "unit": "ok", "counts": counts}))
 
 
+def pct(xs, q):
+    """Nearest-rank percentile (no interpolation); None on empty."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(int(len(xs) * q), len(xs) - 1)]
+
+
 def _mk_prompt(rid: int, plen: int, vocab: int):
     """Deterministic per-request prompt, identical across A/B modes."""
     import numpy as _np
@@ -838,10 +873,6 @@ def run_continuous_ab(args, serve, np, cfg_name, model):
                for i in range(n_req) if toks[i] != max_news[i]]
         assert not bad, f"short/failed streams (i, got, want, err): {bad}"
         return ttfts, comps, wall, sum(toks)
-
-    def pct(xs, q):
-        xs = sorted(xs)
-        return xs[min(int(len(xs) * q), len(xs) - 1)]
 
     # Both deployments stay up for the whole A/B and the drive passes
     # INTERLEAVE (static, continuous, static, continuous): this box's
@@ -1031,10 +1062,6 @@ def run_paged_ab(args, np, cfg_name, model):
                for i in range(n_req) if toks[i] != max_news[i]]
         assert not bad, f"short streams (i, got, want): {bad}"
         return ttfts, comps, wall, sum(toks)
-
-    def pct(xs, q):
-        xs = sorted(xs)
-        return xs[min(int(len(xs) * q), len(xs) - 1)]
 
     def ttft_probe(eng, repeats=7):
         """Median TTFT of a lone request on an idle engine (the paged
@@ -1265,10 +1292,6 @@ def run_spec_ab(args, np, cfg_name, model):
                  for i in range(n_req)]
         return ttfts, tpots, wall, sum(toks)
 
-    def pct(xs, q):
-        xs = sorted(xs)
-        return xs[min(int(len(xs) * q), len(xs) - 1)]
-
     engines = {}
     for mode in modes:
         eng = build(mode)
@@ -1343,6 +1366,281 @@ def run_spec_ab(args, np, cfg_name, model):
         summary["model_accepted_per_forward"] = \
             md["accepted_per_forward"]
     print(json.dumps(summary))
+
+
+def run_disagg_ab(args, serve, np, cfg_name, model):
+    """ISSUE 14 acceptance: colocated vs disaggregated prefill/decode
+    under a bursty-prefill Poisson mix at EQUAL offered load and equal
+    replica counts (2 colocated vs 1 prefill + 1 decode).
+
+    Steady decode streams (short prompts, long outputs) share the
+    deployment with Poisson BURSTS of prefill-heavy requests (long
+    prompts, 2 output tokens). Colocated, every burst prefill dispatch
+    lands between the decode engine's chunk dispatches and inflates
+    decode TPOT; disaggregated, bursts prefill on the prefill replica
+    and reach the decode engine as a cheap KV import. Reports decode
+    TPOT p50/p95 per mode, handoff latency/bytes, and asserts ZERO
+    broken streams and NO handoff leaks (pages free back to baseline,
+    no outstanding leases)."""
+    import threading as _th
+
+    import jax
+
+    import ray_tpu as rt
+    from ray_tpu.models import gpt, gpt_decode
+    from ray_tpu.testing import _serve_replica_handles
+
+    # Slots exceed the steady decode lanes so burst admissions always
+    # find a free slot — the contention being measured is for the
+    # DRIVER's dispatch stream (prefill programs between decode
+    # chunks), not for slots.
+    slots = 8
+    chunk = 4
+    plen_dec, plen_burst = 8, 112
+    n_dec = 4 if args.smoke else 6
+    dec_new = 64 if args.smoke else 96
+    burst_size = 6
+    burst_gap_s = 0.03
+    max_len = 128
+    cfg = gpt.CONFIGS[cfg_name]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+
+    @serve.deployment(max_ongoing_requests=64,
+                      health_check_period_s=1.0,
+                      graceful_shutdown_timeout_s=10.0)
+    class DisaggGPT:
+        def __init__(self, cfg_name, max_len, slots, chunk, buckets):
+            from ray_tpu.models import gpt as _gpt
+            from ray_tpu.serve.engine import DecodeEngine
+
+            self.cfg = _gpt.CONFIGS[cfg_name]
+            p = _gpt.init_params(jax.random.PRNGKey(0), self.cfg)
+            # prefix_cache off: the leak check below wants pages_free
+            # to return EXACTLY to baseline, with no cache pins.
+            self.engine = DecodeEngine(
+                p, self.cfg, slots=slots, chunk=chunk, max_len=max_len,
+                prompt_buckets=tuple(buckets), paged=True, page_size=8,
+                prefix_cache=False, deployment="gpt_disagg")
+
+        @serve.batch(continuous=True)
+        def decode(self, request):
+            return self.engine, {
+                "prompt": _mk_prompt(int(request["rid"]),
+                                     int(request["plen"]),
+                                     self.cfg.vocab_size),
+                "max_new": int(request["max_new"]),
+                "seed": int(request["rid"])}
+
+        def warm(self, plen: int, max_new: int = 2):
+            list(self.engine.stream(
+                _mk_prompt(0, plen, self.cfg.vocab_size), max_new))
+            return "warm"
+
+        def __call__(self, request):
+            return self.decode(request)
+
+    refs = {i: np.concatenate([s[0] for s in gpt_decode.generate_chunked(
+        params, _mk_prompt(1000 + i, plen_dec, cfg.vocab_size)[None],
+        cfg, dec_new, chunk=chunk, max_len=max_len)])
+        for i in range(n_dec)}
+
+    def run_mode(disagg: bool):
+        name = "gpt_disagg"
+        dep = DisaggGPT.options(
+            name=name,
+            num_replicas=None if disagg else 2,
+            engine_config={"roles": {"prefill": 1, "decode": 1},
+                           "handoff_ttl_s": 15.0} if disagg else None)
+        handle = serve.run(dep.bind(cfg_name, max_len, slots, chunk,
+                                    (plen_dec, plen_burst)),
+                           name=name, route_prefix=None)
+        # Warm every replica's programs (prefill buckets + chunk +
+        # export/import) before the clock starts.
+        for h in _serve_replica_handles(name, name).values():
+            for plen in (plen_dec, plen_burst):
+                try:
+                    rt.get(h.handle_request.remote(
+                        "warm", (plen,), {}, {}), timeout=600)
+                except Exception:  # noqa: BLE001 - prefill-role engine
+                    pass           # warms through the handoff below
+        for _ in range(2):
+            list(handle.options(stream=True).remote(
+                {"rid": 0, "plen": plen_dec, "max_new": 2}))
+            list(handle.options(stream=True).remote(
+                {"rid": 0, "plen": plen_burst, "max_new": 2}))
+
+        tpot_ms, ttft_ms = [], []
+        results = [None] * n_dec
+        errors = [None] * n_dec
+        done = _th.Event()
+
+        def dec_stream(i):
+            try:
+                toks = []
+                t0 = time.perf_counter()
+                last = None
+                it = handle.options(stream=True, resumable=True,
+                                    timeout_s=300.0).remote(
+                    {"rid": 1000 + i, "plen": plen_dec,
+                     "max_new": dec_new})
+                for item in it:
+                    now = time.perf_counter()
+                    w = np.asarray(item).ravel()
+                    if last is None:
+                        ttft_ms.append((now - t0) * 1000)
+                    elif len(w):
+                        tpot_ms.extend([(now - last) * 1000 / len(w)]
+                                       * len(w))
+                    last = now
+                    toks.extend(int(t) for t in w)
+                results[i] = toks
+            except Exception as e:  # noqa: BLE001 - counted as broken
+                errors[i] = repr(e)
+
+        bursts = {"offered": 0, "errors": 0}
+
+        def burst_client():
+            # Poisson bursts of prefill-heavy requests, identical
+            # schedule both modes (seeded RNG), until decode finishes.
+            import random as _rnd
+
+            r = _rnd.Random(77)
+            rid = 5000
+            while not done.is_set():
+                time.sleep(r.expovariate(1.0 / burst_gap_s))
+                ths = []
+                for _ in range(burst_size):
+                    rid += 1
+
+                    def one(rid=rid):
+                        try:
+                            list(handle.options(
+                                stream=True, timeout_s=120.0).remote(
+                                {"rid": rid, "plen": plen_burst,
+                                 "max_new": 2}))
+                        except Exception:  # noqa: BLE001 - counted
+                            bursts["errors"] += 1
+                    t = _th.Thread(target=one)
+                    t.start()
+                    ths.append(t)
+                    bursts["offered"] += 1
+                for t in ths:
+                    t.join()
+
+        t_start = time.perf_counter()
+        dec_threads = [_th.Thread(target=dec_stream, args=(i,))
+                       for i in range(n_dec)]
+        burst_thread = _th.Thread(target=burst_client)
+        for t in dec_threads:
+            t.start()
+            time.sleep(0.02)
+        burst_thread.start()
+        for t in dec_threads:
+            t.join()
+        done.set()
+        burst_thread.join()
+        wall = time.perf_counter() - t_start
+
+        broken = [(i, errors[i]) for i in range(n_dec)
+                  if errors[i] is not None
+                  or results[i] != [int(t) for t in refs[i]]]
+
+        # Handoff accounting + leak check across the surviving fleet:
+        # every lease claimed or swept, every page back on the free
+        # list (prefix cache off, so baseline == n_pages).
+        handles = _serve_replica_handles(name, name)
+        agg = {"exported": 0, "imported": 0, "import_fallbacks": 0,
+               "ship_bytes": 0, "leases_outstanding": 0,
+               "leases_reclaimed": 0}
+        leaks = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            agg = {k: 0 for k in agg}
+            leaked_pages = 0
+            for h in handles.values():
+                m = rt.get(h.get_metrics.remote(), timeout=10)
+                est = (m.get("engines") or [{}])[0]
+                for k in agg:
+                    agg[k] += int(est.get("handoff", {}).get(k, 0))
+                if est.get("paged"):
+                    leaked_pages += int(est.get("pages_used", 0))
+            leaks = agg["leases_outstanding"] + leaked_pages
+            if leaks == 0:
+                break
+            time.sleep(0.5)
+
+        mode = "disagg" if disagg else "colocated"
+        row = {
+            "metric": f"serve_{model}_disagg_{mode}_mode",
+            "value": round(pct(tpot_ms, 0.95) or 0.0, 3),
+            "unit": "decode_tpot_p95_ms",
+            "tpot_p50_ms": round(pct(tpot_ms, 0.5) or 0.0, 3),
+            "tpot_p95_ms": round(pct(tpot_ms, 0.95) or 0.0, 3),
+            "ttft_p50_ms": round(pct(ttft_ms, 0.5) or 0.0, 1),
+            "decode_streams": n_dec,
+            "decode_tokens": int(sum(len(r) for r in results
+                                     if r is not None)),
+            "burst_requests": bursts["offered"],
+            "burst_errors": bursts["errors"],
+            "broken_streams": len(broken),
+            "handoffs_exported": agg["exported"],
+            "handoffs_imported": agg["imported"],
+            "import_fallbacks": agg["import_fallbacks"],
+            "ship_bytes": agg["ship_bytes"],
+            "leases_reclaimed": agg["leases_reclaimed"],
+            "handoff_leaks": leaks,
+            "wall_s": round(wall, 2),
+        }
+        print(json.dumps(row))
+        assert not broken, f"broken decode streams ({mode}): {broken[:3]}"
+        serve.delete(name)
+        return row
+
+    coloc = run_mode(disagg=False)
+    disagg = run_mode(disagg=True)
+
+    # Mean handoff latency from the head-merged histogram (observed by
+    # the decode replicas; the bench process cannot see it locally).
+    handoff_ms = None
+    try:
+        total = {"sum": 0.0, "count": 0.0}
+        for line in rt.metrics_text().splitlines():
+            if line.startswith("ray_tpu_serve_kv_handoff_seconds_sum"):
+                total["sum"] += float(line.rsplit(" ", 1)[1])
+            elif line.startswith(
+                    "ray_tpu_serve_kv_handoff_seconds_count"):
+                total["count"] += float(line.rsplit(" ", 1)[1])
+        if total["count"]:
+            handoff_ms = round(total["sum"] / total["count"] * 1000, 2)
+    except Exception:  # noqa: BLE001 - head mid-flush
+        pass
+
+    summary = {
+        "metric": f"serve_{model}_disagg_ab",
+        "value": round(coloc["tpot_p95_ms"]
+                       / max(disagg["tpot_p95_ms"], 1e-9), 2),
+        "unit": "x_decode_tpot_p95_colocated_vs_disagg",
+        "tpot_p50_ratio": round(coloc["tpot_p50_ms"]
+                                / max(disagg["tpot_p50_ms"], 1e-9), 2),
+        "colocated_tpot_p95_ms": coloc["tpot_p95_ms"],
+        "disagg_tpot_p95_ms": disagg["tpot_p95_ms"],
+        "handoff_mean_ms": handoff_ms,
+        "handoffs_imported": disagg["handoffs_imported"],
+        "import_fallbacks": disagg["import_fallbacks"],
+        "ship_bytes": disagg["ship_bytes"],
+        "broken_streams": coloc["broken_streams"]
+        + disagg["broken_streams"],
+        "handoff_leaks": (coloc["handoff_leaks"] or 0)
+        + (disagg["handoff_leaks"] or 0),
+        "burst_requests": [coloc["burst_requests"],
+                           disagg["burst_requests"]],
+        "smoke": bool(args.smoke),
+    }
+    print(json.dumps(summary))
+    assert summary["handoff_leaks"] == 0, \
+        "handoff leaked pages or leases past the run"
+    assert disagg["handoffs_imported"] >= 1, \
+        "disaggregated mode never imported a handoff"
 
 
 def run_chaos_mode(args, serve, np, cfg_name, model):

@@ -493,6 +493,31 @@ def serve_metrics() -> dict:
             drain_duration=Histogram(
                 "serve_drain_duration_seconds",
                 "Wall time of graceful replica drains"),
+            # ---- disaggregated prefill/decode (ISSUE 14). Export is
+            # observed by the prefill engine, import latency by the
+            # decode engine (wall-clock across processes, like the
+            # deadlines it rides with), lease reclaims by the prefill
+            # engine's driver-loop sweep, and fallbacks by whichever
+            # layer degraded to a local prefill (where=router |
+            # engine).
+            kv_handoff=Histogram(
+                "serve_kv_handoff_seconds",
+                "Prefill->decode KV handoff latency: export stamp to "
+                "successful import on the decode engine"),
+            kv_ship_bytes=Counter(
+                "serve_kv_ship_bytes_total",
+                "KV bytes exported into handoff ship buffers"),
+            handoff_leases_reclaimed=Counter(
+                "serve_handoff_leases_reclaimed_total",
+                "Handoff leases that expired unclaimed (the decode "
+                "side died or fell back); their shipped pages were "
+                "swept"),
+            prefill_fallbacks=Counter(
+                "serve_prefill_fallbacks_total",
+                "Disaggregated requests that degraded to a local "
+                "prefill (where=router: no prefill replica answered; "
+                "where=engine: shipped payload unavailable or failed "
+                "byte verification)"),
         )
         return _serve
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from multiprocessing import shared_memory, resource_tracker
 from typing import Dict, List, Optional
@@ -170,8 +171,18 @@ class SharedMemoryStore:
     Reader processes attach by name (zero-copy).
     """
 
+    #: Default lifetime of a pending (create_pending → seal/abort)
+    #: reservation. A puller that dies between reserve and seal — the
+    #: task cancelled so hard its abort never ran, a thread killed by a
+    #: process-level fault — would otherwise pin its reserved bytes
+    #: (and squat the segment name) forever; the sweep reclaims on the
+    #: same lease-clock discipline as the serve handoff plane
+    #: (ISSUE 14 satellite). Generous against the slowest legitimate
+    #: transfer: GiB-scale pulls finish in seconds.
+    PENDING_TTL_S = 120.0
+
     def __init__(self, capacity_bytes: int, spill_dir: str = "",
-                 domain: str = ""):
+                 domain: str = "", pending_ttl_s: float = 0.0):
         self._capacity = capacity_bytes
         self._used = 0
         # RLock: see MemoryStore — the GC free path may re-enter delete().
@@ -179,8 +190,10 @@ class SharedMemoryStore:
         # object_id -> (shm handle or None, nbytes, spilled_path or None)
         self._owned: "OrderedDict[ObjectID, tuple]" = OrderedDict()
         self._attached: Dict[ObjectID, shared_memory.SharedMemory] = {}
-        # In-progress chunked transfers (create_pending → seal/abort)
+        # In-progress chunked transfers (create_pending → seal/abort):
+        # object_id -> (shm, nbytes, num_frames, reserved_at)
         self._pending: Dict[ObjectID, tuple] = {}
+        self._pending_ttl = float(pending_ttl_s) or self.PENDING_TTL_S
         self._spill_dir = spill_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "rt_spill"
         )
@@ -261,6 +274,10 @@ class SharedMemoryStore:
         header = _struct.pack("<I", 0) + b"".join(
             _struct.pack("<Q", s) for s in frame_sizes)
         nbytes = len(header) + sum(frame_sizes)
+        # Reclaim abandoned reservations FIRST: a crashed puller's
+        # leftover must neither hold capacity against this transfer nor
+        # squat the segment name it happens to share.
+        self.sweep_pending()
         with self._lock:
             if object_id in self._pending:
                 # A transfer of this object is already in flight in THIS
@@ -277,12 +294,20 @@ class SharedMemoryStore:
             # Reserve now: concurrent pending transfers must see each
             # other's bytes or the store overcommits its capacity.
             self._used += nbytes
-            self._pending[object_id] = (shm, nbytes, len(frame_sizes))
+            self._pending[object_id] = (shm, nbytes, len(frame_sizes),
+                                        time.monotonic())
         shm.buf[4:len(header)] = header[4:]
         return memoryview(shm.buf)[len(header):]
 
-    def seal(self, object_id: ObjectID) -> None:
+    def seal(self, object_id: ObjectID, view=None) -> None:
         """Publish a pending segment: the frame count lands LAST.
+
+        ``view`` (the payload view ``create_pending`` returned) lets a
+        writer prove the entry is still ITS OWN: a puller that stalled
+        past the TTL may find its reservation swept — and possibly
+        re-created by a retrying writer. Sealing the NEW writer's
+        half-written segment would publish torn bytes, so a mismatched
+        (or missing) entry raises instead; the caller re-pulls.
 
         Plain Python stores publish-after-write — like the pure-Python
         ``pack_frames_into`` path, ordering is guaranteed on TSO
@@ -291,22 +316,70 @@ class SharedMemoryStore:
         import struct as _struct
 
         with self._lock:
-            shm, n, num_frames = self._pending.pop(object_id)
+            ent = self._pending.get(object_id)
+            if ent is None:
+                raise RuntimeError(
+                    f"pending transfer for {object_id} was swept "
+                    f"(TTL) or aborted before seal; retry the pull")
+            shm, n, num_frames, _t = ent
+            if view is not None and view.obj is not shm._mmap:
+                raise RuntimeError(
+                    f"pending transfer for {object_id} was swept and "
+                    f"re-created by another writer; this writer's "
+                    f"bytes are gone — retry the pull")
+            del self._pending[object_id]
             shm.buf[:4] = _struct.pack("<I", num_frames)
             self._owned[object_id] = (shm, n, None)
+
+    def sweep_pending(self, ttl_s: Optional[float] = None,
+                      now: Optional[float] = None) -> int:
+        """Abort pending reservations older than the TTL (crashed or
+        wedged pullers that never reached seal/abort): their reserved
+        bytes return to the capacity budget and their count-0 segments
+        unlink so a new writer can claim the name. Returns how many
+        were reclaimed. Runs opportunistically on every
+        ``create_pending`` (the lease clock needs no dedicated thread);
+        ``ttl_s``/``now`` exist for tests."""
+        ttl = self._pending_ttl if ttl_s is None else float(ttl_s)
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            expired = [oid for oid, ent in self._pending.items()
+                       if now - ent[3] > ttl]
+        # The abort happens outside the scan lock, so the entry may have
+        # been aborted by its own writer and re-created by a NEW one in
+        # between — ``stamped_before`` makes abort_pending re-check the
+        # expiry under ITS lock (a fresh reservation carries a fresh
+        # stamp) instead of tearing down the new writer's segment.
+        return sum(1 for oid in expired
+                   if self.abort_pending(oid, stamped_before=now - ttl))
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
 
     def clear_stale_segment(self, object_id: ObjectID) -> bool:
         """Unlink a half-written (count-0) segment left by a crashed
         transfer so a new writer can claim the name."""
         return self._clear_if_stale(self._name(object_id))
 
-    def abort_pending(self, object_id: ObjectID) -> None:
-        """Drop a pending segment after a failed transfer."""
+    def abort_pending(self, object_id: ObjectID, view=None,
+                      stamped_before: Optional[float] = None) -> bool:
+        """Drop a pending segment after a failed transfer. ``view``
+        (see :meth:`seal`) guards the swept-and-re-created race: a
+        stale writer's abort must not tear down the NEW writer's
+        reservation. ``stamped_before`` is the sweeper's equivalent
+        guard — only an entry reserved before that monotonic instant is
+        aborted. Returns True if an entry was actually dropped."""
         with self._lock:
-            ent = self._pending.pop(object_id, None)
+            ent = self._pending.get(object_id)
             if ent is None:
-                return
-            shm, n, _ = ent
+                return False
+            if view is not None and view.obj is not ent[0]._mmap:
+                return False    # someone else's reservation now
+            if stamped_before is not None and ent[3] >= stamped_before:
+                return False    # re-created after the sweep scan
+            del self._pending[object_id]
+            shm, n = ent[0], ent[1]
             self._used -= n
         # Unlink FIRST (independent of open mappings): close() raises
         # BufferError while the writer's aborted view is still alive,
@@ -328,6 +401,7 @@ class SharedMemoryStore:
             shm.close()
         except BufferError:
             pass  # writer's view still alive; fd goes with the process
+        return True
 
     @staticmethod
     def _safe_unpack(buf) -> Optional[List[memoryview]]:

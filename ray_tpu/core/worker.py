@@ -1250,9 +1250,11 @@ class CoreWorker:
                 fetch(i, off)
                 for i, off in enumerate(range(0, total, chunk))))
         except BaseException:
-            self.shm_store.abort_pending(ref.object_id)
+            # view-guarded: if our reservation was TTL-swept and a
+            # retrying writer re-created it, leave THEIRS alone.
+            self.shm_store.abort_pending(ref.object_id, view=dview)
             raise
-        self.shm_store.seal(ref.object_id)
+        self.shm_store.seal(ref.object_id, view=dview)
         self.memory_store.put(ref.object_id, None)  # marker: lives in shm
         self._register_object_copy(ref.object_id, frame_sizes)
         return self.shm_store.get(ref.object_id)

@@ -114,6 +114,18 @@ positions, whose garbage K/V is overwritten before it is ever attended
 prompt right-padding). Everything is traced with chunk-static shapes:
 one verify program per (pool shape, k) on top of the usual
 ``len(prompt_buckets) + 1``, for any acceptance pattern.
+
+KV handoff (ISSUE 14): disaggregated prefill/decode ships a prefilled
+slot between engines. :func:`export_slot_kv` / :func:`export_slot_kv_paged`
+extract one slot's K/V into contiguous ship order (the host trims to the
+true ``pos`` — pad/stale garbage never crosses the wire, so the shipped
+bytes are identical whichever pool mode produced them), and
+:func:`import_slot_kv` / :func:`import_slot_kv_paged` scatter a
+host-padded ship buffer into a target pool's flat row or mapped pages
+and set the slot's ``pos``. Slot index, page table, and length are all
+traced: the whole handoff plane adds exactly TWO compiled programs per
+engine (one export, one import) on top of the usual set, for any
+prompt length and any flat/paged pairing.
 """
 from __future__ import annotations
 
@@ -1049,6 +1061,123 @@ def verify_chunk_slots_paged(params: Params, cache: Cache,
                                           temperature, k)
     pos2 = pos + (1 + n_acc) * active.astype(jnp.int32)
     return committed, n_acc, {"k": k_new, "v": v_new, "pos": pos2}, rngs
+
+
+# ------------------------------------------------------- KV handoff (ship)
+def export_slot_kv(cache: Cache, slot: jax.Array, *, cfg: GPTConfig
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Extract one slot's K/V rows from a FLAT pool for a prefill →
+    decode handoff (ISSUE 14): ``(k, v)`` each ``[L, max_len, H, hd]``.
+
+    ``slot`` is traced, so ONE compiled program serves every slot; the
+    host trims the returned rows to the slot's true ``pos`` before
+    shipping (positions past ``pos`` hold pad/stale garbage that the
+    attention mask never read — shipping them would make the digest
+    depend on pool history). The cache is NOT donated: the exporting
+    engine keeps serving out of it."""
+    L, B, M, H, hd = cache["k"].shape
+    k = lax.dynamic_slice(cache["k"], (0, slot, 0, 0, 0),
+                          (L, 1, M, H, hd))[:, 0]
+    v = lax.dynamic_slice(cache["v"], (0, slot, 0, 0, 0),
+                          (L, 1, M, H, hd))[:, 0]
+    return k, v
+
+
+def export_slot_kv_paged(cache: Cache, pt_row: jax.Array, *,
+                         cfg: GPTConfig, page_size: int
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Paged twin of :func:`export_slot_kv`: gather the slot's pages
+    through its page-table row into virtual order — ``(k, v)`` each
+    ``[L, max_pages * page_size, H, hd]``. Sentinel entries clip to a
+    real page whose garbage sits past ``pos`` and is trimmed by the
+    host before shipping, exactly like flat pad positions. The
+    page-table CONTENTS are traced data: one program per pool shape."""
+    L = cache["k"].shape[0]
+    n_pages = cache["k"].shape[1]
+    H, hd = cfg.n_head, cfg.head_dim
+    max_pages = pt_row.shape[0]
+    V = max_pages * page_size
+    ptc = jnp.clip(pt_row, 0, n_pages - 1)
+    k = cache["k"][:, ptc].reshape(L, V, H, hd)
+    v = cache["v"][:, ptc].reshape(L, V, H, hd)
+    return k, v
+
+
+def import_slot_kv(cache: Cache, k_row: jax.Array, v_row: jax.Array,
+                   slot: jax.Array, length: jax.Array, *, cfg: GPTConfig
+                   ) -> Cache:
+    """Scatter a shipped prefill's K/V into slot ``slot`` of a FLAT
+    pool and set its ``pos`` to ``length`` (the inverse of
+    :func:`export_slot_kv`). ``k_row``/``v_row`` are ``[L, max_len, H,
+    hd]`` — the host pads the trimmed ship buffer back out to the
+    TARGET pool's length, so ONE compiled program serves every handoff
+    regardless of prompt length. Positions past ``length`` land as
+    zeros, which is exactly the flat prefill's pad discipline: decode
+    overwrites position ``pos`` before attention ever reads ``<= pos``.
+    """
+    kp = lax.dynamic_update_slice(cache["k"], k_row[:, None],
+                                  (0, slot, 0, 0, 0))
+    vp = lax.dynamic_update_slice(cache["v"], v_row[:, None],
+                                  (0, slot, 0, 0, 0))
+    pos = lax.dynamic_update_slice(cache["pos"],
+                                   jnp.reshape(length, (1,)), (slot,))
+    return {"k": kp, "v": vp, "pos": pos}
+
+
+def import_slot_kv_paged(cache: Cache, k_pages: jax.Array,
+                         v_pages: jax.Array, pt_row: jax.Array,
+                         slot: jax.Array, length: jax.Array, *,
+                         cfg: GPTConfig, page_size: int) -> Cache:
+    """Paged twin of :func:`import_slot_kv`: scatter shipped K/V into
+    the pool pages mapped by ``pt_row``. ``k_pages``/``v_pages`` are
+    ``[L, max_pages, page_size, H, hd]`` (host-padded to the full table
+    width — one program per pool shape); pages the host never mapped
+    (``pt_row`` sentinel, or wholly past ``length``) are DROPPED, never
+    clamped into another slot's page — the same write discipline as
+    every other paged scatter in this module."""
+    n_pages = cache["k"].shape[1]
+    max_pages = pt_row.shape[0]
+    ar = jnp.arange(max_pages)
+    ok = (ar * page_size < length) & (pt_row < n_pages)
+    page_w = jnp.where(ok, pt_row, jnp.int32(PT_SENTINEL))
+    kp = cache["k"].at[:, page_w].set(k_pages, mode="drop")
+    vp = cache["v"].at[:, page_w].set(v_pages, mode="drop")
+    pos = lax.dynamic_update_slice(cache["pos"],
+                                   jnp.reshape(length, (1,)), (slot,))
+    return {"k": kp, "v": vp, "pos": pos}
+
+
+@functools.lru_cache(maxsize=64)
+def jit_export_slot_kv(cfg: GPTConfig):
+    """Jitted :func:`export_slot_kv`: ONE program per flat pool shape
+    (slot index is traced). NOT donated — the exporter keeps its pool."""
+    return jax.jit(functools.partial(export_slot_kv, cfg=cfg))
+
+
+@functools.lru_cache(maxsize=64)
+def jit_export_slot_kv_paged(cfg: GPTConfig, page_size: int):
+    """Jitted :func:`export_slot_kv_paged`: ONE program per (pool
+    shape, page_size) — the page table is data. NOT donated."""
+    return jax.jit(functools.partial(export_slot_kv_paged, cfg=cfg,
+                                     page_size=page_size))
+
+
+@functools.lru_cache(maxsize=64)
+def jit_import_slot_kv(cfg: GPTConfig):
+    """Jitted :func:`import_slot_kv`: ONE program per flat pool shape
+    (slot and length are traced). Pool donated as in
+    :func:`jit_prefill_into_slot` — the importer immediately rebinds."""
+    return jax.jit(functools.partial(import_slot_kv, cfg=cfg),
+                   donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=64)
+def jit_import_slot_kv_paged(cfg: GPTConfig, page_size: int):
+    """Jitted :func:`import_slot_kv_paged`: ONE program per (pool
+    shape, page_size). Pool donated."""
+    return jax.jit(functools.partial(import_slot_kv_paged, cfg=cfg,
+                                     page_size=page_size),
+                   donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=64)

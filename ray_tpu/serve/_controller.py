@@ -97,6 +97,7 @@ class ServeController:
             if cur["config"] != cfg:
                 cur["config"] = cfg
                 cur["target"] = cfg.initial_target()
+                cur["role_targets"] = self._role_targets(cfg)
                 replicas = list(cur["replicas"].values())
                 deferred.append(lambda: [
                     self._call_quietly(r["handle"].reconfigure,
@@ -111,12 +112,59 @@ class ServeController:
             "payload": dspec["payload"],
             "config": cfg,
             "target": cfg.initial_target(),
+            # Heterogeneous role groups within ONE deployment
+            # (ISSUE 14): an ``engine: roles: {prefill: n, decode: m}``
+            # block reconciles per role — each replica is started with
+            # its role stamped into its engine config, and roles scale
+            # and drain independently.
+            "role_targets": self._role_targets(cfg),
             "version": 0,
             "replicas": {},
             "scale": {"desired": None, "since": 0.0, "last_metric": 0.0},
             "last_health": 0.0,
         }
         return deferred
+
+    @staticmethod
+    def _role_targets(cfg: DeploymentConfig) -> Optional[Dict[str, int]]:
+        eng = cfg.engine_config or {}
+        roles = eng.get("roles")
+        if eng.get("role") == "prefill":
+            # The bare spelling pins EVERY replica's engine to one
+            # role, but only a ``roles:`` group teaches the controller
+            # and router to two-hop — an all-prefill deployment would
+            # hard-fail every plain stream (engine.submit refuses on a
+            # prefill-role engine). Same trap the roles-block guard
+            # below rejects, so reject this spelling too.
+            raise ValueError(
+                "engine role 'prefill' cannot be applied "
+                "deployment-wide (no replica could decode); use "
+                "roles: {prefill: n, decode: m} for disaggregation")
+        if roles and eng.get("role"):
+            raise ValueError(
+                "engine block carries both 'role' and 'roles'; pick "
+                "one (a roles: group stamps each replica's role)")
+        if not roles:
+            return None
+        out = {}
+        for role, n in roles.items():
+            if role not in ("prefill", "decode", "both"):
+                raise ValueError(f"unknown engine role {role!r} in "
+                                 f"roles block {roles}")
+            if int(n) < 0:
+                raise ValueError(f"negative target for role {role!r}")
+            out[role] = int(n)
+        if out.get("prefill", 0) > 0 and \
+                out.get("decode", 0) + out.get("both", 0) == 0:
+            # A prefill-only fleet can never finish a stream: the
+            # router filters all traffic to decode-capable replicas
+            # the moment a prefill role exists, so every request would
+            # queue until its deadline. Reject at deploy time.
+            raise ValueError(
+                f"roles block {roles} has prefill replicas but no "
+                f"decode-capable ones (decode/both); streams could "
+                f"never complete")
+        return out
 
     def _teardown_deployment(self, dstate: dict):
         with self._reconcile_lock:
@@ -190,7 +238,17 @@ class ServeController:
                     # rid -> node_id, for locality-preferring routing
                     # (reference: pow_2_scheduler prefer_local_node).
                     "replica_nodes": {rid: r.get("node_id")
-                                      for rid, r in d["replicas"].items()}}
+                                      for rid, r in d["replicas"].items()},
+                    # Disaggregation role groups (ISSUE 14): routers
+                    # two-hop generation across prefill/decode groups.
+                    "replica_roles": {rid: r.get("role") or "both"
+                                      for rid, r in
+                                      d["replicas"].items()},
+                    # Replicas mid-graceful-drain: routers must keep
+                    # them OUT of the pick set until this list clears
+                    # (a drain pushback mark must not self-expire).
+                    "draining": [rid for rid, r in d["replicas"].items()
+                                 if r.get("draining")]}
 
     def get_routes(self) -> Dict[str, dict]:
         with self._lock:
@@ -214,11 +272,28 @@ class ServeController:
                 deps = {}
                 for dname, d in app["deployments"].items():
                     n_healthy = len(d["replicas"])
+                    role_targets = d.get("role_targets")
+                    if role_targets:
+                        # Role-split deployments (ISSUE 14): the fleet
+                        # target is the SUM over role groups, and the
+                        # deployment is healthy only when EVERY group
+                        # meets its own target — one surviving prefill
+                        # replica serves nothing if both decode
+                        # replicas are gone.
+                        role_counts: Dict[str, int] = {}
+                        for r in d["replicas"].values():
+                            rr = r.get("role") or "both"
+                            role_counts[rr] = role_counts.get(rr, 0) + 1
+                        target = sum(role_targets.values())
+                        healthy = all(role_counts.get(role, 0) >= n
+                                      for role, n in role_targets.items())
+                    else:
+                        target = d["target"]
+                        healthy = n_healthy >= target
                     deps[dname] = {
-                        "status": ("HEALTHY" if n_healthy >= d["target"]
-                                   else "UPDATING"),
+                        "status": "HEALTHY" if healthy else "UPDATING",
                         "replicas": n_healthy,
-                        "target": d["target"],
+                        "target": target,
                         # Shed/expired/overload visibility (collected on
                         # the health pass; see _health_check).
                         "lifecycle": dict(d.get("lifecycle") or
@@ -438,6 +513,20 @@ class ServeController:
                                     "lanes", "fallback_rounds"):
                             agg[key] = agg.get(key, 0) + int(
                                 sp.get(key, 0))
+                    ho = est.get("handoff")
+                    if ho:
+                        # Disaggregation visibility (ISSUE 14): summed
+                        # across roles, so exported ~= imported +
+                        # fallbacks + outstanding + reclaimed is
+                        # checkable from serve.status() alone.
+                        agg = engine.setdefault("handoff", {})
+                        for key in ("exported", "imported",
+                                    "import_fallbacks", "ship_bytes",
+                                    "leases_outstanding",
+                                    "leases_claimed",
+                                    "leases_reclaimed"):
+                            agg[key] = agg.get(key, 0) + int(
+                                ho.get(key, 0))
             except Exception:  # noqa: BLE001 - totals dip this round
                 pass
         d["lifecycle"] = life
@@ -480,6 +569,12 @@ class ServeController:
         ac: Optional[AutoscalingConfig] = d["config"].autoscaling_config
         if ac is None:
             return
+        if d.get("role_targets"):
+            # Role groups scale declaratively (the roles block IS the
+            # target per role); a single ongoing-requests signal cannot
+            # apportion replicas between compute-bound prefill and
+            # bandwidth-bound decode.
+            return
         if time.time() - d["scale"]["last_metric"] < ac.metrics_interval_s:
             return
         d["scale"]["last_metric"] = time.time()
@@ -511,14 +606,53 @@ class ServeController:
             sc["desired"] = None
 
     def _scale_to_target(self, app_name: str, dname: str, d: dict):
+        with self._lock:
+            role_targets = d.get("role_targets")
+        self._reap_stray_roles(dname, d, role_targets)
+        if role_targets:
+            # Heterogeneous role groups (ISSUE 14): each role
+            # reconciles against ITS target — prefill and decode scale
+            # and drain independently inside one deployment.
+            for role, target in role_targets.items():
+                self._scale_role(app_name, dname, d, role, target)
+            return
+        self._scale_role(app_name, dname, d, None, None)
+
+    def _reap_stray_roles(self, dname: str, d: dict,
+                          role_targets: Optional[Dict[str, int]]):
+        """Drain replicas whose stamped role matches no current role
+        group (a redeploy added, removed, or reshaped the ``roles:``
+        block): without this, a plain replica would sit outside every
+        per-role count forever, and a role-stamped leftover under a
+        plain target would keep rejecting the traffic routed to it —
+        its engine role cannot be changed live."""
+        with self._lock:
+            valid = set(role_targets) if role_targets else {None}
+            stray = {rid: r for rid, r in d["replicas"].items()
+                     if r.get("role") not in valid}
+            if not stray:
+                return
+            for rid in stray:
+                d["replicas"].pop(rid, None)
+            d["version"] += 1
+            cfg = d["config"]
+        self._drain_and_kill(list(stray.values()),
+                             cfg.graceful_shutdown_timeout_s, dname)
+
+    def _scale_role(self, app_name: str, dname: str, d: dict,
+                    role: Optional[str], target: Optional[int]):
         from .. import api as rt
 
         with self._lock:
-            have = len(d["replicas"])
-            target = d["target"]
+            members = {rid: r for rid, r in d["replicas"].items()
+                       if role is None or (r.get("role") or "both")
+                       == role}
+            have = len(members)
+            if target is None:
+                target = d["target"]
             cfg = d["config"]
         if have < target:
-            new = [self._start_replica(app_name, dname, d)
+            new = [self._start_replica(app_name, dname, d, role=role)
                    for _ in range(target - have)]
             ok = []
             for rid, handle in new:
@@ -537,11 +671,12 @@ class ServeController:
                     for rid, handle, node_id in ok:
                         d["replicas"][rid] = {"handle": handle,
                                               "node_id": node_id,
+                                              "role": role,
                                               "created": time.time()}
                     d["version"] += 1
         elif have > target:
             with self._lock:
-                victims = sorted(d["replicas"].items(),
+                victims = sorted(members.items(),
                                  key=lambda kv: kv[1]["created"],
                                  reverse=True)[:have - target]
                 for rid, _ in victims:
@@ -550,7 +685,64 @@ class ServeController:
             self._drain_and_kill([r for _rid, r in victims],
                                  cfg.graceful_shutdown_timeout_s, dname)
 
-    def _start_replica(self, app_name: str, dname: str, d: dict):
+    def drain_role(self, app_name: str, deployment_name: str, role: str,
+                   remove: bool = True,
+                   timeout_s: Optional[float] = None) -> list:
+        """Drain ONE role group of a disaggregated deployment
+        independently of the others (ISSUE 14): its replicas are marked
+        draining (``get_replicas`` lists them, so routers pin them out
+        of the pick set — no self-expiring mark), their engines drain
+        gracefully, and with ``remove=True`` they are torn down and the
+        role's target zeroed so the reconcile loop does not respawn
+        them. Returns the drained replica ids."""
+        with self._reconcile_lock:
+            with self._lock:
+                app = self._apps.get(app_name)
+                d = (app or {"deployments": {}})["deployments"] \
+                    .get(deployment_name)
+                if d is None:
+                    return []
+                victims = {rid: r for rid, r in d["replicas"].items()
+                           if (r.get("role") or "both") == role}
+                for r in victims.values():
+                    r["draining"] = True
+                d["version"] += 1
+                cfg = d["config"]
+            budget = cfg.graceful_shutdown_timeout_s \
+                if timeout_s is None else float(timeout_s)
+            if not victims:
+                return []
+            if not remove:
+                # Mark-and-drain only: replicas stay listed (as
+                # draining) so routers hold their marks; the caller
+                # removes them later (or redeploys).
+                from .. import api as rt
+
+                refs = []
+                for r in victims.values():
+                    try:
+                        refs.append(r["handle"].drain.remote(budget))
+                    except Exception:  # noqa: BLE001 - already dead
+                        pass
+                if refs:
+                    try:
+                        rt.wait(refs, num_returns=len(refs),
+                                timeout=budget + 2)
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
+                return sorted(victims)
+            with self._lock:
+                for rid in victims:
+                    d["replicas"].pop(rid, None)
+                if d.get("role_targets"):
+                    d["role_targets"][role] = 0
+                d["version"] += 1
+            self._drain_and_kill(list(victims.values()), budget,
+                                 deployment_name)
+            return sorted(victims)
+
+    def _start_replica(self, app_name: str, dname: str, d: dict,
+                       role: Optional[str] = None):
         from .. import api as rt
         from ._replica import Replica
 
@@ -565,6 +757,13 @@ class ServeController:
         opts.setdefault("scheduling_strategy", "SPREAD")
         actor_cls = rt.remote(Replica).options(
             max_concurrency=cfg.max_ongoing_requests + 4, **opts)
+        # Role stamping (ISSUE 14): the replica sees its OWN role in
+        # the engine block; the deployment-level ``roles:`` group
+        # sizing is controller state and never reaches the engine.
+        engine_config = dict(getattr(cfg, "engine_config", None) or {})
+        engine_config.pop("roles", None)
+        if role:
+            engine_config["role"] = role
         # The replica enforces max_ongoing_requests itself: client-side
         # admission undercounts when several routers share one replica,
         # so the server gate (typed ReplicaOverloadedError pushback) is
@@ -572,7 +771,7 @@ class ServeController:
         handle = actor_cls.remote(app_name, dname, rid, d["payload"],
                                   cfg.user_config,
                                   cfg.max_ongoing_requests,
-                                  getattr(cfg, "engine_config", None))
+                                  engine_config or None)
         return rid, handle
 
     # ------------------------------------------------------------- proxies

@@ -18,10 +18,11 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ..util import tracing
-from .request import (RESUME_FROM_KEY, SUBMITTED_AT_KEY, TRACE_CTX_KEY,
-                      ReplicaDrainingError, ReplicaOverloadedError,
-                      RequestDeadlineExceeded, _request_deadline,
-                      _request_deployment, _request_resume_from,
+from .request import (HANDOFF_KEY, RESUME_FROM_KEY, SUBMITTED_AT_KEY,
+                      TRACE_CTX_KEY, ReplicaDrainingError,
+                      ReplicaOverloadedError, RequestDeadlineExceeded,
+                      _request_deadline, _request_deployment,
+                      _request_handoff, _request_resume_from,
                       deadline_expired)
 
 #: Bound on the fault-injection invocation log (test hook, see below).
@@ -178,6 +179,10 @@ class Replica:
             token = _request_model_id.set(ctx["multiplexed_model_id"])
         dl_token = _request_deadline.set(deadline)
         dep_token = _request_deployment.set(self.deployment_name)
+        # Prefill hop of a disaggregated dispatch (ISSUE 14): the
+        # continuous-batching wrapper answers with a leased handoff
+        # descriptor instead of a stream.
+        ho_token = _request_handoff.set((ctx or {}).get(HANDOFF_KEY))
         try:
             self._pre_invoke(method_name, deadline)
             if inspect.isfunction(self._user) or inspect.isbuiltin(self._user):
@@ -199,6 +204,7 @@ class Replica:
                     out = asyncio.run(out)
             return out
         finally:
+            _request_handoff.reset(ho_token)
             _request_deployment.reset(dep_token)
             _request_deadline.reset(dl_token)
             if token is not None:
@@ -244,6 +250,11 @@ class Replica:
         dl_token = _request_deadline.set(deadline)
         dep_token = _request_deployment.set(self.deployment_name)
         rf_token = _request_resume_from.set(resume_from)
+        # Decode hop of a disaggregated dispatch (ISSUE 14): the
+        # continuous-batching wrapper imports the shipped KV instead of
+        # prefilling locally (or falls back to a local prefill when the
+        # payload is gone/corrupt — token-identical by determinism).
+        ho_token = _request_handoff.set((ctx or {}).get(HANDOFF_KEY))
         try:
             self._pre_invoke(method_name, deadline)
             # user_code stage span covers the ITERATION of the handler
@@ -278,6 +289,7 @@ class Replica:
                 else:
                     yield from items
         finally:
+            _request_handoff.reset(ho_token)
             _request_resume_from.reset(rf_token)
             _request_deployment.reset(dep_token)
             _request_deadline.reset(dl_token)
@@ -430,6 +442,16 @@ class Replica:
         except Exception:  # noqa: BLE001 - metrics stay useful without it
             pass
         return out
+
+    def claim_handoff(self, lease_id: str, epoch: int) -> bool:
+        """Release one handoff lease on this (prefill) replica's
+        engines — the decode side imported the shipped KV, so the pin
+        on the shipped object may drop before the lease expires. Fired
+        by the router after the decode hop's first item; an unknown or
+        already-swept lease returns False, which is fine (the importer
+        holds its bytes)."""
+        return any(eng.claim_handoff(lease_id, epoch)
+                   for eng in self._engines())
 
     def inject_engine_fault(self, kind: str = "driver_die",
                             at_tokens: int = 0,

@@ -76,7 +76,8 @@ class Deployment:
                 user_config: Any = None,
                 health_check_period_s: Optional[float] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None,
-                ray_actor_options: Optional[dict] = None) -> "Deployment":
+                ray_actor_options: Optional[dict] = None,
+                engine_config: Optional[dict] = None) -> "Deployment":
         cfg = self.config
         updates: Dict[str, Any] = {}
         if num_replicas is not None:
@@ -97,6 +98,8 @@ class Deployment:
             updates["graceful_shutdown_timeout_s"] = graceful_shutdown_timeout_s
         if ray_actor_options is not None:
             updates["ray_actor_options"] = ray_actor_options
+        if engine_config is not None:
+            updates["engine_config"] = dict(engine_config)
         return Deployment(self.func_or_class, name or self.name,
                           replace(cfg, **updates))
 
@@ -126,7 +129,8 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
                user_config: Any = None,
                health_check_period_s: Optional[float] = None,
                graceful_shutdown_timeout_s: Optional[float] = None,
-               ray_actor_options: Optional[dict] = None):
+               ray_actor_options: Optional[dict] = None,
+               engine_config: Optional[dict] = None):
     """``@serve.deployment`` decorator (reference: ``serve/api.py:248``).
 
     ``num_replicas="auto"`` enables autoscaling with default bounds, like the
@@ -187,6 +191,11 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if engine_config is not None:
+            # Decode-engine block (paged-KV / spec-decode knobs, and
+            # the ISSUE 14 disaggregation ``roles:`` group sizing) —
+            # the decorator twin of the schema's ``engine:`` block.
+            cfg.engine_config = dict(engine_config)
         return Deployment(obj, name or obj.__name__, cfg)
 
     if _func_or_class is not None and callable(_func_or_class):

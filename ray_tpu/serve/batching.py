@@ -45,7 +45,7 @@ from typing import Any, Callable, List, Optional, Sequence
 from ..util import tracing
 from .request import (RequestDeadlineExceeded, deadline_expired,
                       get_request_deadline, get_request_deployment,
-                      get_request_resume_from)
+                      get_request_handoff, get_request_resume_from)
 
 
 def default_buckets(max_batch_size: int) -> List[int]:
@@ -500,6 +500,25 @@ def _decorate_continuous(fn, page_size: Optional[int] = None,
                                 draft_k=draft_k,
                                 spec_threshold=spec_threshold)
             configured.add(engine)
+        # Disaggregated dispatch (ISSUE 14), stamped by the router's
+        # two-hop routing: the prefill hop answers with a leased
+        # handoff descriptor (unary), the decode hop imports one
+        # instead of prefilling locally. The handler's submit kwargs
+        # stay authoritative for WHAT to generate; the hop marker only
+        # picks the engine entry point.
+        hop = get_request_handoff()
+        if hop == "export":
+            return engine.handoff(
+                kw["prompt"], kw["max_new"],
+                seed=int(kw.get("seed", 0)),
+                deadline_s=get_request_deadline(),
+                trace_ctx=tracing.current_context())
+        if isinstance(hop, dict):
+            lane = engine.admit_prefilled(
+                hop, deadline_s=get_request_deadline(),
+                trace_ctx=tracing.current_context(),
+                resume_from=get_request_resume_from())
+            return _EngineStream(lane)
         # Mid-stream failover replay token: a resumed request (its first
         # replica died after delivering n tokens) replays the SAME
         # deterministic generation here with the delivered prefix

@@ -131,6 +131,30 @@ stays one weight sweep. Wired through the config plane as
 ``@serve.batch(continuous=True, spec_decode=.., draft_k=..)`` and the
 deployment schema's ``engine:`` block; A/B'd in
 ``benchmarks/serve_gpt.py --spec``.
+
+**Disaggregated prefill/decode** (ISSUE 14 tentpole, ``role=..``):
+prefill is compute-bound and bursty, decode bandwidth-bound and steady
+— colocated they fight for the one driver dispatch slot and prefill
+bursts inflate decode TPOT. ``role="prefill"`` turns an engine into a
+prefill-only front: :meth:`handoff` runs the prompt into a transient
+slot, samples the first token, EXPORTS the slot's K/V into a contiguous
+ship buffer (:func:`~ray_tpu.models.gpt_decode.export_slot_kv`, paged
+twin included; trimmed to the true prompt length so the bytes are
+identical whichever pool mode produced them), frees the slot
+immediately — no slot-pool steady state — and returns a descriptor
+under an epoch-stamped **lease** (:mod:`~.handoff`). ``role="decode"``
+engines own the slot pools: :meth:`admit_prefilled` resolves the
+descriptor (inline or an object-plane chunked pull), BYTE-VERIFIES the
+shipped pages against the stamped digest, and imports them into a free
+slot/pages (:func:`~ray_tpu.models.gpt_decode.import_slot_kv`), so the
+first decode chunk continues bit-exactly where the prefill engine
+stopped. Every failure mode degrades to a cheap re-prefill, never a
+broken stream: a missing/corrupt payload falls back to a local prefill
+from the descriptor's prompt+seed (token-identical by determinism); a
+decode side that never claims lets the lease expire, and the prefill
+driver's sweep reclaims the shipped pages — a crash can never pin the
+pool. The handoff plane adds exactly TWO compiled programs per engine
+(export + import); ``role="both"`` (the default) serves all paths.
 """
 from __future__ import annotations
 
@@ -157,6 +181,19 @@ def default_prompt_buckets(max_len: int) -> List[int]:
         or [max_len]
 
 
+def _node_id():
+    """This process's node id (handoff locality hint), or None outside
+    a running runtime."""
+    try:
+        from ..core.worker import CoreWorker
+
+        core = CoreWorker._current
+        return getattr(core, "node_id", None) if core is not None \
+            else None
+    except Exception:  # noqa: BLE001 - no runtime in this process
+        return None
+
+
 @dataclass
 class _EngineRequest:
     """One queued admission: everything the driver needs to prefill a
@@ -174,6 +211,17 @@ class _EngineRequest:
     #: replay regenerates them (identical — the per-request PRNG lane
     #: is deterministic) and suppresses this many from the stream.
     skip: int = 0
+    #: Prefill-role handoff export: admit = prefill + export + free,
+    #: the lane receives ONE item (the handoff descriptor), no slot
+    #: steady state.
+    export: bool = False
+    #: Decode-role import: a verified handoff payload whose K/V is
+    #: scattered into the slot instead of prefilling. Kept on the
+    #: request so a recompute preemption re-imports (cheaper than a
+    #: re-prefill, identical by construction).
+    handoff: Optional[dict] = None
+    #: Export-side lease TTL override (0 = the engine's default).
+    ttl_s: float = 0.0
 
 
 @dataclass
@@ -379,9 +427,11 @@ class DecodeEngine:
                  wedge_timeout_s: float = 30.0,
                  max_driver_restarts: int = 1,
                  spec_decode=None, draft_k: int = 4,
-                 spec_threshold: float = 0.0):
+                 spec_threshold: float = 0.0,
+                 role: str = "both", handoff_ttl_s: float = 30.0):
         from ..models import gpt_decode
         from .draft import make_drafter
+        from .handoff import LeaseTable
 
         self.params = params
         self.cfg = cfg
@@ -406,6 +456,16 @@ class DecodeEngine:
                 f"length {self.max_len}")
         self.prompt_buckets = buckets
         self._gd = gpt_decode
+        # ---- disaggregation role (ISSUE 14): "prefill" engines only
+        # export handoffs (no slot-pool steady state), "decode" engines
+        # additionally import them; "both" serves every path. The lease
+        # table exists for every role — ensure_role may flip a fresh
+        # engine before traffic, and an empty table costs nothing.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}; expected "
+                             f"'prefill', 'decode', or 'both'")
+        self.role = role
+        self._leases = LeaseTable(ttl_s=float(handoff_ttl_s))
         # ---- speculative decoding (ISSUE 9): an optional drafter turns
         # the dispatch loop into draft -> verify; draft_k is the
         # chunk-static proposal width (one verify program per value).
@@ -469,7 +529,10 @@ class DecodeEngine:
                        "preempted": 0, "resumed": 0, "driver_restarts": 0,
                        "spec_rounds": 0, "spec_proposed": 0,
                        "spec_accepted": 0, "spec_fallback_rounds": 0,
-                       "spec_lanes": 0}
+                       "spec_lanes": 0,
+                       "handoffs_exported": 0, "handoffs_imported": 0,
+                       "handoff_import_fallbacks": 0,
+                       "handoff_ship_bytes": 0}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # ---- driver supervision (ISSUE 7): the driver stamps _beat at
@@ -513,6 +576,8 @@ class DecodeEngine:
                 cfg, self.temperature)
             self._step = gpt_decode.jit_decode_chunk_slots(
                 cfg, self.chunk, self.temperature, self.eos_token)
+            self._export = gpt_decode.jit_export_slot_kv(cfg)
+            self._import = gpt_decode.jit_import_slot_kv(cfg)
             self._cache = gpt_decode.init_slot_cache(cfg, self.slots,
                                                      self.max_len)
             self._bind_verify()
@@ -538,6 +603,10 @@ class DecodeEngine:
         self._step = gpt_decode.jit_decode_chunk_slots_paged(
             cfg, self.chunk, self.page_size, self.temperature,
             self.eos_token)
+        self._export = gpt_decode.jit_export_slot_kv_paged(
+            cfg, self.page_size)
+        self._import = gpt_decode.jit_import_slot_kv_paged(
+            cfg, self.page_size)
         self._cache = gpt_decode.init_paged_cache(
             cfg, self.slots, self.n_pages, self.page_size)
         self._bind_verify()
@@ -656,32 +725,88 @@ class DecodeEngine:
             self._bind_verify()
         return self
 
+    def ensure_role(self, role: Optional[str] = None,
+                    handoff_ttl_s: Optional[float] = None):
+        """Idempotently apply the disaggregation knobs from the config
+        plane (the deployment schema's ``engine: role:`` assignment —
+        the controller stamps each replica's role when reconciling a
+        ``roles:`` block). A matching engine is a no-op; a mismatched
+        engine is re-roled IF it has never admitted or exported, else
+        this raises — the role gates which queues exist, not something
+        to flip under live lanes."""
+        if role is not None and role not in ("both", "prefill",
+                                             "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        with self._admit_lock:
+            if role is not None and role != self.role:
+                with self._stats_lock:
+                    used = self._stats["admitted"] \
+                        + self._stats["handoffs_exported"]
+                if used or self._queue.qsize() or self._pending or \
+                        any(s is not None for s in self._state):
+                    raise ValueError(
+                        f"cannot change engine role ({self.role} -> "
+                        f"{role}) on a live engine; construct it with "
+                        f"the role or apply the config before traffic")
+                self.role = role
+            if handoff_ttl_s is not None:
+                self._leases.ttl_s = float(handoff_ttl_s)
+        return self
+
     #: Config-plane knob split for :meth:`apply_config`.
     _PAGE_KEYS = ("page_size", "prefix_cache", "n_pages")
     _SPEC_KEYS = ("spec_decode", "draft_k", "spec_threshold")
+    _ROLE_KEYS = ("role", "handoff_ttl_s")
 
     def apply_config(self, **knobs):
         """Route a deployment ``engine:`` config block to the right
         idempotent applier: paged-KV knobs to :meth:`ensure_paging`,
-        speculative-decoding knobs to :meth:`ensure_spec`. Unknown keys
+        speculative-decoding knobs to :meth:`ensure_spec`,
+        disaggregation knobs to :meth:`ensure_role`. Unknown keys
         raise (the schema validates too — this guards direct callers).
         """
-        unknown = set(knobs) - set(self._PAGE_KEYS) - set(self._SPEC_KEYS)
+        known = set(self._PAGE_KEYS) | set(self._SPEC_KEYS) \
+            | set(self._ROLE_KEYS)
+        unknown = set(knobs) - known
         if unknown:
             raise ValueError(
                 f"unknown engine config keys {sorted(unknown)}; known: "
-                f"{sorted(self._PAGE_KEYS + self._SPEC_KEYS)}")
+                f"{sorted(known)}")
         page = {k: v for k, v in knobs.items()
                 if k in self._PAGE_KEYS and v is not None}
         spec = {k: v for k, v in knobs.items()
                 if k in self._SPEC_KEYS and v is not None}
+        rolek = {k: v for k, v in knobs.items()
+                 if k in self._ROLE_KEYS and v is not None}
         if page:
             self.ensure_paging(**page)
         if spec:
             self.ensure_spec(**spec)
+        if rolek:
+            self.ensure_role(**rolek)
         return self
 
     # ------------------------------------------------------------- admission
+    def _validate_admission(self, prompt, max_new: int):
+        """Shared admission-time validation for every entry point that
+        prefills from a prompt (``submit`` and ``handoff``):
+        canonicalize the prompt, pick its compile bucket, and bound the
+        generation against the cache. Returns ``(prompt, bucket)``."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        S = prompt.shape[0]
+        if S < 1:
+            raise ValueError("empty prompt")
+        bucket = next((b for b in self.prompt_buckets if b >= S), None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {S} exceeds largest prompt bucket "
+                f"{self.prompt_buckets[-1]}")
+        if S + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({S}) + max_new ({max_new}) exceeds cache "
+                f"length {self.max_len}")
+        return prompt, bucket
+
     def submit(self, prompt, max_new: int, *,
                deadline_s: Optional[float] = None,
                trace_ctx: Optional[dict] = None,
@@ -697,25 +822,18 @@ class DecodeEngine:
         per-request PRNG lane is deterministic; a paged engine's prefix
         cache makes the prompt prefill near-free) and suppresses the
         first ``n`` tokens from the lane."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        S = prompt.shape[0]
-        if S < 1:
-            raise ValueError("empty prompt")
+        if self.role == "prefill":
+            raise ValueError(
+                "prefill-role engine only exports handoffs (use "
+                "handoff()); decode streams need a decode-capable "
+                "engine")
+        prompt, bucket = self._validate_admission(prompt, max_new)
         resume_from = int(resume_from)
         if resume_from < 0 or resume_from > max_new:
             raise ValueError(
                 f"resume_from {resume_from} outside [0, max_new="
                 f"{max_new}] — the replay token counts tokens this "
                 f"stream already delivered")
-        bucket = next((b for b in self.prompt_buckets if b >= S), None)
-        if bucket is None:
-            raise ValueError(
-                f"prompt length {S} exceeds largest prompt bucket "
-                f"{self.prompt_buckets[-1]}")
-        if S + max_new > self.max_len:
-            raise ValueError(
-                f"prompt ({S}) + max_new ({max_new}) exceeds cache "
-                f"length {self.max_len}")
         lane = _StreamLane()
         if max_new <= 0:
             lane.q.put((_STREAM_END, None))
@@ -744,6 +862,164 @@ class DecodeEngine:
         slices (first slice is the prefill token alone). ``close()``
         marks the lane abandoned even before the first pull."""
         return _EngineStream(self.submit(prompt, max_new, **kw))
+
+    # --------------------------------------------------- disaggregation
+    def handoff(self, prompt, max_new: int, *, seed: int = 0,
+                deadline_s: Optional[float] = None,
+                trace_ctx: Optional[dict] = None,
+                ttl_s: Optional[float] = None) -> dict:
+        """Prefill ``prompt`` into a transient slot, sample the first
+        token, EXPORT the slot's K/V, and return a leased handoff
+        descriptor (ISSUE 14). The slot frees before this returns — a
+        prefill-role engine never holds slot-pool steady state.
+
+        The descriptor carries the lease (``lease_id``/``epoch``/
+        ``expires_at``), the byte-verification ``digest``, the shipped
+        payload (inline, or an object-plane ``ref`` the decode side
+        pulls through the chunked-transfer path), and the full replay
+        identity (``prompt``/``seed``/``max_new``) — so ANY
+        decode-capable engine can either import the bytes or, if they
+        are gone, re-prefill the identical stream from scratch.
+
+        Blocks the calling thread until the driver exports (bounded by
+        ``deadline_s``); safe from any thread."""
+        if self.role == "decode":
+            raise ValueError(
+                "decode-role engine cannot export handoffs; use a "
+                "prefill or both-role engine")
+        prompt, bucket = self._validate_admission(prompt, max_new)
+        if max_new < 1:
+            raise ValueError("handoff needs max_new >= 1 (the first "
+                             "token is sampled at prefill)")
+        lane = _StreamLane()
+        with self._admit_lock:
+            if self._draining:
+                raise EngineShutdownError(
+                    "engine is not accepting requests (draining or "
+                    "shut down); resubmit on another replica")
+            self._queue.put(_EngineRequest(
+                prompt=prompt, bucket=bucket, max_new=int(max_new),
+                lane=lane, deadline_s=deadline_s, trace_ctx=trace_ctx,
+                seed=int(seed), enq_t=time.time(), export=True,
+                ttl_s=float(ttl_s or 0.0)))
+        # Synchronous drain: ONE item (the descriptor), then END. The
+        # wait is deadline-bounded so a wedged driver surfaces as the
+        # deadline error instead of a hang.
+        from .request import remaining_s
+        while True:
+            rem = remaining_s(deadline_s)
+            try:
+                kind, val = lane.q.get(
+                    timeout=rem if rem is not None else 120.0)
+            except queue.Empty:
+                lane.closed = True
+                raise RequestDeadlineExceeded(
+                    "handoff export did not complete before the "
+                    "request deadline") from None
+            if kind == "err":
+                raise val
+            if kind is _STREAM_END:
+                raise EngineShutdownError(
+                    "handoff export lane closed without a descriptor")
+            return val
+
+    def claim_handoff(self, lease_id: str, epoch: int) -> bool:
+        """Decode-side acknowledgement that a shipped payload was
+        imported: releases the lease (and the pin on the shipped
+        object) before its expiry. Unknown/stale leases return False —
+        the sweep already reclaimed them, which is also fine: the
+        claimer holds the bytes it needs. Safe from any thread."""
+        return self._leases.claim(lease_id, int(epoch))
+
+    def admit_prefilled(self, desc: dict, *,
+                        deadline_s: Optional[float] = None,
+                        trace_ctx: Optional[dict] = None,
+                        resume_from: int = 0) -> _StreamLane:
+        """Admit a handed-off stream: resolve the descriptor's payload
+        (inline, or a chunked object-plane pull), BYTE-VERIFY it, and
+        enqueue an import admission — the driver scatters the shipped
+        K/V into a free slot/pages and decoding continues bit-exactly
+        from the prefill engine's state. Any resolution or verification
+        failure degrades to a LOCAL prefill of the descriptor's
+        prompt+seed (token-identical by determinism), counted as a
+        fallback. Returns the stream lane; safe from any thread."""
+        from .handoff import HandoffError, resolve_payload, verify_payload
+        from .request import remaining_s
+
+        if self.role == "prefill":
+            raise ValueError(
+                "prefill-role engine cannot import handoffs; use a "
+                "decode or both-role engine")
+        prompt = np.asarray(desc["prompt"], np.int32).reshape(-1)
+        max_new = int(desc["max_new"])
+        seed = int(desc["seed"])
+        resume_from = int(resume_from)
+        payload = None
+        try:
+            rem = remaining_s(deadline_s)
+            payload = resolve_payload(
+                desc, timeout_s=min(rem, 30.0) if rem is not None
+                else 30.0)
+            # Cross-plane check FIRST: the descriptor's digest traveled
+            # over the RPC plane, independently of the object-plane
+            # payload — a stale or wrong payload that is internally
+            # consistent would pass verify_payload alone.
+            if desc.get("digest") and \
+                    payload.get("digest") != desc["digest"]:
+                raise HandoffError(
+                    "shipped payload digest does not match the "
+                    "descriptor's (stale or clobbered object)")
+            verify_payload(payload)
+            if int(payload["pos"]) + max_new > self.max_len:
+                raise HandoffError(
+                    f"shipped pos {payload['pos']} + max_new "
+                    f"{max_new} exceeds cache length {self.max_len}")
+            want = (self.cfg.n_layer, int(payload["pos"]),
+                    self.cfg.n_head, self.cfg.head_dim)
+            if tuple(payload["k"].shape) != want \
+                    or tuple(payload["v"].shape) != want:
+                raise HandoffError(
+                    f"shipped KV shape {tuple(payload['k'].shape)} "
+                    f"does not fit this engine's model ({want})")
+        except HandoffError:
+            payload = None
+        if payload is None:
+            # Degraded path: the bytes are gone or bad — re-prefill the
+            # SAME deterministic stream locally. Counted so the A/B and
+            # the chaos harness can see who paid what.
+            self._count(handoff_import_fallbacks=1)
+            from .._private.metrics import serve_metrics
+            serve_metrics()["prefill_fallbacks"].inc(
+                labels={"deployment": self.deployment,
+                        "where": "engine"})
+            return self.submit(prompt, max_new, seed=seed,
+                               deadline_s=deadline_s,
+                               trace_ctx=trace_ctx,
+                               resume_from=resume_from)
+        if resume_from < 0 or resume_from > max_new:
+            raise ValueError(
+                f"resume_from {resume_from} outside [0, max_new="
+                f"{max_new}]")
+        # The preemption-replay fallback needs a bucket only when the
+        # payload is lost mid-flight; an over-long prompt just pins the
+        # import path (re-import replays it fine).
+        bucket = next((b for b in self.prompt_buckets
+                       if b >= prompt.shape[0]), self.prompt_buckets[-1])
+        lane = _StreamLane()
+        with self._admit_lock:
+            if self._draining:
+                raise EngineShutdownError(
+                    "engine is not accepting requests (draining or "
+                    "shut down); resubmit on another replica")
+            self._queue.put(_EngineRequest(
+                prompt=prompt, bucket=bucket, max_new=max_new,
+                lane=lane, deadline_s=deadline_s, trace_ctx=trace_ctx,
+                seed=seed, enq_t=time.time(), skip=resume_from,
+                handoff={"payload": payload,
+                         "created_t": desc.get("created_t")}))
+        if resume_from:
+            self._count(resumed=1)
+        return lane
 
     def queue_depth(self) -> int:
         """Requests accepted but not yet admitted to a slot (submit
@@ -972,6 +1248,20 @@ class DecodeEngine:
                 "mean_accept_len": sp_a / max(sp_l, 1),
                 "accepted_per_forward": (sp_a + sp_l) / max(sp_l, 1),
             }
+        # ---- disaggregation (ISSUE 14): always surfaced — a zero
+        # block on a colocated engine is itself the signal that no
+        # handoffs happened.
+        out["role"] = self.role
+        ls = self._leases.stats()
+        out["handoff"] = {
+            "exported": out.pop("handoffs_exported"),
+            "imported": out.pop("handoffs_imported"),
+            "import_fallbacks": out.pop("handoff_import_fallbacks"),
+            "ship_bytes": out.pop("handoff_ship_bytes"),
+            "leases_outstanding": ls["outstanding"],
+            "leases_claimed": ls["claimed"],
+            "leases_reclaimed": ls["reclaimed"],
+        }
         t = self._thread
         out["driver_alive"] = bool(t is not None and t.is_alive())
         out["heartbeat_age_s"] = round(time.monotonic() - self._beat, 3)
@@ -1030,6 +1320,7 @@ class DecodeEngine:
                     break
                 self._admit_pending(epoch)
                 self._observe_queue_depth()
+                self._sweep_leases()
                 if not any(s is not None for s in self._state):
                     if self._pending:
                         # Deferred head with an empty pool and ZERO
@@ -1146,6 +1437,20 @@ class DecodeEngine:
         sm["engine_pages_free"].set(free, labels=labels)
         sm["engine_pages_used"].set(self.n_pages - free, labels=labels)
 
+    def _sweep_leases(self):  # rtlint: owner=driver
+        """Reclaim expired handoff leases once per driver loop
+        (ISSUE 14): dropping each orphan's pin frees the shipped pages
+        on the object plane, so a decode replica (or router) that died
+        between grant and claim can never pin the pool."""
+        if not len(self._leases):
+            return
+        n = self._leases.sweep()
+        if n:
+            from .._private.metrics import serve_metrics
+
+            serve_metrics()["handoff_leases_reclaimed"].inc(
+                n, labels={"deployment": self.deployment})
+
     def _observe_queue_depth(self):  # rtlint: owner=driver
         """Export the admission backlog once per driver loop (gauge
         semantics want one writer: the driver, same as the page
@@ -1233,6 +1538,8 @@ class DecodeEngine:
 
         P = req.prompt.shape[0]
         sm = serve_metrics()
+        if req.handoff is not None:
+            return self._admit_import(req, slot, sm, epoch)
         if self.paged:
             admitted = self._prefill_paged(req, slot, P, sm, jax, epoch)
             if admitted is None:
@@ -1258,12 +1565,29 @@ class DecodeEngine:
             tracing.record_span("engine.admission", req.enq_t, t_admit,
                                 parent_ctx=req.trace_ctx, slot=slot,
                                 deployment=self.deployment)
-        self._count(prefills=1, admitted=1 if req.skip == 0 else 0)
+        self._count(prefills=1,
+                    admitted=1 if (req.skip == 0 and not req.export)
+                    else 0)
         self._token[slot] = first
+        if req.export:
+            return self._finish_export(req, slot, P, pages, first, sm)
+        return self._enter_steady_state(req, slot, first, P, pages, sm)
+
+    # rtlint: owner=driver
+    def _enter_steady_state(self, req: _EngineRequest, slot: int,
+                            first: int, P: int, pages: List[int],
+                            sm) -> bool:
+        """Shared admission tail for every path that lands a first
+        token in a slot (local prefill AND handoff import): deliver or
+        replay-suppress the first token, close out single-token/EOS
+        requests, otherwise install the slot's steady state and seed
+        the drafter. The replay bookkeeping (``emitted``/``skip``)
+        must stay bit-equal between the two entry paths or a resumed
+        stream diverges by one token."""
         skip = req.skip
         if skip > 0:
             skip -= 1            # replay: the first token was delivered
-        else:                    # before the preemption
+        else:                    # before the preemption/failover
             self._count(tokens=1)
             sm["engine_tokens"].inc(
                 labels={"deployment": self.deployment})
@@ -1368,6 +1692,140 @@ class DecodeEngine:
         if prefix is not None:
             prefix.insert(req.prompt, pages)
         return first, pages, t_admit
+
+    # rtlint: owner=driver
+    def _finish_export(self, req: _EngineRequest, slot: int, P: int,
+                       pages: List[int], first: int, sm) -> bool:
+        """Handoff export tail (ISSUE 14), run right after the prefill
+        landed in the transient slot: extract the slot's K/V into ship
+        order, trim to the true prompt length on the host, free the
+        slot's pages, grant the lease, and deliver the descriptor on
+        the request's lane. The slot never enters steady state — a
+        prefill-role engine's pool is a staging area, not a residence.
+        """
+        from . import handoff as _ho
+
+        if self.paged:
+            k_dev, v_dev = self._export(self._cache, self._pt[slot])
+        else:
+            k_dev, v_dev = self._export(self._cache, np.int32(slot))
+        # Trim to pos BEFORE hashing/shipping: positions past P hold
+        # pad/stale garbage the mask never read — shipping them would
+        # make the digest depend on pool history.
+        k = np.asarray(k_dev)[:, :P].copy()
+        v = np.asarray(v_dev)[:, :P].copy()
+        rng = np.asarray(self._rngs[slot], np.uint32).copy()
+        if pages:
+            self._pool.unref(pages)
+            self._pt[slot, :] = self._gd.PT_SENTINEL
+        payload = _ho.build_payload(k=k, v=v, prompt=req.prompt, pos=P,
+                                    first=first, rng=rng, seed=req.seed,
+                                    max_new=req.max_new)
+        fields, nbytes = _ho.ship_payload(payload)
+        lease_id, expires = self._leases.grant(
+            epoch=self._epoch, pin=fields.get("ref"), nbytes=nbytes,
+            ttl_s=req.ttl_s or None)
+        desc = dict(fields)
+        desc.update({
+            "lease_id": lease_id, "epoch": self._epoch,
+            "expires_at": expires, "digest": payload["digest"],
+            "prompt": req.prompt, "pos": P, "first": first,
+            "seed": req.seed, "max_new": req.max_new,
+            "created_t": time.time(), "nbytes": nbytes,
+            "node_id": _node_id(), "deployment": self.deployment})
+        # tokens counts the sampled first token, so the chaos fault
+        # points (kill/throttle at token N) work on prefill engines.
+        self._count(handoffs_exported=1, handoff_ship_bytes=nbytes,
+                    tokens=1)
+        sm["kv_ship_bytes"].inc(
+            nbytes, labels={"deployment": self.deployment})
+        req.lane.q.put(("item", desc))
+        req.lane.q.put((_STREAM_END, None))
+        self._observe_pages(sm)
+        return True
+
+    # rtlint: owner=driver
+    def _admit_import(self, req: _EngineRequest, slot: int, sm,
+                      epoch: int = -1) -> bool:
+        """Handoff import admission (ISSUE 14): scatter the verified
+        ship buffer into a free slot (flat) or freshly mapped pages
+        (paged), restore the slot's PRNG lane and fed token, and enter
+        steady-state decode exactly where the prefill engine stopped.
+        Returns False to defer (paged mode, no pages). A recompute
+        preemption re-enqueues the request WITH its payload, so the
+        replay is a re-import, not a re-prefill."""
+        payload = req.handoff["payload"]
+        P = int(payload["pos"])
+        gd = self._gd
+        L = self.cfg.n_layer
+        H, hd = self.cfg.n_head, self.cfg.head_dim
+        dt = payload["k"].dtype
+        t_admit = time.time()
+        if self.paged:
+            ps = self.page_size
+            # ONE pool snapshot for the whole admission (see
+            # _prefill_paged): a supervisor restart must never split
+            # page accounting across two pool objects.
+            pool = self._pool
+            prefix = self._prefix
+            n_cover = -(-P // ps)
+            pages = self._alloc_pages(n_cover, pool, prefix)
+            if pages is None:
+                return False          # out of pages: defer, keep FIFO
+            pt_row = np.full((self.max_pages,), gd.PT_SENTINEL,
+                             np.int32)
+            pt_row[:len(pages)] = pages
+            self._pt[slot] = pt_row
+            k_pad = np.zeros((L, self.max_pages * ps, H, hd), dt)
+            v_pad = np.zeros((L, self.max_pages * ps, H, hd), dt)
+            k_pad[:, :P] = payload["k"]
+            v_pad[:, :P] = payload["v"]
+            cache = self._import(
+                self._cache,
+                k_pad.reshape(L, self.max_pages, ps, H, hd),
+                v_pad.reshape(L, self.max_pages, ps, H, hd),
+                pt_row, np.int32(slot), np.int32(P))
+            if epoch >= 0 and epoch != self._epoch:
+                pool.unref(pages)     # stale driver: hand pages back
+                return True
+            # Shipped pages cover the WHOLE prompt: register them so
+            # later local admissions of the same prompt prefix map the
+            # imported pages instead of re-prefilling.
+            if prefix is not None and P == req.prompt.shape[0]:
+                prefix.insert(req.prompt, pages)
+        else:
+            pages = []
+            k_pad = np.zeros((L, self.max_len, H, hd), dt)
+            v_pad = np.zeros((L, self.max_len, H, hd), dt)
+            k_pad[:, :P] = payload["k"]
+            v_pad[:, :P] = payload["v"]
+            cache = self._import(self._cache, k_pad, v_pad,
+                                 np.int32(slot), np.int32(P))
+            if epoch >= 0 and epoch != self._epoch:
+                return True           # stale driver: drop on the floor
+        self._cache = cache
+        first = int(payload["first"])
+        self._token[slot] = first
+        self._rngs[slot] = np.asarray(payload["rng"], np.uint32)
+        sm["engine_admission_wait"].observe(
+            max(t_admit - req.enq_t, 0.0),
+            labels={"deployment": self.deployment})
+        created = req.handoff.get("created_t")
+        if created:
+            # Export stamp -> successful import: THE handoff latency.
+            # Wall-clock across processes, like the deadline it rides
+            # with.
+            sm["kv_handoff"].observe(
+                max(time.time() - float(created), 0.0),
+                labels={"deployment": self.deployment})
+        if req.trace_ctx is not None:
+            tracing.record_span("engine.admission", req.enq_t, t_admit,
+                                parent_ctx=req.trace_ctx, slot=slot,
+                                imported=True,
+                                deployment=self.deployment)
+        self._count(handoffs_imported=1,
+                    admitted=1 if req.skip == 0 else 0)
+        return self._enter_steady_state(req, slot, first, P, pages, sm)
 
     def _cover_pages(self) -> bool:  # rtlint: owner=driver
         """Allocate-on-advance (paged mode, chunk boundary): every

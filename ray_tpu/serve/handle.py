@@ -37,8 +37,9 @@ from ..exceptions import (ActorDiedError, ActorUnavailableError,
                           GetTimeoutError, RayTpuError, TaskError,
                           WorkerCrashedError)
 from ..util import tracing
-from .request import (RESUME_FROM_KEY, SUBMITTED_AT_KEY, TRACE_CTX_KEY,
-                      BackPressureError, ReplicaOverloadedError,
+from .request import (HANDOFF_KEY, RESUME_FROM_KEY, SUBMITTED_AT_KEY,
+                      TRACE_CTX_KEY, BackPressureError,
+                      ReplicaDrainingError, ReplicaOverloadedError,
                       RequestDeadlineExceeded, deadline_expired,
                       get_request_deadline, make_deadline, remaining_s,
                       stream_item_width)
@@ -85,6 +86,19 @@ def _is_overload(e: Exception) -> bool:
         return True
     return (isinstance(e, TaskError)
             and getattr(e, "cause_type", "") in _PUSHBACK_CAUSES)
+
+
+def _is_draining(e: Exception) -> bool:
+    """Drain pushback specifically: unlike a saturation mark (which
+    self-expires — the replica stays a candidate), a draining replica
+    must stay OUT of the pick set until the controller stops listing it
+    as draining or membership drops it. Letting the mark self-expire
+    would bounce every re-pick off the same dying replica for the whole
+    graceful-drain window."""
+    if isinstance(e, ReplicaDrainingError):
+        return True
+    return (isinstance(e, TaskError)
+            and getattr(e, "cause_type", "") == "ReplicaDrainingError")
 
 
 def _is_deadline_error(e: Exception) -> bool:
@@ -181,6 +195,20 @@ class RetryBudget:
             return self._tokens
 
 
+def _claim_on_first(gen, claim):
+    """Pass-through over a streaming-generator's refs that fires the
+    handoff claim exactly once, on the first yielded item — the decode
+    side produced output, so the import landed and the prefill engine
+    may drop its pin before the lease expires. A stream that dies
+    before its first item never claims; the lease sweep reclaims."""
+    first = True
+    for ref in gen:
+        if first:
+            first = False
+            claim()
+        yield ref
+
+
 def _backoff_sleep(backoff_s: float, deadline_s: Optional[float]):
     """Jittered backoff, never sleeping past the request deadline.
 
@@ -273,9 +301,15 @@ class DeploymentResponse:
                         f"request to {self._router.deployment_name} "
                         f"expired before execution") from e
                 if _is_overload(e):
-                    # Typed pushback: the replica is full, not broken.
-                    # Re-pick another one; no budget spend, no mark_dead.
-                    self._router.note_overloaded(self._rid)
+                    # Typed pushback: the replica is full (or leaving),
+                    # not broken. Re-pick another one; no budget spend,
+                    # no mark_dead. Draining marks persist until the
+                    # controller confirms the drain is over; saturation
+                    # marks self-expire.
+                    if _is_draining(e):
+                        self._router.note_draining(self._rid)
+                    else:
+                        self._router.note_overloaded(self._rid)
                     _serve_counters()["overload_repicks"].inc(labels=labels)
                 elif _is_replica_failure(e):
                     self._router.mark_dead(self._rid)
@@ -416,7 +450,10 @@ class DeploymentResponseGenerator:
         if deadline_expired(self._deadline_s) or _is_deadline_error(e):
             return False
         if _is_overload(e):
-            self._router.note_overloaded(self._rid)
+            if _is_draining(e):
+                self._router.note_draining(self._rid)
+            else:
+                self._router.note_overloaded(self._rid)
             _serve_counters()["overload_repicks"].inc(labels=labels)
         elif _is_replica_failure(e):
             self._router.mark_dead(self._rid)
@@ -572,8 +609,18 @@ class Router:
         self._cond = threading.Condition()
         self._replicas: Dict[str, Any] = {}   # rid -> ActorHandle
         self._replica_nodes: Dict[str, Any] = {}  # rid -> node_id
+        self._replica_roles: Dict[str, str] = {}  # rid -> prefill|decode|both
         self._ongoing: Dict[str, int] = {}
         self._saturated: Dict[str, float] = {}  # rid -> mark expiry
+        # Draining replicas: rid -> mark expiry. A ReplicaDrainingError
+        # pushback plants a FINITE mark (it outlives the saturation
+        # mark, covering the controller-notification lag); a membership
+        # snapshot that lists the replica as draining upgrades it to
+        # INFINITE — it then clears only when the controller stops
+        # listing it or membership drops it, never by timeout
+        # (ISSUE 14 satellite: a draining prefill replica must not
+        # self-expire back into the candidate set mid-drain).
+        self._draining_marks: Dict[str, float] = {}
         self._version = -1
         # This process's node, for locality-preferring choice
         # (reference: pow_2_scheduler prefer-local-node ranking).
@@ -623,8 +670,21 @@ class Router:
         if info is None:
             raise RayTpuError(
                 f"deployment {self.app_name}/{self.deployment_name} not found")
+        self._apply_membership(info)
+
+    def _apply_membership(self, info: dict):
+        """Apply one controller membership snapshot (factored out of
+        :meth:`refresh` so the draining-mark interaction is unit-
+        testable without a live controller)."""
         with self._cond:
+            ctrl_draining = set(info.get("draining") or ())
             if info["version"] == self._version:
+                # Same version: membership unchanged, but the draining
+                # set is reported fresh on every poll — reconcile the
+                # marks against it (the ONLY way an infinite mark
+                # heals).
+                self._reconcile_draining_locked(ctrl_draining,
+                                                set(self._replicas))
                 return
             self._version = info["version"]
             self._max_ongoing = info["max_ongoing_requests"]
@@ -633,9 +693,11 @@ class Router:
             new = dict(info["replicas"])  # rid -> ActorHandle
             self._replicas = new
             self._replica_nodes = dict(info.get("replica_nodes") or {})
+            self._replica_roles = dict(info.get("replica_roles") or {})
             self._ongoing = {rid: self._ongoing.get(rid, 0) for rid in new}
             self._saturated = {rid: t for rid, t in self._saturated.items()
                                if rid in new}
+            self._reconcile_draining_locked(ctrl_draining, set(new))
             # Membership changed: drop affinity entries for dead replicas.
             for mid in list(self._model_affinity):
                 kept = self._model_affinity[mid] & set(new)
@@ -645,11 +707,54 @@ class Router:
                     del self._model_affinity[mid]
             self._cond.notify_all()
 
+    #: Floor lifetime of a LOCALLY-noted drain mark: long enough to
+    #: cover the controller-notification lag (a couple of membership
+    #: polls), after which only a controller-confirmed mark persists.
+    DRAIN_MARK_MIN_S = 3.0
+
+    def _reconcile_draining_locked(self, ctrl_draining: set,
+                                   alive: set):
+        """Merge the controller-reported draining set into the local
+        marks: confirmed marks become infinite (they heal ONLY when the
+        controller stops listing the replica), local pushback marks
+        keep their finite floor, and marks for departed replicas drop.
+        Held: ``_cond``."""
+        marks = self._draining_marks
+        now = time.monotonic()
+        for rid in list(marks):
+            if rid not in alive:
+                del marks[rid]
+            elif rid in ctrl_draining:
+                continue
+            elif marks[rid] == float("inf"):
+                del marks[rid]     # controller says the drain is over
+            elif marks[rid] <= now:
+                # Local pushback floor lapsed and the controller never
+                # confirmed the drain: drop the mark entirely (the pick
+                # filter already ignores it; leaving it would overcount
+                # stats()["draining"] forever).
+                del marks[rid]
+        for rid in ctrl_draining & alive:
+            marks[rid] = float("inf")
+
+    def note_draining(self, rid: str):
+        """Replica drain pushback: keep it out of the pick set. Unlike
+        :meth:`note_overloaded` the mark does not blindly self-expire —
+        it is reconciled against the controller's draining list on
+        every membership poll, with a finite floor only to cover the
+        notification lag."""
+        with self._cond:
+            if rid in self._replicas:
+                cur = self._draining_marks.get(rid, 0.0)
+                self._draining_marks[rid] = max(
+                    cur, time.monotonic() + self.DRAIN_MARK_MIN_S)
+
     def mark_dead(self, rid: str):
         with self._cond:
             self._replicas.pop(rid, None)
             self._ongoing.pop(rid, None)
             self._saturated.pop(rid, None)
+            self._draining_marks.pop(rid, None)
             self._last_refresh = 0.0
             self._cond.notify_all()
 
@@ -669,8 +774,8 @@ class Router:
         self._waiter_wake.set()
 
     # ----------------------------------------------------------- data plane
-    def _acquire(self, deadline_s: Optional[float], model_id: str
-                 ) -> Tuple[str, Any]:
+    def _acquire(self, deadline_s: Optional[float], model_id: str,
+                 role: str = "", prefer_node=None) -> Tuple[str, Any]:
         """Admission wait, instrumented: the elapsed time is the
         ``router.queue_wait`` stage — observed into the queue-wait
         histogram always, and recorded as a span when the request is
@@ -678,7 +783,8 @@ class Router:
         when every replica is saturated)."""
         t0_wall = time.time()
         t0 = time.perf_counter()
-        out = self._acquire_inner(deadline_s, model_id)
+        out = self._acquire_inner(deadline_s, model_id, role,
+                                  prefer_node)
         _serve_counters()["queue_wait"].observe(
             time.perf_counter() - t0,
             labels={"deployment": self.deployment_name, "where": "router"})
@@ -690,7 +796,8 @@ class Router:
                                 deployment=self.deployment_name)
         return out
 
-    def _acquire_inner(self, deadline_s: Optional[float], model_id: str
+    def _acquire_inner(self, deadline_s: Optional[float], model_id: str,
+                       role: str = "", prefer_node=None
                        ) -> Tuple[str, Any]:
         """Admission: block until a replica has an in-flight slot, with
         capped exponential backoff between controller refreshes.
@@ -706,7 +813,7 @@ class Router:
         try:
             while True:
                 with self._cond:
-                    rid = self._pick_locked(model_id)
+                    rid = self._pick_locked(model_id, role, prefer_node)
                     if rid is not None:
                         self._ongoing[rid] += 1
                         return rid, self._replicas[rid]
@@ -802,8 +909,34 @@ class Router:
         (rid, core streaming generator). Shared by first submission and
         the generator's re-routes. ``resume_from`` is the mid-stream
         replay token: the receiving replica replays the deterministic
-        stream and suppresses that many already-delivered tokens."""
-        rid, handle = self._acquire(deadline_s, model_id)
+        stream and suppresses that many already-delivered tokens.
+
+        Role-aware two-hop routing (ISSUE 14): when the deployment runs
+        disaggregated role groups, the stream dispatch becomes pick
+        prefill replica → export a leased KV handoff → pick decode
+        replica (locality-preferring) → import + decode. Every failure
+        on the prefill hop degrades to a LOCAL prefill on a decode
+        replica — token-identical by determinism — so disaggregation
+        can only ever add capacity, never a new way to break a stream.
+        A resumed stream re-enters here and re-prefills on whatever
+        survivors exist."""
+        self.refresh()   # roles ride membership; a cold router must
+        with self._cond:  # learn them BEFORE deciding the hop count
+            disagg = self._roles_active()
+            want_decode = self._prefill_present()
+        handoff = None
+        prefill_node = None
+        claim = None
+        if disagg:
+            handoff, claim, prefill_node = self._prefill_hop(
+                method_name, args, kwargs, deadline_s, model_id)
+            if handoff is None:
+                _serve_counters()["prefill_fallbacks"].inc(
+                    labels={"deployment": self.deployment_name,
+                            "where": "router"})
+        rid, handle = self._acquire(deadline_s, model_id,
+                                    role="decode" if want_decode else "",
+                                    prefer_node=prefill_node)
         ctx = self._request_ctx(deadline_s)
         if model_id:
             ctx["multiplexed_model_id"] = model_id
@@ -811,9 +944,95 @@ class Router:
             ctx["flatten_chunks"] = True
         if resume_from:
             ctx[RESUME_FROM_KEY] = int(resume_from)
+        if handoff is not None:
+            ctx[HANDOFF_KEY] = handoff
         gen = handle.handle_request_streaming.options(
             num_returns="streaming").remote(method_name, args, kwargs, ctx)
+        if claim is not None:
+            gen = _claim_on_first(gen, claim)
         return rid, gen
+
+    def _prefill_hop(self, method_name: str, args: tuple, kwargs: dict,
+                     deadline_s: Optional[float], model_id: str):
+        """Hop 1 of a disaggregated stream: a unary call to a
+        prefill-role replica whose continuous-batching wrapper answers
+        with a leased handoff descriptor. Budgeted and backoff-spaced
+        like every retry; returns ``(descriptor, claim_fn, node_id)``
+        or ``(None, None, None)`` — the caller then falls back to a
+        local prefill on a decode replica (the stream must never hang
+        on a missing prefill tier)."""
+        from .. import api as rt
+
+        attempts = 0
+        backoff = self.RETRY_BACKOFF_BASE_S
+        labels = {"deployment": self.deployment_name}
+        while attempts <= self.DEFAULT_MAX_RETRIES:
+            if deadline_expired(deadline_s):
+                return None, None, None
+            with self._cond:
+                rid = self._pick_locked(model_id, role="prefill")
+                if rid is None:
+                    # No prefill replica admits RIGHT NOW (all dead,
+                    # draining, or saturated): fall back rather than
+                    # queue — a decode replica can always prefill
+                    # locally.
+                    return None, None, None
+                self._ongoing[rid] += 1
+                handle = self._replicas[rid]
+            ctx = self._request_ctx(deadline_s)
+            if model_id:
+                ctx["multiplexed_model_id"] = model_id
+            ctx[HANDOFF_KEY] = "export"
+            try:
+                ref = handle.handle_request.remote(
+                    method_name, args, kwargs, ctx)
+                rem = remaining_s(deadline_s)
+                desc = rt.get(ref, timeout=min(rem, 30.0)
+                              if rem is not None else 30.0)
+                self.release(rid)
+                if not isinstance(desc, dict) \
+                        or "lease_id" not in desc:
+                    # Handler is not handoff-capable (no continuous
+                    # engine behind it): disable disagg for this call.
+                    return None, None, None
+
+                def claim(h=handle, d=desc):
+                    try:
+                        h.claim_handoff.remote(d["lease_id"],
+                                               d["epoch"])
+                    except Exception:  # noqa: BLE001 - lease expiry
+                        pass           # sweeps the orphan anyway
+
+                return desc, claim, desc.get("node_id")
+            except Exception as e:  # noqa: BLE001 - classified below
+                self.release(rid)
+                if _is_deadline_error(e):
+                    return None, None, None
+                if _is_draining(e):
+                    self.note_draining(rid)
+                elif _is_overload(e):
+                    self.note_overloaded(rid)
+                    _serve_counters()["overload_repicks"].inc(
+                        labels=labels)
+                elif _is_replica_failure(e):
+                    self.mark_dead(rid)
+                    attempts += 1
+                    if attempts > self.DEFAULT_MAX_RETRIES \
+                            or not self.budget.take():
+                        return None, None, None
+                    _serve_counters()["retries"].inc(labels=labels)
+                else:
+                    # Unclassified failure (wedged-but-alive replica
+                    # timing out the get, serialization trouble, a
+                    # deterministic user error...): the prefill hop is
+                    # an optimization, never a new way to break a
+                    # stream. Fall back to local prefill — a genuine
+                    # request error reproduces identically there and
+                    # surfaces through the normal stream path.
+                    return None, None, None
+                _backoff_sleep(backoff, deadline_s)
+                backoff = min(backoff * 2, self.RETRY_BACKOFF_CAP_S)
+        return None, None, None
 
     def submit_stream(self, method_name: str, args: tuple, kwargs: dict,
                       timeout_s: Optional[float] = None, model_id: str = "",
@@ -844,16 +1063,61 @@ class Router:
                 self._ongoing[rid] = max(0, self._ongoing[rid] - 1)
             self._cond.notify_all()
 
-    def _pick_locked(self, model_id: str = "") -> Optional[str]:
+    def _prefill_present(self) -> bool:
+        """True when this deployment's membership has EVER advertised a
+        prefill role group (the roles map survives individual replica
+        deaths until the next membership snapshot). While true, plain
+        traffic must keep filtering to decode-capable replicas — a
+        momentarily empty decode group (its replica just died) means
+        WAIT for the controller to respawn it, never spill decode
+        streams onto prefill-only replicas that reject them."""
+        return any(r == "prefill"
+                   for r in self._replica_roles.values())
+
+    def _roles_active(self) -> bool:
+        """True when two-hop dispatch can run RIGHT NOW: at least one
+        prefill-role replica AND one decode-capable one alive. When
+        only the prefill side survives, streams fall back to single-hop
+        — still decode-filtered via :meth:`_prefill_present`, blocking
+        in admission until decode capacity returns."""
+        roles = self._replica_roles
+        return any(roles.get(rid, "both") == "prefill"
+                   for rid in self._replicas) and \
+            any(roles.get(rid, "both") in ("decode", "both")
+                for rid in self._replicas)
+
+    def _pick_locked(self, model_id: str = "", role: str = "",
+                     prefer_node=None) -> Optional[str]:
+        now = time.monotonic()
         if self._saturated:
-            now = time.monotonic()
             for r in [r for r, t in self._saturated.items() if t <= now]:
                 del self._saturated[r]
+        draining = {r for r, t in self._draining_marks.items()
+                    if t > now}
         rids = [r for r in self._replicas
                 if self._ongoing.get(r, 0) < self._max_ongoing
-                and r not in self._saturated]
+                and r not in self._saturated and r not in draining]
+        # Role filter (ISSUE 14): an explicit role picks its group
+        # ("both" replicas serve either); with a prefill group present
+        # and no explicit role, plain traffic targets decode-capable
+        # replicas — a prefill-only engine rejects decode streams, and
+        # an EMPTY decode group must mean "wait for respawn", not
+        # "spill onto prefill replicas".
+        want = role or ("decode" if self._prefill_present() else "")
+        if want:
+            rids = [r for r in rids
+                    if self._replica_roles.get(r, "both")
+                    in (want, "both")]
         if not rids:
             return None
+        if prefer_node is not None:
+            # Handoff locality: land the decode hop on the node already
+            # holding the shipped bytes (the pull then rides shm, not
+            # the wire).
+            near = [r for r in rids
+                    if self._replica_nodes.get(r) == prefer_node]
+            if near:
+                rids = near
         if model_id:
             # Model-affinity (reference multiplex routing): prefer a
             # replica that has already served this model — its LRU cache
@@ -911,5 +1175,7 @@ class Router:
                     "ongoing": dict(self._ongoing),
                     "pending": self._pending,
                     "saturated": len(self._saturated),
+                    "draining": len(self._draining_marks),
+                    "roles": dict(self._replica_roles),
                     "retry_tokens": self.budget.tokens(),
                     "version": self._version}

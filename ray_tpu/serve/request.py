@@ -127,6 +127,13 @@ SUBMITTED_AT_KEY = "submitted_at"
 #: run. Stamped by ``DeploymentResponseGenerator`` on re-route after a
 #: mid-stream replica failure.
 RESUME_FROM_KEY = "resume_from"
+#: Disaggregated prefill/decode hop marker (ISSUE 14), stamped by the
+#: router's two-hop dispatch: the literal string ``"export"`` on the
+#: prefill hop (the continuous-batching wrapper answers with a leased
+#: handoff descriptor instead of a stream), or the descriptor dict on
+#: the decode hop (the wrapper imports it via
+#: ``engine.admit_prefilled`` instead of prefilling locally).
+HANDOFF_KEY = "handoff"
 
 
 #: Tokens already delivered to the caller of the request being handled
@@ -142,6 +149,21 @@ def get_request_resume_from() -> int:
     """Delivered-token count of the stream being resumed on this thread
     (0 outside a resumed stream)."""
     return _request_resume_from.get()
+
+
+#: Handoff hop of the request being handled on this thread: ``None``
+#: (plain colocated request), ``"export"`` (prefill hop), or the
+#: handoff descriptor dict (decode hop). Set by the replica around user
+#: code from :data:`HANDOFF_KEY`; read by the continuous-batching
+#: wrapper to pick the engine entry point.
+_request_handoff: "contextvars.ContextVar[Any]" = \
+    contextvars.ContextVar("rt_serve_request_handoff", default=None)
+
+
+def get_request_handoff() -> Any:
+    """The current request's handoff hop marker (see
+    :data:`HANDOFF_KEY`); None outside a disaggregated dispatch."""
+    return _request_handoff.get()
 
 
 def stream_item_width(item) -> int:

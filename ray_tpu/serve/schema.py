@@ -43,12 +43,19 @@ class DeploymentSchema:
     #: spec_decode: ngram, draft_k: 4}`` — paged-KV knobs plus the
     #: speculative-decoding knobs. The replica applies it to every
     #: DecodeEngine the deployment constructs (see
-    #: ``DeploymentConfig.engine_config``).
+    #: ``DeploymentConfig.engine_config``). Disaggregated
+    #: prefill/decode (ISSUE 14) rides the same block:
+    #: ``engine: {roles: {prefill: 1, decode: 2}, handoff_ttl_s: 30}``
+    #: makes the controller reconcile heterogeneous role groups within
+    #: the one deployment (each replica's engine gets its own ``role``
+    #: stamped; routers two-hop generation across the groups), while a
+    #: bare ``role:`` pins every replica to one role.
     engine: Optional[Dict[str, Any]] = None
 
     _ENGINE_KEYS = frozenset({"page_size", "prefix_cache", "n_pages",
                               "spec_decode", "draft_k",
-                              "spec_threshold"})
+                              "spec_threshold", "role", "roles",
+                              "handoff_ttl_s"})
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSchema":

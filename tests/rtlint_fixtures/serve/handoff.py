@@ -1,0 +1,52 @@
+"""RT102/RT107 fixture for the disaggregation handoff plane: the rule
+path-scopes grew ``serve/handoff.py`` (ISSUE 14) — export/import
+dispatches (``self._export`` / ``self._import``) obey the same
+driver-thread ownership as every other engine dispatch, and its control
+paths obey the serve exception hygiene. Never imported.
+"""
+
+
+def jit_export_fake(cfg):
+    def run(cache):
+        return cache
+    return run
+
+
+class FixtureHandoffEngine:
+    def __init__(self, cfg):
+        # Binding a factory result is construction, not a dispatch.
+        self._export = jit_export_fake(cfg)
+        self._import = jit_export_fake(cfg)
+
+    # rtlint: owner=driver entry=driver
+    def _run(self, cache):
+        return self._finish_export(cache)
+
+    # rtlint: owner=driver
+    def _finish_export(self, cache):
+        k = self._export(cache)
+        v = self._import(cache)
+        return k, v
+
+    def rogue_export(self, cache):
+        return self._export(cache)  # FIRES RT102
+
+    def rogue_import(self, cache):
+        return self._import(cache)  # FIRES RT102
+
+    def suppressed_probe(self, cache):
+        # rtlint: disable=RT102 test-only synchronous probe
+        return self._export(cache)
+
+    def sweep_leases(self):
+        try:
+            return len(self.__dict__)
+        # FIRES-BELOW RT107
+        except Exception:
+            pass
+
+    def sweep_leases_justified(self):
+        try:
+            return len(self.__dict__)
+        except Exception:  # noqa: BLE001 - lease sweep is best-effort
+            pass

@@ -106,6 +106,80 @@ def test_pending_seal_publishes():
     store.delete(oid)
 
 
+def test_pending_ttl_sweep_reclaims_crashed_puller():
+    """ISSUE 14 satellite regression: a puller that dies between
+    ``create_pending`` and seal/abort must not pin its reserved bytes
+    (or squat the segment name) forever — the TTL sweep, run on the
+    same lease-clock discipline as the serve handoff plane, aborts the
+    orphan: capacity returns and a new writer can claim the name."""
+    import time as _time
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import SharedMemoryStore
+
+    dom = f"pend-ttl-{os.getpid()}-{int(_time.time())}"
+    store = SharedMemoryStore(1 << 24, domain=dom)
+    oid = ObjectID.from_random()
+    frames = [b"h", b"x" * 4096]
+    view = store.create_pending(oid, [len(f) for f in frames])
+    assert view is not None
+    reserved = store.used_bytes()
+    assert reserved > 0 and store.pending_count() == 1
+    # Simulate the crash: the puller never seals, never aborts. The
+    # sweep is a no-op before the TTL...
+    assert store.sweep_pending() == 0
+    assert store.pending_count() == 1
+    # ...and reclaims after it (clock injected: no real waiting).
+    assert store.sweep_pending(
+        now=_time.monotonic() + store.PENDING_TTL_S + 1) == 1
+    assert store.pending_count() == 0
+    assert store.used_bytes() == 0, "reserved bytes leaked"
+    del view  # the crashed writer's view (kept alive above for realism)
+    # The name is free again: a fresh transfer of the same object
+    # reserves, writes, seals, and reads back.
+    view2 = store.create_pending(oid, [len(f) for f in frames])
+    assert view2 is not None, "swept segment still squats the name"
+    off = 0
+    for f in frames:
+        view2[off:off + len(f)] = f
+        off += len(f)
+    store.seal(oid)
+    got = store.get(oid)
+    assert got is not None and bytes(got[1]) == frames[1]
+    store.delete(oid)
+    # Opportunistic sweep: an expired orphan is reclaimed by the NEXT
+    # create_pending (no dedicated sweeper thread needed).
+    oid2, oid3 = ObjectID.from_random(), ObjectID.from_random()
+    assert store.create_pending(oid2, [1, 16]) is not None
+    store._pending[oid2] = store._pending[oid2][:3] + (
+        _time.monotonic() - store.PENDING_TTL_S - 1,)
+    assert store.create_pending(oid3, [1, 16]) is not None
+    assert store.pending_count() == 1          # oid2 swept, oid3 live
+    store.abort_pending(oid3)
+    # A slow-but-alive puller whose reservation was swept must get a
+    # clean typed error at seal — not a KeyError, and NEVER a torn
+    # publish of a retrying writer's half-written segment.
+    oid4 = ObjectID.from_random()
+    stale_view = store.create_pending(oid4, [1, 16])
+    assert store.sweep_pending(now=_time.monotonic()
+                               + store.PENDING_TTL_S + 1) == 1
+    with pytest.raises(RuntimeError, match="swept"):
+        store.seal(oid4, view=stale_view)
+    # A retrying writer re-creates the same object id; the STALE
+    # writer's seal/abort must not touch the new reservation.
+    fresh_view = store.create_pending(oid4, [1, 16])
+    assert fresh_view is not None
+    with pytest.raises(RuntimeError, match="another writer"):
+        store.seal(oid4, view=stale_view)
+    store.abort_pending(oid4, view=stale_view)   # guarded no-op
+    assert store.pending_count() == 1
+    fresh_view[:] = b"h" + b"y" * 16
+    store.seal(oid4, view=fresh_view)
+    got4 = store.get(oid4)
+    assert got4 is not None and bytes(got4[1]) == b"y" * 16
+    store.delete(oid4)
+
+
 def test_concurrent_same_ref_pulls(two_node_cluster):
     """Several tasks on one node consuming the SAME big remote ref: one
     transfer, every consumer gets the value (in-process pull dedup)."""

@@ -167,23 +167,27 @@ class LockGuardRule(Rule):
 # ------------------------------------------------------------------ RT102
 class DriverOwnershipRule(Rule):
     """RT102: device-dispatch calls in the decode engine (its drafters
-    — ISSUE 9 — and the offline batch-inference pipeline driver,
-    ``data/llm.py`` — ISSUE 11) must run on the driver thread.
+    — ISSUE 9 — the offline batch-inference pipeline driver,
+    ``data/llm.py`` — ISSUE 11 — and the disaggregation handoff plane,
+    ``serve/handoff.py`` — ISSUE 14) must run on the driver thread.
     Lexically: calls to the bound jit wrappers (``self._prefill`` /
-    ``self._step`` / ``self._verify`` / ``self._ingest``) or an
-    immediately-invoked ``jit_*`` factory (``jit_x(...)(...)``) are
-    only allowed inside methods annotated ``# rtlint: owner=driver``.
-    Binding a factory (``self._prefill = jit_prefill(...)``) is
-    construction, not a dispatch, and is not flagged."""
+    ``self._step`` / ``self._verify`` / ``self._ingest`` /
+    ``self._export`` / ``self._import``) or an immediately-invoked
+    ``jit_*`` factory (``jit_x(...)(...)``) are only allowed inside
+    methods annotated ``# rtlint: owner=driver``. Binding a factory
+    (``self._prefill = jit_prefill(...)``) is construction, not a
+    dispatch, and is not flagged."""
 
     id = "RT102"
     summary = "device dispatch outside a driver-annotated method"
 
-    DISPATCH_ATTRS = ("_prefill", "_step", "_verify", "_ingest")
+    DISPATCH_ATTRS = ("_prefill", "_step", "_verify", "_ingest",
+                      "_export", "_import")
 
     def applies(self, mod: Module) -> bool:
         return mod.relpath.endswith(("serve/engine.py",
                                      "serve/draft.py",
+                                     "serve/handoff.py",
                                      "data/llm.py"))
 
     def check(self, mod: Module) -> Iterable[Finding]:

@@ -72,6 +72,7 @@ DEFAULT_BASELINE = os.path.join(
 DEFAULT_MODULES = (
     "ray_tpu.serve.engine",
     "ray_tpu.serve.draft",
+    "ray_tpu.serve.handoff",
     "ray_tpu.serve._replica",
     "ray_tpu.serve._controller",
     "ray_tpu.data.llm",
