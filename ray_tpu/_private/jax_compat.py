@@ -1,0 +1,22 @@
+"""Version-bridging shims for jax APIs the repo relies on.
+
+The repo targets the modern spelling (``jax.shard_map(..., check_vma=)``);
+older jax releases ship the same primitive as
+``jax.experimental.shard_map.shard_map`` with the check named
+``check_rep``. Resolve the spelling once here so every call site stays
+on the modern one.
+"""
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-0.6 jax: experimental spelling, check_vma named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        # check_rep stays off: the legacy replication checker rejects
+        # valid cond-under-shard_map programs (its own error message
+        # says to pass check_rep=False as the workaround).
+        del check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False, **kw)

@@ -149,8 +149,9 @@ class XlaMeshGroup(BaseGroup):
         else:
             raise ValueError(kind)
 
-        fn = jax.jit(jax.shard_map(f, mesh=self.mesh, in_specs=in_spec,
-                                   out_specs=out_spec, check_vma=False))
+        from ray_tpu._private.jax_compat import shard_map
+        fn = jax.jit(shard_map(f, mesh=self.mesh, in_specs=in_spec,
+                               out_specs=out_spec, check_vma=False))
         self._jit_cache[key] = fn
         return fn
 

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu._private.jax_compat import shard_map
+
 Params = Dict[str, Any]
 
 
@@ -185,8 +187,8 @@ def _sp_shard_map(fn, cfg: GPTConfig, mesh):
     spec = P(bt, cfg.sp_axis, tp, None)
     inner = functools.partial(fn, axis_name=cfg.sp_axis, causal=True,
                               axis_size=mesh.shape[cfg.sp_axis])
-    return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)
+    return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)
 
 
 def _attention(q, k, v, cfg: GPTConfig, mesh=None):
@@ -209,9 +211,9 @@ def _attention(q, k, v, cfg: GPTConfig, mesh=None):
             spec = P(bt, None, tp, None)
             # check_vma=False: pallas_call's out_shape carries no vma
             # annotation, which strict shard_map rejects.
-            return jax.shard_map(lambda q, k, v: fn(q, k, v), mesh=mesh,
-                                 in_specs=(spec, spec, spec),
-                                 out_specs=spec, check_vma=False)(q, k, v)
+            return shard_map(lambda q, k, v: fn(q, k, v), mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
         return fn(q, k, v)
     if backend in ("ring", "ulysses"):
         from ray_tpu.ops import ring_attention as ra

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu._private.jax_compat import shard_map
+
 
 def _stage_spec(leaf, pp_axis: str):
     """PartitionSpec sharding only the leading (layer) dim over pp."""
@@ -134,6 +136,6 @@ def pipeline_apply(block_fn: Callable, stacked_params: Any, x: jax.Array,
             jnp.where(p == P_ - 1, outbuf, jnp.zeros_like(outbuf)), pp_axis)
         return outbuf.reshape(B_loc, S, d)
 
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(param_specs, x_spec),
         out_specs=x_spec, check_vma=False)(stacked_params, x)

@@ -264,6 +264,9 @@ class ModelDrafter(Drafter):
         self.params = params
         self.cfg = cfg
 
+    # The drafter's own program set (rtflow RT109): its prefill per
+    # prompt bucket + the k-step draft chunk + the 1-token lazy ingest.
+    # rtlint: program-budget: len(prompt_buckets) + 2
     def configure(self, *, slots: int, max_len: int,
                   prompt_buckets: Sequence[int], draft_k: int):
         super().configure(slots=slots, max_len=max_len,
@@ -331,6 +334,9 @@ class ModelDrafter(Drafter):
             self._rngs, active)
         self._cache = cache
         self._pos[active] += self.draft_k
+        # The drafted tokens must reach the host: the verify dispatch
+        # feeds them back as its device inputs.
+        # rtlint: sync-ok=proposals proposals feed the verify dispatch
         return np.asarray(toks)
 
     def observe(self, slot: int, tokens: np.ndarray, accepted: int):

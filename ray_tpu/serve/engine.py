@@ -553,6 +553,15 @@ class DecodeEngine:
         if auto_start:
             self.start()
 
+    # THE engine program budget (rtflow RT109, ISSUE 15): one prefill
+    # program per prompt bucket (the true prompt length is traced, so
+    # every length within a bucket shares its program) + 1 fused chunk
+    # program + the 2 KV-handoff programs (export + import). The verify
+    # program is budgeted separately in _bind_verify. rtflow audits
+    # this bound against every factory call and dispatch shape
+    # reachable from here; the budget-vs-actual test pins it to the
+    # jit cache sizes on nano CPU.
+    # rtlint: program-budget: len(prompt_buckets) + 3
     def _build_pool(self, paged: bool, page_size: int, n_pages: int,
                     prefix_cache: bool):  # rtlint: holds=_admit_lock
         """Allocate THE persistent pool (flat or paged) and bind the
@@ -611,10 +620,12 @@ class DecodeEngine:
             cfg, self.slots, self.n_pages, self.page_size)
         self._bind_verify()
 
+    # rtlint: program-budget: 1
     def _bind_verify(self):  # rtlint: holds=_admit_lock
         """(Re)bind the verify program to the current pool layout and
         drafter — ONE compiled program per (pool shape, draft_k), or
-        None with speculative decoding off. Called from
+        None with speculative decoding off (the flat/paged bindings are
+        branch-exclusive, so the RT109 budget is 1, not 2). Called from
         :meth:`_build_pool` and :meth:`ensure_spec`, both of which hold
         ``_admit_lock``."""
         if self._drafter is None:
@@ -1382,6 +1393,11 @@ class DecodeEngine:
             if st is not None:
                 st.lane.q.put(("err", exc))
                 if free_state:
+                    # Ownership transferred: free_state=True means the
+                    # driver is confirmed dead (_fail_all's contract),
+                    # so the failing thread IS the owner — the same
+                    # dead-owner rebind rtsan's RS103 grants at runtime.
+                    # rtlint: disable=RT110 ownership transfer (above)
                     self._free_slot(i)
         if free_state:
             while self._pending:
@@ -1552,10 +1568,14 @@ class DecodeEngine:
             tok, cache, key = self._prefill(
                 self.params, self._cache, padded, np.int32(P),
                 np.int32(slot), jax.random.PRNGKey(req.seed))
+            # One transfer per admission — THE TTFT point.
+            # rtlint: sync-ok=ttft first token streams from the host
             first = int(np.asarray(tok))
             if epoch >= 0 and epoch != self._epoch:
                 return True          # stale driver: drop on the floor
             self._cache = cache
+            # Host mirror of the slot's PRNG lane (tiny [2] uint32).
+            # rtlint: sync-ok=prng-mirror re-uploaded per dispatch
             self._rngs[slot] = np.asarray(key)
             pages = []
         sm["engine_admission_wait"].observe(
@@ -1665,6 +1685,8 @@ class DecodeEngine:
             self.params, self._cache, padded, np.int32(sl),
             np.int32(hist), pt_row, np.int32(cow_src), np.int32(slot),
             jax.random.PRNGKey(req.seed))
+        # One transfer per admission — THE TTFT point.
+        # rtlint: sync-ok=ttft first token streams from the host
         first = int(np.asarray(tok))
         if epoch >= 0 and epoch != self._epoch:
             # Stale driver: drop the result AND hand back every page
@@ -1677,6 +1699,8 @@ class DecodeEngine:
                 pool.unref([cow_src])
             return None
         self._cache = cache
+        # Host mirror of the slot's PRNG lane (tiny [2] uint32).
+        # rtlint: sync-ok=prng-mirror re-uploaded per dispatch
         self._rngs[slot] = np.asarray(key)
         if partial:
             # The fork read src synchronously inside the dispatch above;
@@ -1712,7 +1736,11 @@ class DecodeEngine:
         # Trim to pos BEFORE hashing/shipping: positions past P hold
         # pad/stale garbage the mask never read — shipping them would
         # make the digest depend on pool history.
+        # The export IS the handoff payload: the bytes must reach the
+        # host to digest and ship — one round-trip per export.
+        # rtlint: sync-ok=ship handoff payload leaves through the host
         k = np.asarray(k_dev)[:, :P].copy()
+        # rtlint: sync-ok=ship second half of the same payload
         v = np.asarray(v_dev)[:, :P].copy()
         rng = np.asarray(self._rngs[slot], np.uint32).copy()
         if pages:
@@ -1949,7 +1977,11 @@ class DecodeEngine:
             toks, cache, _done, rngs = self._step(
                 self.params, self._cache, self._token, self._rngs,
                 active)
-        toks_np = np.asarray(toks)        # ONE transfer per chunk
+        # ONE transfer per fused k-step chunk — the engine's designed
+        # streaming granularity.
+        # rtlint: sync-ok=chunk-boundary one transfer per chunk
+        toks_np = np.asarray(toks)
+        # rtlint: sync-ok=chunk-boundary PRNG lanes ride the same sync
         rngs_np = np.asarray(rngs)
         t1 = time.time()
         if epoch >= 0 and epoch != self._epoch:
@@ -2076,8 +2108,13 @@ class DecodeEngine:
             committed, n_acc, cache, rngs = self._verify(
                 self.params, self._cache, self._token, draft,
                 self._rngs, active)
-        com_np = np.asarray(committed)    # ONE transfer per verify
+        # ONE transfer per verify round: committed tokens, accept
+        # counts, and PRNG lanes come back together.
+        # rtlint: sync-ok=verify-boundary one transfer per round
+        com_np = np.asarray(committed)
+        # rtlint: sync-ok=verify-boundary same round-trip
         acc_np = np.asarray(n_acc)
+        # rtlint: sync-ok=verify-boundary same round-trip
         rngs_np = np.asarray(rngs)
         t1 = time.time()
         if epoch >= 0 and epoch != self._epoch:

@@ -69,6 +69,39 @@ class Suppressed:
         self._n = 6              # rtlint: disable=RT103,RT101 multi
 
 
+def _fixture_deco(f):
+    return f
+
+
+class DecoratorSuppressed:
+    """A ``disable=`` on a DECORATOR line covers the decorated def
+    (ISSUE 15 satellite: previously only the ``def`` line or the line
+    directly above it attached, so decorated functions could not be
+    suppressed at their signature)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._d = 0
+
+    def guarded(self):
+        with self._lock:
+            self._d = 1
+
+    @_fixture_deco  # rtlint: disable=RT101 single writer behind deco
+    def on_decorator_line(self):
+        self._d = 2
+
+    # rtlint: disable=RT101 directive above the decorator stack
+    @_fixture_deco
+    @_fixture_deco
+    def above_decorators(self):
+        self._d = 3
+
+    @_fixture_deco
+    def unsuppressed(self):
+        self._d = 4  # FIRES RT101
+
+
 class Negative:
     """All writes guarded, or no lock at all — no findings."""
 

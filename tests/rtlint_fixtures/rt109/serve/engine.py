@@ -1,0 +1,110 @@
+"""RT109 fixture: the static compiled-program-budget audit (rtflow,
+ISSUE 15). Lives under ``rt109/serve/engine.py`` because the
+declaration requirement is path-scoped to the engine files. Tagged
+lines must each produce exactly one finding; every other line must
+stay clean. Never imported."""
+import numpy as np
+
+
+# The factory's budget is per call site, INCLUDING the dispatch
+# shape multiplicity of whatever the site binds it to — the worst
+# binding below pads to a prompt bucket.
+# rtlint: program-budget: len(prompt_buckets)
+def jit_budget_fixture(cfg, k=8):
+    return lambda *a: a
+
+
+def jit_undeclared_fixture(cfg):  # FIRES RT109
+    return lambda *a: a
+
+
+class BudgetEngine:
+    """Negative case: the declared budget covers the bucketed prefill
+    (one program per prompt bucket, established through the dataflow)
+    plus the chunk program."""
+
+    # rtlint: program-budget: len(prompt_buckets) + 1
+    def _build(self, cfg):
+        self._pf = jit_budget_fixture(cfg)
+        self._chunkprog = jit_budget_fixture(cfg, 4)
+
+    def admit(self, req):
+        bucket = next(b for b in self.prompt_buckets
+                      if b >= len(req.prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        return self._pf(padded)
+
+    def dispatch(self):
+        return self._chunkprog(self._token)
+
+
+class OverBudget:
+    """Declared 1, binds 2 distinct programs: the bound exceeds the
+    declaration."""
+
+    # FIRES-BELOW RT109
+    # rtlint: program-budget: 1
+    def _build(self, cfg):
+        self._a = jit_budget_fixture(cfg)
+        self._b = jit_budget_fixture(cfg, 4)
+
+
+class UnboundedEngine:
+    """A request-varying value reaches a trace key THROUGH A HELPER —
+    the interprocedural blind spot RT103 cannot see (the offending call
+    sites contain no len()/.shape at all)."""
+
+    # rtlint: program-budget: len(prompt_buckets)
+    def _build(self, cfg):
+        self._chunkprog = jit_budget_fixture(cfg)
+
+    def _width(self, prompt):
+        # RT103-invisible at the call sites below: the len() hides here.
+        return len(prompt)
+
+    def admit(self, cfg, prompt):
+        k = self._width(prompt)
+        return jit_budget_fixture(cfg, k)  # FIRES RT109
+
+    def dispatch_shape(self, prompt):
+        n = self._width(prompt)
+        padded = np.zeros((1, n), np.int32)
+        return self._chunkprog(padded)  # FIRES RT109
+
+    def dispatch_bucketed(self, prompt):
+        # Negative: the same request-varying width, REBOUND to a bucket
+        # before it touches a shape — exactly the engine's discipline.
+        n = self._width(prompt)
+        bucket = next(b for b in self.prompt_buckets if b >= n)
+        padded = np.zeros((1, bucket), np.int32)
+        return self._chunkprog(padded)
+
+
+class StructuralFactoryEngine:
+    """A factory recognized STRUCTURALLY (jax.jit in the body, no
+    ``jit_`` name): RT103's name-based classifier never sees its call
+    sites, so rtflow must report even a bare len() argument there
+    instead of deferring."""
+
+    # rtlint: program-budget: 1
+    def _build(self, cfg):
+        self._step = make_step_fixture(cfg, 8)
+
+    def admit(self, cfg, prompt):
+        return make_step_fixture(cfg, len(prompt))  # FIRES RT109
+
+
+# rtlint: program-budget: 1
+def make_step_fixture(cfg, n):
+    return jax.jit(lambda *a: a, static_argnums=(1,))
+
+
+class MissingBinder:
+    def _build(self, cfg):  # FIRES RT109
+        self._x = jit_budget_fixture(cfg)
+
+
+class SuppressedBinder:
+    # rtlint: disable=RT109 experimental probe engine, not in serving
+    def _build(self, cfg):
+        self._x = jit_budget_fixture(cfg)

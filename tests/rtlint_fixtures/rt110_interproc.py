@@ -1,0 +1,73 @@
+"""RT110 fixture: interprocedural lock/driver contracts at call edges
+(rtflow, ISSUE 15) — the static twin of rtsan's RS102/RS103. Never
+imported."""
+import threading
+
+
+class Interproc:
+    """holds= contracts checked at every resolved call edge."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def _bump(self):  # rtlint: holds=_lock
+        self._n += 1
+
+    def ok_lexical(self):
+        with self._lock:
+            self._bump()
+
+    def ok_transitive(self):  # rtlint: holds=_lock
+        # The caller's own holds= contract credits the edge.
+        self._bump()
+
+    def ok_manual(self):
+        self._lock.acquire()
+        try:
+            self._bump()
+        finally:
+            self._lock.release()
+
+    def bad_caller(self):
+        self._bump()  # FIRES RT110
+
+    def suppressed_caller(self):
+        # rtlint: disable=RT110 single-threaded test harness path
+        self._bump()
+
+    def _flush_locked(self):
+        self._n = 0
+
+    def ok_locked_convention(self):
+        with self._lock:
+            self._flush_locked()
+
+    def bad_locked_convention(self):
+        self._flush_locked()  # FIRES RT110
+
+
+class DriverContract:
+    """owner=driver propagation: driver code and thread registrations
+    may enter; anything else is a cross-thread dispatch hazard."""
+
+    # rtlint: owner=driver entry=driver
+    def _run(self):
+        self._step()                     # owner -> owner: clean
+
+    # rtlint: owner=driver
+    def _step(self):
+        return 1
+
+    def start(self):
+        # The repo's driver registration idiom: a thread edge is THE
+        # legitimate entry into owner=driver code.
+        t = threading.Thread(target=self._run, daemon=True)
+        return t
+
+    def rogue(self):
+        return self._step()  # FIRES RT110
+
+    def suppressed_rogue(self):
+        # rtlint: disable=RT110 ownership transfer: driver joined above
+        return self._step()

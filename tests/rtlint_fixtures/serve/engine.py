@@ -4,6 +4,7 @@ look at files named ``serve/engine.py``. Never imported.
 """
 
 
+# rtlint: program-budget: 1
 def jit_fake_factory(cfg):
     def step(params):
         return params
@@ -11,6 +12,7 @@ def jit_fake_factory(cfg):
 
 
 class FixtureEngine:
+    # rtlint: program-budget: 2
     def __init__(self, cfg):
         # Binding a factory result is construction, not a dispatch.
         self._prefill = jit_fake_factory(cfg)
@@ -44,6 +46,40 @@ class FixtureEngine:
     def helper(self, cfg):
         # Factory call WITHOUT immediate invocation: construction only.
         return jit_fake_factory(cfg)
+
+
+class SyncFixtureEngine:
+    """RT111 (rtflow, ISSUE 15): every host sync on a dispatch result
+    in the driver files must be justified; the device taint follows
+    values through helper calls (the interprocedural case)."""
+
+    # rtlint: program-budget: 1
+    def __init__(self, cfg):
+        self._sync_prog = jit_fake_factory(cfg)
+
+    # rtlint: owner=driver entry=driver
+    def _drive(self, params):
+        import numpy as np
+
+        toks = self._sync_prog(params)
+        bad = np.asarray(toks)  # FIRES RT111
+        # rtlint: sync-ok=chunk-boundary deliberate per-chunk transfer
+        ok = np.asarray(toks)
+        # rtlint: disable=RT111 test-only probe of the raw device value
+        probe = np.asarray(toks)
+        self._trim(toks)
+        if toks:  # FIRES RT111
+            return bad
+        return ok, probe
+
+    # A helper reached WITH a device value: the sync hides behind the
+    # call boundary, where RT102's lexical scope cannot see it.
+    # rtlint: owner=driver
+    def _trim(self, toks):
+        return toks.item()  # FIRES RT111
+
+    def _host_side(self, row):
+        return row.item()       # never fed a device value: clean
 
 
 class EntrylessEngine:

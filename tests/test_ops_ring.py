@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu._private.jax_compat import shard_map
 from ray_tpu.models.gpt import GPTConfig, _attention_xla
 from ray_tpu.ops.ring_attention import ring_attention, ulysses_attention
 from ray_tpu.parallel import create_mesh
@@ -28,7 +29,7 @@ def _run_sp(fn, mesh, axis, q, k, v):
     spec = P(None, axis, None, None)
     inner = functools.partial(fn, axis_name=axis, causal=True,
                               axis_size=mesh.shape[axis])
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
     return sharded(q, k, v)
 
@@ -54,8 +55,8 @@ def test_ring_gradients_match_xla():
     spec = P(None, "sp", None, None)
     inner = functools.partial(ring_attention, axis_name="sp", causal=True,
                               axis_size=4)
-    sp_fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                          out_specs=spec)
+    sp_fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
 
     def loss_sp(q, k, v):
         return jnp.sum(sp_fn(q, k, v) ** 2)

@@ -281,3 +281,318 @@ def test_shared_lint_rules_agree_with_register():
                for p in lint_metric_name("wait_ms", "histogram"))
     assert any("regex" in p
                for p in lint_metric_name("1bad", "gauge"))
+
+
+# ---------------------------------------------------------------- rtflow
+# ISSUE 15: interprocedural dataflow (tools/rtlint/flow.py +
+# callgraph.py) and the RT109/RT110/RT111 rules built on it.
+
+def test_new_rules_registered():
+    assert {"RT109", "RT110", "RT111"} <= set(RULE_TABLE)
+
+
+def test_determinism_covers_rtflow_rules():
+    """Two analyses of the fixture tree — where RT109-RT111 actually
+    produce findings — must render byte-identical JSON, extending the
+    determinism pin to the interprocedural rules (their fixpoint and
+    call-graph iteration order must not leak)."""
+    a = run_paths([FIXTURES]).to_json()
+    b = run_paths([FIXTURES]).to_json()
+    assert a == b
+    rules = {f["rule"] for f in json.loads(a)["findings"]}
+    assert {"RT109", "RT110", "RT111"} <= rules
+
+
+def test_parse_budget_grammar():
+    from tools.rtlint import parse_budget
+
+    c = parse_budget("len(prompt_buckets) + 3")
+    assert c.evaluate({"len(prompt_buckets)": 2}) == 5
+    assert parse_budget("1").evaluate({}) == 1
+    assert parse_budget("2 * len(buckets) + 1").evaluate(
+        {"len(buckets)": 4}) == 9
+    for bad in ("len(prompt_buckets) - 1", "foo", "1.5", "len(a, b)"):
+        with pytest.raises(ValueError):
+            parse_budget(bad)
+
+
+def test_card_leq_assumes_atoms_at_least_one():
+    from tools.rtlint import Card, parse_budget
+
+    atom = parse_budget("len(prompt_buckets)")
+    assert Card.const(1).leq(atom)           # len >= 1 covers a const
+    assert atom.leq(parse_budget("len(prompt_buckets) + 2"))
+    assert not parse_budget("len(prompt_buckets) + 1").leq(atom)
+    assert not Card.unbounded().leq(parse_budget("len(prompt_buckets)"))
+    assert Card.unbounded().leq(Card.unbounded())
+
+
+def _run_engine_scoped(tmp_path, src):
+    """Analyze ``src`` under a path RT109's budget scope matches."""
+    p = tmp_path / "serve"
+    p.mkdir(exist_ok=True)
+    f = p / "engine.py"
+    f.write_text(src)
+    return run_paths([str(f)])
+
+
+def test_rt109_unbounded_fails_then_bounded_passes(tmp_path):
+    """THE acceptance-criteria pin: a request-varying value laundered
+    through a helper reaches a trace key -> RT109 fires (RT103 stays
+    blind: no len() at the flagged site); re-bounding it through the
+    bucket discipline makes the same code clean."""
+    unbounded = (
+        "import numpy as np\n"
+        "# rtlint: program-budget: 1\n"
+        "def jit_step(cfg):\n"
+        "    return lambda *a: a\n"
+        "class Eng:\n"
+        "    # rtlint: program-budget: 1\n"
+        "    def _build(self, cfg):\n"
+        "        self._prog = jit_step(cfg)\n"
+        "    def _width(self, prompt):\n"
+        "        return len(prompt)\n"
+        "    def admit(self, prompt):\n"
+        "        n = self._width(prompt)\n"
+        "        padded = np.zeros((1, n), np.int32)\n"
+        "        return self._prog(padded)\n")
+    report = _run_engine_scoped(tmp_path, unbounded)
+    assert [f.rule for f in report.findings] == ["RT109"]
+    assert "request-varying" in report.findings[0].message
+    assert report.new, "an unbounded trace key must fail the gate"
+
+    bounded = unbounded.replace(
+        "        n = self._width(prompt)\n"
+        "        padded = np.zeros((1, n), np.int32)\n",
+        "        n = self._width(prompt)\n"
+        "        b = next(x for x in self.prompt_buckets if x >= n)\n"
+        "        padded = np.zeros((1, b), np.int32)\n").replace(
+        "    # rtlint: program-budget: 1\n"
+        "    def _build",
+        "    # rtlint: program-budget: len(prompt_buckets)\n"
+        "    def _build").replace(
+        "# rtlint: program-budget: 1\n"
+        "def jit_step",
+        "# rtlint: program-budget: len(prompt_buckets)\n"
+        "def jit_step")
+    report = _run_engine_scoped(tmp_path, bounded)
+    assert not report.findings, [f.render() for f in report.findings]
+
+
+def test_rt109_budget_exceeded_then_raised(tmp_path):
+    over = (
+        "# rtlint: program-budget: 1\n"
+        "def jit_p(cfg, k=0):\n"
+        "    return lambda *a: a\n"
+        "class Eng:\n"
+        "    # rtlint: program-budget: 1\n"
+        "    def _build(self, cfg):\n"
+        "        self._a = jit_p(cfg)\n"
+        "        self._b = jit_p(cfg, 1)\n")
+    report = _run_engine_scoped(tmp_path, over)
+    assert [f.rule for f in report.findings] == ["RT109"]
+    assert "budget_exceeded" in report.findings[0].key
+    fixed = over.replace("    # rtlint: program-budget: 1\n",
+                         "    # rtlint: program-budget: 2\n")
+    assert not _run_engine_scoped(tmp_path, fixed).findings
+
+
+def test_rt110_holds_checked_at_edges(tmp_path):
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def _bump(self):  # rtlint: holds=_lock\n"
+        "        self._n += 1\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def bad(self):\n"
+        "        self._bump()\n")
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    report = run_paths([str(p)])
+    assert [f.rule for f in report.findings] == ["RT110"]
+    assert "C.bad->C._bump" in report.findings[0].key
+
+
+def test_callgraph_resolves_repo_idioms(tmp_path):
+    """Self methods, base-class methods, thread registration, nested
+    with-lock context, and manual-acquire credit all resolve."""
+    from tools.rtlint.callgraph import CallGraph
+    from tools.rtlint.core import Module
+
+    src = (
+        "import threading\n"
+        "class Base:\n"
+        "    def shared(self):\n"
+        "        return 1\n"
+        "class C(Base):\n"
+        "    def _run(self):\n"
+        "        self.helper()\n"
+        "    def helper(self):\n"
+        "        return self.shared()\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._run)\n"
+        "        return t\n"
+        "    def locked_call(self):\n"
+        "        with self._big_lock:\n"
+        "            with self._small_lock:\n"
+        "                self.helper()\n")
+    p = tmp_path / "cg.py"
+    p.write_text(src)
+    mod = Module(str(p), str(p), src)
+    g = CallGraph.build([mod])
+    edges = {(e.caller or "<mod>", e.callee, e.kind): e for e in g.edges}
+    rel = mod.relpath
+    assert (f"{rel}::C._run", f"{rel}::C.helper", "call") in edges
+    assert (f"{rel}::C.helper", f"{rel}::Base.shared", "call") in edges
+    assert (f"{rel}::C.start", f"{rel}::C._run", "thread") in edges
+    nested = edges[(f"{rel}::C.locked_call", f"{rel}::C.helper", "call")]
+    assert nested.locks == frozenset({"_big_lock", "_small_lock"})
+
+
+def test_decorator_line_directives_attach(tmp_path):
+    """The shared loader attaches directives on ANY decorator line of a
+    def (and the line above the stack) — the rtlint suppression and the
+    rtsan contract read the same placement (fixture coverage lives in
+    rt101_locks.py; this pins the loader directly, multi-line decorator
+    included)."""
+    from tools.rtlint.annotations import directive_map, func_directives
+
+    src = (
+        "import functools\n"
+        "# rtlint: owner=driver\n"
+        "@functools.lru_cache(\n"
+        "    maxsize=64)\n"
+        "@staticmethod  # rtlint: holds=_lock\n"
+        "def f():\n"
+        "    pass\n")
+    import ast as _ast
+    fn = _ast.parse(src).body[1]
+    d = func_directives(directive_map(src), fn)
+    assert d == {"owner": "driver", "holds": "_lock"}
+
+
+def test_update_baseline_refuses_growth(tmp_path):
+    """--update-baseline is a burn-down tool: shrinking is free, adding
+    entries needs --allow-growth (ISSUE 15 satellite)."""
+    bad = tmp_path / "serve"
+    bad.mkdir()
+    f = bad / "controller.py"
+    one = ("def loop(work):\n"
+           "    try:\n"
+           "        work()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    two = one + ("def loop2(work):\n"
+                 "    try:\n"
+                 "        work()\n"
+                 "    except Exception:\n"
+                 "        pass\n")
+    baseline = tmp_path / "baseline.json"
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.rtlint", *args],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+
+    f.write_text(one)
+    proc = cli(str(f), "--update-baseline", "--baseline", str(baseline))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refusing to grow" in proc.stderr
+    assert not baseline.exists()
+
+    proc = cli(str(f), "--update-baseline", "--baseline", str(baseline),
+               "--allow-growth")
+    assert proc.returncode == 0, proc.stderr
+    assert len(json.loads(baseline.read_text())["findings"]) == 1
+
+    # Growing an EXISTING baseline refuses the same way...
+    f.write_text(two)
+    proc = cli(str(f), "--update-baseline", "--baseline", str(baseline))
+    assert proc.returncode == 2 and "refusing" in proc.stderr
+    assert len(json.loads(baseline.read_text())["findings"]) == 1
+    # ...while shrinking (the burn-down direction) never needs a flag.
+    f.write_text("def loop(work):\n    return work()\n")
+    proc = cli(str(f), "--update-baseline", "--baseline", str(baseline))
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(baseline.read_text())["findings"] == []
+
+
+def test_ci_gate_rtflow_rules_clean_on_ray_tpu():
+    """The tier-1 budget/contract gate, rule-filtered: even under
+    --rules RT109,RT110,RT111 the engine tree must be clean — every
+    factory entrypoint declares its budget, every contract edge holds,
+    every sync point is justified."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", "ray_tpu/", "--check",
+         "--rules", "RT109,RT110,RT111"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+
+
+def test_engine_declared_budget_matches_actual_nano():
+    """The declared budgets in serve/engine.py are the engine's REAL
+    compiled-program count (ISSUE 15 satellite): exercise every prompt
+    bucket plus a full handoff round-trip on nano CPU and compare the
+    jit cache growth against the parsed program-budget declarations."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import gpt
+    from ray_tpu.models import gpt_decode as gd
+    from ray_tpu.serve.engine import DecodeEngine
+    from tools.rtlint import declared_budgets, parse_budget
+    from tools.rtlint.core import Module
+
+    cfg = gpt.CONFIGS["nano"]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    buckets = (8, 16)
+    eng = DecodeEngine(params, cfg, slots=3, chunk=4, max_len=40,
+                       prompt_buckets=buckets, eos_token=-1)
+    try:
+        wrappers = {"_prefill": eng._prefill, "_step": eng._step,
+                    "_export": eng._export, "_import": eng._import}
+        pre = {k: w._cache_size() for k, w in wrappers.items()}
+        rng = np.random.default_rng(3)
+        # Every bucket decodes...
+        for n in (5, 8, 11, 16):
+            prompt = rng.integers(0, cfg.vocab_size, (n,)).astype(
+                np.int32)
+            assert len(list(eng.stream(prompt, 6))) >= 1
+        # ...and the handoff path exports AND imports.
+        prompt = rng.integers(0, cfg.vocab_size, (9,)).astype(np.int32)
+        desc = eng.handoff(prompt, max_new=5)
+        out = np.concatenate(list(eng.stream(prompt, 5)))
+        resumed = eng.admit_prefilled(desc)
+        from ray_tpu.serve.batching import _EngineStream
+        got = np.concatenate(list(_EngineStream(resumed)))
+        assert np.array_equal(out, got)
+        actual = sum(w._cache_size() - pre[k]
+                     for k, w in wrappers.items())
+
+        src = open(os.path.join(REPO, "ray_tpu", "serve",
+                                "engine.py")).read()
+        mod = Module("engine.py", "serve/engine.py", src)
+        decls = declared_budgets(mod)
+        declared = parse_budget(decls["DecodeEngine._build_pool"][1])
+        env = {"len(prompt_buckets)": len(buckets)}
+        assert actual == declared.evaluate(env) == len(buckets) + 3
+        # The verify budget is declared separately (spec engines).
+        assert parse_budget(
+            decls["DecodeEngine._bind_verify"][1]).evaluate(env) == 1
+        # And the factory-level declarations in gpt_decode parse and
+        # cover the flat factories' per-site bounds.
+        gsrc = open(os.path.join(REPO, "ray_tpu", "models",
+                                 "gpt_decode.py")).read()
+        gdecls = declared_budgets(
+            Module("gpt_decode.py", "models/gpt_decode.py", gsrc))
+        assert parse_budget(gdecls["jit_prefill_into_slot"][1]
+                            ).evaluate(env) == len(buckets)
+        assert parse_budget(gdecls["jit_decode_chunk_slots"][1]
+                            ).evaluate(env) == 1
+    finally:
+        eng.shutdown()

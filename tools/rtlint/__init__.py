@@ -7,12 +7,15 @@ Usage::
     python -m tools.rtlint ray_tpu/ --json       # machine report
     python -m tools.rtlint ray_tpu/ --check      # CI gate (quiet)
 
-Rules (see ``tools/rtlint/rules.py`` for the conventions each leans on):
+Rules (see ``tools/rtlint/rules.py`` for the conventions each leans on;
+RT109-RT111 are **rtflow** rules — interprocedural dataflow over the
+project call graph, ``tools/rtlint/flow.py`` + ``callgraph.py``):
 
 ========  ============================================================
 RT101     attribute written both with and without its guarding lock
 RT102     device dispatch outside a driver-annotated engine method
 RT103     unhashable / unbounded-cardinality args into jit factories
+          (intra-procedural — the hazard visible AT the call site)
 RT104     blocking calls (time.sleep, .get(), .result()) in async defs
 RT105     retryable pushback classes out of sync with _PUSHBACK_CAUSES
 RT106     metric names violating prometheus conventions (shared with
@@ -21,17 +24,51 @@ RT107     bare / silently-swallowed except in serve control loops
 RT108     owner=/holds= annotations naming a lock / driver
           registration that does not exist (the same contracts the
           runtime sanitizer tools/rtsan enforces dynamically)
+RT109     static compiled-program-budget audit: factory entrypoints
+          declare ``# rtlint: program-budget: <expr>``; rtflow bounds
+          the reachable trace keys (through helpers, fields, and
+          dispatch shapes) and fails on excess or unboundedness
+RT110     holds=/owner=driver contracts checked at every resolved call
+          EDGE (the helper-boundary blind spot of RT101/RT102; static
+          twin of rtsan's RS102/RS103)
+RT111     host-device sync points on dispatch results in the driver
+          files must carry ``# rtlint: sync-ok=<tag> <why>`` — the
+          dispatch loop's sync inventory is explicit and gated
 ========  ============================================================
 
+The lint → sanitize pipeline: one annotation grammar
+(:mod:`tools.rtlint.annotations`) is parsed by BOTH the static rules
+above and the runtime sanitizer ``tools/rtsan`` (RS101-RS105), and
+``python -m tools.rtsan --report`` prints the annotation-coverage
+summary — the fraction of driver methods / locks actually carrying the
+contracts — so the two enforcement layers visibly share one contract
+set.
+
 Suppression: ``# rtlint: disable=RT101[,RT104]`` on the offending line
-(or the line above, or the enclosing ``def`` line) — add a justification
-after the directive. Grandfathered findings live in
-``tools/rtlint/baseline.json``; ``--update-baseline`` regenerates it.
+(or the line above, the enclosing ``def`` signature, or a decorator
+line of that def) — add a justification after the directive.
+Grandfathered findings live in ``tools/rtlint/baseline.json``;
+``--update-baseline`` regenerates it, and refuses to ADD entries
+unless ``--allow-growth`` is passed (the baseline is a burn-down list).
+
+Diagnosing an RT109 unbounded-trace-key report: the finding names the
+argument (or dispatched array) whose cardinality rtflow bounded as
+``unbounded``. Walk backwards from that line: the value came from
+``len(...)``/``.shape`` of request data — often through a helper
+return or a dataclass field, which is why no ``len()`` appears at the
+flagged site. Fix it the way the engine does: re-bound the value
+through the bucket discipline (``next(b for b in self.prompt_buckets
+if b >= n)``) before it touches a shape or a factory argument; the
+bound then shows up as ``len(prompt_buckets)`` in the computed budget
+instead of ``unbounded``. See ``README.md`` ("Static analysis") for a
+worked example.
 """
 from .annotations import (FuncAnn, load_annotations,  # noqa: F401
                           parse_directives)
+from .callgraph import CallGraph
 from .core import (Finding, Module, ProjectRule, Report, Rule,
                    load_baseline, run, write_baseline)
+from .flow import Card, FlowAnalysis, declared_budgets, parse_budget
 from .metrics_names import lint_metric_name
 from .rules import ALL_RULES, RULE_TABLE
 
@@ -45,7 +82,9 @@ def run_paths(paths, baseline_path=None, rule_filter=None) -> Report:
                rule_filter=rule_filter)
 
 
-__all__ = ["Finding", "FuncAnn", "Module", "ProjectRule", "Report",
-           "Rule", "ALL_RULES", "RULE_TABLE", "DEFAULT_BASELINE",
-           "lint_metric_name", "load_annotations", "load_baseline",
-           "parse_directives", "run", "run_paths", "write_baseline"]
+__all__ = ["ALL_RULES", "CallGraph", "Card", "DEFAULT_BASELINE",
+           "Finding", "FlowAnalysis", "FuncAnn", "Module",
+           "ProjectRule", "Report", "Rule", "RULE_TABLE",
+           "declared_budgets", "lint_metric_name", "load_annotations",
+           "load_baseline", "parse_budget", "parse_directives", "run",
+           "run_paths", "write_baseline"]

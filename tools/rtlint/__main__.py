@@ -13,13 +13,16 @@ import argparse
 import os
 import sys
 
-from . import DEFAULT_BASELINE, RULE_TABLE, run_paths, write_baseline
+from . import (DEFAULT_BASELINE, RULE_TABLE, load_baseline, run_paths,
+               write_baseline)
+from .core import PARSE_ERROR_RULE
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.rtlint",
-        description="repo-native static analysis (rules RT101-RT108)")
+        description="repo-native static analysis (rules "
+                    f"{min(RULE_TABLE)}-{max(RULE_TABLE)})")
     ap.add_argument("paths", nargs="+", help="files or directories")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
@@ -33,7 +36,13 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (default all)")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="rewrite the baseline to the current findings")
+                    help="rewrite the baseline to the current findings "
+                         "(refuses to ADD entries unless --allow-growth "
+                         "is passed — the baseline is a burn-down list, "
+                         "not a dumping ground)")
+    ap.add_argument("--allow-growth", action="store_true",
+                    help="let --update-baseline grandfather NEW "
+                         "findings instead of refusing")
     args = ap.parse_args(argv)
 
     rule_filter = None
@@ -55,7 +64,28 @@ def main(argv=None) -> int:
                        rule_filter=rule_filter)
 
     if args.update_baseline:
+        if rule_filter is not None:
+            # A rule-filtered report only sees a slice of the findings;
+            # writing it out would silently drop every other rule's
+            # grandfathered entries.
+            print("refusing --update-baseline with --rules: the "
+                  "baseline spans ALL rules, a filtered run cannot "
+                  "rewrite it", file=sys.stderr)
+            return 2
         path = args.baseline or DEFAULT_BASELINE
+        old = load_baseline(path)
+        grown = sorted(
+            {f.key for f in report.findings
+             if f.rule != PARSE_ERROR_RULE} - old)
+        if grown and not args.allow_growth:
+            print(f"refusing to grow the baseline: {len(grown)} "
+                  f"finding{'s' if len(grown) != 1 else ''} not "
+                  f"already grandfathered — fix them, suppress them "
+                  f"with a justification, or pass --allow-growth:",
+                  file=sys.stderr)
+            for k in grown:
+                print(f"  {k}", file=sys.stderr)
+            return 2
         write_baseline(path, report.findings)
         print(f"baseline written: {path} "
               f"({len(report.findings)} findings)")

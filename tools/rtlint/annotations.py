@@ -20,12 +20,25 @@ justification)::
     # rtlint: disable=RT101,RT104   <why this is safe>
     # rtlint: owner=driver entry=driver
     # rtlint: holds=_lock           <every caller holds self._lock>
+    # rtlint: sync-ok=ttft          <why this host sync is deliberate>
     # rtsan: disable=RS104          <why this blocking call is safe>
+
+One directive key escapes the ``k=v`` token grammar:
+``program-budget:`` (rtflow's RT109 compiled-program-budget audit)
+takes the REST of the comment as a symbolic expression, because budget
+expressions contain spaces::
+
+    # rtlint: program-budget: len(prompt_buckets) + 3
+
+The expression grammar is integer literals, ``len(<name>)`` atoms, and
+``+`` / ``*`` (see :func:`tools.rtlint.flow.parse_budget`); a budget
+comment carries no other directives and no prose.
 
 Placement: a directive on a line (or the line directly above, for
 wrapped statements) attaches to that line; a directive anywhere on a
-(possibly multi-line) ``def`` signature, or on the line directly above
-it, applies to the whole function.
+(possibly multi-line) ``def`` signature — INCLUDING its decorator
+lines — or on the line directly above the first decorator, applies to
+the whole function.
 """
 from __future__ import annotations
 
@@ -38,19 +51,37 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 
+#: THE ``self.<attr>`` naming convention for locks, shared by RT101
+#: guard inference, rtflow's call-graph lock context (RT110), and
+#: rtsan's annotation-coverage summary — one definition so the static
+#: and runtime tools can never disagree about what counts as a lock.
+LOCKISH_RE = re.compile(r"lock|cond|mutex", re.I)
+
+
 @functools.lru_cache(maxsize=8)
 def _tag_re(tag: str) -> "re.Pattern":
     return re.compile(re.escape(tag) + r":\s*(.*)")
+
+
+#: The one directive whose value is the whole comment remainder (a
+#: symbolic expression with spaces), not a whitespace-split token.
+BUDGET_KEY = "program-budget"
+_BUDGET_RE = re.compile(re.escape(BUDGET_KEY) + r":\s*(.+?)\s*$")
 
 
 def parse_directives(comment: str, tag: str = "rtlint") -> Dict[str, str]:
     """``# <tag>: k=v[,v2] [k=v ...] prose`` -> ``{k: v[,v2]}``. Tokens
     split on whitespace ONLY, so comma-joined values
     (``disable=RT101,RT104``) stay intact; the first non ``k=v`` token
-    starts the prose. Non-directive comments return ``{}``."""
+    starts the prose. ``# <tag>: program-budget: <expr>`` is special:
+    the whole remainder is the (space-containing) budget expression.
+    Non-directive comments return ``{}``."""
     m = _tag_re(tag).search(comment)
     if not m:
         return {}
+    b = _BUDGET_RE.match(m.group(1))
+    if b:
+        return {BUDGET_KEY: b.group(1)}
     out: Dict[str, str] = {}
     for tok in m.group(1).split():
         if "=" not in tok:
@@ -95,11 +126,21 @@ def line_directives(directives: Dict[int, Dict[str, str]],
 def func_directives(directives: Dict[int, Dict[str, str]],
                     funcdef) -> Dict[str, str]:
     """Directives anywhere on the (possibly multi-line) ``def``
-    signature, or on the line directly above it."""
-    out = dict(directives.get(funcdef.lineno - 1, ()))
+    signature — including its DECORATOR lines, so ``# rtlint:
+    disable=..`` next to ``@decorator`` covers the decorated ``def`` —
+    or on the line directly above the first decorator.
+
+    ``funcdef.lineno`` is the ``def`` line (decorators carry their own
+    linenos), so the scan starts at the first decorator when one
+    exists; without the decorator span a directive on a decorator line
+    only covered the def when it HAPPENED to be the line directly
+    above it (single, single-line decorator)."""
+    deco = getattr(funcdef, "decorator_list", None) or ()
+    start = min([funcdef.lineno] + [d.lineno for d in deco])
+    out = dict(directives.get(start - 1, ()))
     sig_end = (funcdef.body[0].lineno - 1 if funcdef.body
                else funcdef.lineno)
-    for ln in range(funcdef.lineno, sig_end + 1):
+    for ln in range(start, sig_end + 1):
         out.update(directives.get(ln, ()))
     return out
 

@@ -257,6 +257,11 @@ def run(paths: Sequence[str], rules: Sequence[Rule],
     """Analyze ``paths`` with ``rules``; returns the full report with
     baseline split applied."""
     report = Report()
+    if rule_filter:
+        # Skip filtered-out rules up front: their findings would be
+        # dropped anyway, and the rtflow ProjectRules each pay a
+        # project-wide call-graph + fixpoint analysis.
+        rules = [r for r in rules if r.id in rule_filter]
     mods: List[Module] = []
     raw: List[Finding] = []
     for abspath, relpath in collect_files(paths):
@@ -288,9 +293,6 @@ def run(paths: Sequence[str], rules: Sequence[Rule],
             mod = by_rel.get(f.path)
             if mod is None or not mod.suppresses(f.line, f.rule):
                 raw.append(f)
-    if rule_filter:
-        raw = [f for f in raw if f.rule in rule_filter
-               or f.rule == PARSE_ERROR_RULE]
     report.findings = _dedup_symbols(raw)
     baseline = load_baseline(baseline_path)
     seen_keys = set()

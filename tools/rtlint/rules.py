@@ -15,8 +15,9 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from .core import Finding, Module, ProjectRule, Rule
 from .metrics_names import lint_metric_name
 
-#: Attribute names that count as locks for RT101 guard inference.
-LOCKISH_RE = re.compile(r"lock|cond|mutex", re.I)
+#: Attribute names that count as locks for RT101 guard inference
+#: (shared definition — see annotations.LOCKISH_RE).
+from .annotations import LOCKISH_RE
 #: Receiver names that look like queues for RT104's timeout-less .get().
 QUEUEISH_RE = re.compile(r"(^|_)(q|queue)$|queue", re.I)
 
@@ -759,9 +760,13 @@ def _calls_with_scope(tree):
     yield from _nodes_with_scope(tree, ast.Call)
 
 
+from .flow import (InterprocContractRule, ProgramBudgetRule,  # noqa: E402
+                   SyncPointRule)
+
 ALL_RULES: Tuple[Rule, ...] = (
     LockGuardRule(), DriverOwnershipRule(), RecompileHazardRule(),
     AsyncBlockingRule(), RetryableWireRule(), MetricNameRule(),
-    SwallowedExceptRule(), AnnotationDriftRule())
+    SwallowedExceptRule(), AnnotationDriftRule(), ProgramBudgetRule(),
+    InterprocContractRule(), SyncPointRule())
 
 RULE_TABLE = {r.id: r for r in ALL_RULES}

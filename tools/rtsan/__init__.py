@@ -27,7 +27,7 @@ enclosing ``def``); grandfathered keys live in
 """
 from .core import (DEFAULT_BASELINE, DEFAULT_MODULES, REPO_ROOT,
                    SANITIZER, RTSanViolation, SanCondition, Sanitizer,
-                   SanLock)
+                   SanLock, annotation_coverage)
 
 RULES = {
     "RS101": "lock-order cycle (potential ABBA deadlock)",
@@ -88,6 +88,6 @@ def stats_block(path_filter: str = "serve/") -> dict:
 
 __all__ = ["DEFAULT_BASELINE", "DEFAULT_MODULES", "REPO_ROOT", "RULES",
            "RTSanViolation", "SANITIZER", "SanCondition", "Sanitizer",
-           "SanLock", "activated", "disable", "dump", "enable",
-           "findings", "gate", "is_active", "is_enabled", "snapshot",
-           "stats_block", "thread_watch"]
+           "SanLock", "activated", "annotation_coverage", "disable",
+           "dump", "enable", "findings", "gate", "is_active",
+           "is_enabled", "snapshot", "stats_block", "thread_watch"]

@@ -17,7 +17,7 @@ import os
 import sys
 
 from ..rtlint.core import load_baseline
-from .core import DEFAULT_BASELINE, HOLD_BUCKETS
+from .core import DEFAULT_BASELINE, HOLD_BUCKETS, coverage_totals
 
 
 def _default_paths():
@@ -30,9 +30,13 @@ def _default_paths():
 
 def _merge(paths):
     findings, edges, holds = {}, {}, {}
+    coverage = {"modules": {}, "totals": {}}
     for p in paths:
         with open(p) as f:
             data = json.load(f)
+        cov = data.get("coverage") or {}
+        for m, c in cov.get("modules", {}).items():
+            coverage["modules"].setdefault(m, c)
         for fd in data.get("findings", ()):
             findings.setdefault(fd["key"], fd)
         for e in data.get("edges", ()):
@@ -53,7 +57,13 @@ def _merge(paths):
                 cur["buckets"] = [x + y for x, y in
                                   zip(cur["buckets"], h["buckets"])]
                 cur["name"] = cur["name"] or h.get("name")
-    return findings, edges, holds
+    if coverage["modules"]:
+        # Recompute the totals from the merged per-module rows: with
+        # one artifact per process the processes may have sanitized
+        # different module sets, so no single artifact's totals line
+        # describes the union printed above it.
+        coverage["totals"] = coverage_totals(coverage["modules"].values())
+    return findings, edges, holds, coverage
 
 
 def main(argv=None) -> int:
@@ -76,7 +86,7 @@ def main(argv=None) -> int:
         print("rtsan: no run artifact found (run the suite first, or "
               "pass artifact paths)", file=sys.stderr)
         return 2
-    findings, edges, holds = _merge(paths)
+    findings, edges, holds, coverage = _merge(paths)
     baseline = load_baseline(args.baseline)
     new = sorted(k for k in findings if k not in baseline)
 
@@ -84,6 +94,7 @@ def main(argv=None) -> int:
         print(json.dumps({
             "version": 1,
             "artifacts": [os.path.abspath(p) for p in sorted(paths)],
+            "coverage": coverage,
             "findings": [findings[k] for k in sorted(findings)],
             "new": new,
             "edges": [edges[k] for k in sorted(edges)],
@@ -93,6 +104,20 @@ def main(argv=None) -> int:
 
     print(f"rtsan report ({len(paths)} artifact"
           f"{'s' if len(paths) != 1 else ''})")
+
+    tot = coverage.get("totals") or {}
+    if tot:
+        print(f"\n== annotation coverage (the contract set rtlint "
+              f"checks statically and rtsan enforces) ==")
+        for m in sorted(coverage.get("modules", {})):
+            c = coverage["modules"][m]
+            print(f"  {m}: {c['annotated']}/{c['methods']} driver "
+                  f"methods annotated, {c['locks_with_holds']}/"
+                  f"{c['locks']} locks named by holds=")
+        print(f"  TOTAL: methods {tot['annotated']}/{tot['methods']} "
+              f"({tot['method_fraction']:.0%}), locks "
+              f"{tot['locks_with_holds']}/{tot['locks']} "
+              f"({tot['lock_fraction']:.0%})")
     print(f"\n== findings: {len(findings)} ({len(new)} new) ==")
     for k in sorted(findings):
         fd = findings[k]

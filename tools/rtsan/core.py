@@ -100,6 +100,103 @@ _THIS_FILE = os.path.abspath(__file__)
 _STDLIB_SUFFIXES = (os.sep + "threading.py", os.sep + "queue.py")
 
 
+def annotation_coverage(modules=DEFAULT_MODULES) -> Dict[str, dict]:
+    """Static annotation-coverage summary (ISSUE 15 satellite): how
+    much of the sanitized driver surface actually carries the
+    ``owner=`` / ``holds=`` / ``entry=`` contracts that rtlint
+    (RT101/RT102/RT108/RT110) checks statically and this sanitizer
+    enforces at runtime. An unannotated driver method or an unnamed
+    lock is a gap BOTH tools are blind to, so the fraction is the
+    visible size of the shared contract set.
+
+    Per module: ``methods`` / ``annotated`` count the methods of
+    driver-owned classes (>= 1 ``owner=``/``entry=`` method) and how
+    many of them carry any contract; ``locks`` / ``locks_with_holds``
+    count the lock-ish ``self.<attr>`` assignments (``lock|cond|
+    mutex``) and how many are named by at least one ``holds=``.
+    ``totals`` aggregates with the two fractions. Purely source-based
+    (``find_spec``, no import), so it works without :func:`enable`."""
+    import ast as _ast
+    import importlib.util
+
+    from ..rtlint.annotations import LOCKISH_RE as lockish
+    out: Dict[str, dict] = {"modules": {}, "totals": {}}
+    for modname in modules:
+        try:
+            spec = importlib.util.find_spec(modname)
+            path = getattr(spec, "origin", None)
+            if not path or not os.path.exists(path):
+                continue
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            anns = load_annotations(src)
+            tree = _ast.parse(src)
+        except Exception:  # noqa: BLE001 - coverage is best-effort
+            continue
+        contracts = {(a.cls, a.name) for a in anns}
+        driver_classes = {a.cls for a in anns
+                          if a.owner or a.entry}
+        holds_named = {h for a in anns for h in a.holds}
+        methods = annotated = 0
+        locks = set()
+
+        def classes(node, prefix=""):
+            for child in _ast.iter_child_nodes(node):
+                if isinstance(child, _ast.ClassDef):
+                    yield f"{prefix}{child.name}", child
+                    yield from classes(child,
+                                       f"{prefix}{child.name}.")
+                elif isinstance(child, (_ast.FunctionDef,
+                                        _ast.AsyncFunctionDef)):
+                    yield from classes(child, prefix)
+
+        for qual, cls in classes(tree):
+            names = [n.name for n in cls.body
+                     if isinstance(n, (_ast.FunctionDef,
+                                       _ast.AsyncFunctionDef))]
+            if qual in driver_classes:
+                methods += len(names)
+                annotated += sum((qual, n) in contracts for n in names)
+        for node in _ast.walk(tree):
+            targets = []
+            if isinstance(node, _ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, _ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                while isinstance(t, (_ast.Tuple, _ast.List)) and t.elts:
+                    t = t.elts[0]
+                if isinstance(t, _ast.Attribute) and \
+                        isinstance(t.value, _ast.Name) and \
+                        t.value.id == "self" and lockish.search(t.attr):
+                    locks.add(t.attr)
+        covered = len(locks & holds_named)
+        out["modules"][modname] = {
+            "methods": methods, "annotated": annotated,
+            "locks": len(locks), "locks_with_holds": covered,
+        }
+    out["totals"] = coverage_totals(out["modules"].values())
+    return out
+
+
+def coverage_totals(rows) -> dict:
+    """Aggregate per-module coverage rows into the ``totals`` block —
+    THE one implementation, shared by single-process snapshots and the
+    CLI's multi-artifact merge so they can never disagree."""
+    rows = list(rows)
+    methods = sum(r["methods"] for r in rows)
+    annotated = sum(r["annotated"] for r in rows)
+    locks = sum(r["locks"] for r in rows)
+    covered = sum(r["locks_with_holds"] for r in rows)
+    return {
+        "methods": methods, "annotated": annotated,
+        "locks": locks, "locks_with_holds": covered,
+        "method_fraction": round(annotated / methods, 3)
+        if methods else 1.0,
+        "lock_fraction": round(covered / locks, 3) if locks else 1.0,
+    }
+
+
 class RTSanViolation(RuntimeError):
     """A broken owner=/holds= contract, raised at the violation site."""
 
@@ -815,10 +912,13 @@ class Sanitizer:
     def snapshot(self) -> dict:
         """JSON-ready state: the run artifact ``python -m tools.rtsan
         --report`` renders."""
+        coverage = annotation_coverage(
+            tuple(sorted(self._seen_modules)) or DEFAULT_MODULES)
         with self._mu:
             return {
                 "version": 1,
                 "pid": os.getpid(),
+                "coverage": coverage,
                 "findings": [f.to_dict() for f in self.findings],
                 "suppressed": list(self.suppressed),
                 "edges": [
