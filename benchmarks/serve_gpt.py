@@ -163,6 +163,18 @@ def main():
                              "are nearly free and locked-in repetitive "
                              "streams commit k+1 tokens per forward)")
     parser.add_argument("--page-size", type=int, default=8)
+    parser.add_argument("--kv-dtype", default="fp",
+                        choices=("fp", "int8"),
+                        help="with --paged: int8 adds an equal-HBM-byte "
+                             "fp-vs-int8 A/B arm (concurrent lanes, "
+                             "TTFT p50, tok/s) after the flat/paged "
+                             "rows (ISSUE 16)")
+    parser.add_argument("--attn-kernel", default="gather",
+                        choices=("gather", "pallas"),
+                        help="with --paged: pallas adds a kernel-on vs "
+                             "kernel-off TPOT A/B arm (CPU runs the "
+                             "kernel in interpret mode — correctness "
+                             "plumbing, not speed) (ISSUE 16)")
     parser.add_argument("--smoke", action="store_true",
                         help="with --continuous/--paged: shrunk load "
                              "for tier-1 CI (fewer requests, shorter "
@@ -1135,6 +1147,211 @@ def run_paged_ab(args, np, cfg_name, model):
             fl["lone_ttft_p50_ms"]
             / max(pg["lone_ttft_p50_ms"], 1e-9), 2),
         "kv_budget_positions": kv_positions,
+        "smoke": bool(args.smoke),
+    }))
+    if args.kv_dtype == "int8":
+        _run_kv_dtype_arm(args, np, cfg, params, model)
+    if args.attn_kernel == "pallas":
+        _run_attn_kernel_arm(args, np, cfg, params, model)
+
+
+def _drive_burst(eng, prompts, max_new, *, np):
+    """Saturating burst shared by the ISSUE 16 arms: every request
+    queued at t=0, one thread per request. Returns per-request
+    (ttft, completion, tokens) plus the emitted token streams (for the
+    kernel arm's token-identity check)."""
+    import threading as _th
+
+    n = len(prompts)
+    ttfts = [None] * n
+    comps = [None] * n
+    streams = [None] * n
+
+    def one(i):
+        t0 = time.perf_counter()
+        first = None
+        out = []
+        for s in eng.stream(prompts[i], int(max_new), seed=i):
+            if first is None:
+                first = time.perf_counter() - t0
+            out.append(np.asarray(s))
+        ttfts[i] = first
+        comps[i] = time.perf_counter() - t0
+        streams[i] = np.concatenate(out) if out else np.zeros(0, np.int32)
+
+    threads = [_th.Thread(target=one, args=(i,)) for i in range(n)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    short = [(i, streams[i].shape[0]) for i in range(n)
+             if streams[i].shape[0] != max_new]
+    assert not short, f"short streams (i, got): {short}"
+    return ttfts, comps, wall, streams
+
+
+def _run_kv_dtype_arm(args, np, cfg, params, model):
+    """ISSUE 16 A/B: fp-paged vs int8-paged pools on the SAME HBM byte
+    budget, prefix cache OFF so every lane pays for its own pages. The
+    binding resource is page BYTES: an int8 page (codes + amortized
+    per-page scales) costs about half a bf16 page, so the equal-byte
+    int8 pool holds ~2x the pages and admits ~2x the concurrent lanes.
+    The workload is sized so a lane's admission-time page demand equals
+    its lifetime demand (the prompt's last page absorbs the whole
+    generation), making measured peak concurrency the page-capacity
+    ratio rather than an admission-timing artifact."""
+    from ray_tpu.models import gpt_decode
+    from ray_tpu.serve.engine import DecodeEngine
+
+    ps = args.page_size
+    # plen one short of a page boundary; max_new fills the rest of the
+    # final page: admit-time pages == lifetime pages == T.
+    T = 4 if args.smoke else 6
+    plen = (T - 1) * ps + 1
+    max_new = T * ps - plen
+    max_len = T * ps
+    base_lanes = 3 if args.smoke else 4      # fp lane capacity
+    fp_bytes = gpt_decode.kv_bytes_per_page(cfg, ps)
+    i8_bytes = gpt_decode.kv_bytes_per_page(cfg, ps, "int8")
+    n_pages_fp = base_lanes * T
+    n_pages_i8 = (n_pages_fp * fp_bytes) // i8_bytes   # equal bytes
+    cap_fp = n_pages_fp // T
+    cap_i8 = n_pages_i8 // T
+    slots = cap_i8 + 2                        # pages bind, not slots
+    n_req = 3 * cap_i8
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab_size, (plen,)).astype(np.int32)
+               for _ in range(n_req)]
+
+    rows = {}
+    for dt, n_pages in (("fp", n_pages_fp), ("int8", n_pages_i8)):
+        eng = DecodeEngine(
+            params, cfg, slots=slots, chunk=8, max_len=max_len,
+            prompt_buckets=(plen,), paged=True, page_size=ps,
+            n_pages=n_pages, prefix_cache=False, kv_dtype=dt,
+            deployment=f"kv_{dt}_bench")
+        try:
+            list(eng.stream(prompts[0], max_new, seed=0))   # warm
+            ttfts, comps, wall, streams = _drive_burst(
+                eng, prompts, max_new, np=np)
+            st = eng.stats()
+            rows[dt] = {
+                "metric": f"serve_{model}_kv_{dt}_mode",
+                "value": round(n_req * max_new / wall, 1),
+                "unit": "tokens/s",
+                "ttft_p50_ms": round(pct(ttfts, 0.5) * 1000, 2),
+                "completion_p50_ms": round(pct(comps, 0.5) * 1000, 2),
+                "peak_concurrent_slots": st["peak_active"],
+                "lane_capacity": n_pages // T,
+                "n_pages": n_pages, "page_size": ps,
+                "kv_bytes_per_page": fp_bytes if dt == "fp"
+                else i8_bytes,
+                "kv_bytes_per_token": st["kv_bytes_per_token"],
+                "kv_budget_bytes": n_pages_fp * fp_bytes,
+                "admissions_deferred": st["admissions_deferred"],
+                "requests": n_req, "max_new": max_new,
+                "prompt_len": plen,
+            }
+            print(json.dumps(rows[dt]))
+        finally:
+            eng.shutdown()
+    # The sizing-fix satellite, shown live: an int8 engine left to the
+    # DEFAULT n_pages computes its budget from the int8 element size
+    # and gets ~2x the pages of the same-slot fp default.
+    dflt = DecodeEngine(params, cfg, slots=base_lanes, chunk=8,
+                        max_len=max_len, prompt_buckets=(plen,),
+                        paged=True, page_size=ps, prefix_cache=False,
+                        kv_dtype="int8", deployment="kv_dflt_bench")
+    default_n_pages = dflt.n_pages
+    dflt.shutdown()
+    fp_row, i8_row = rows["fp"], rows["int8"]
+    print(json.dumps({
+        "metric": f"serve_{model}_kv_dtype_ab",
+        "value": round(i8_row["peak_concurrent_slots"]
+                       / max(fp_row["peak_concurrent_slots"], 1), 2),
+        "unit": "x_concurrent_lanes_equal_kv_bytes",
+        "lane_capacity_ratio": round(cap_i8 / max(cap_fp, 1), 2),
+        "tok_s_ratio": round(i8_row["value"]
+                             / max(fp_row["value"], 1e-9), 2),
+        "ttft_p50_ratio": round(fp_row["ttft_p50_ms"]
+                                / max(i8_row["ttft_p50_ms"], 1e-9), 2),
+        "bytes_per_token_ratio": round(
+            fp_row["kv_bytes_per_token"]
+            / max(i8_row["kv_bytes_per_token"], 1e-9), 2),
+        "default_n_pages_int8": int(default_n_pages),
+        "default_n_pages_fp_equiv": base_lanes * T,
+        "kv_budget_bytes": n_pages_fp * fp_bytes,
+        "smoke": bool(args.smoke),
+    }))
+
+
+def _run_attn_kernel_arm(args, np, cfg, params, model):
+    """ISSUE 16 A/B: paged decode with the fused paged-attention kernel
+    on vs off (XLA gather reference), same engine geometry and burst.
+    Reports TPOT p50 per arm and checks the exactness contract live:
+    at temperature 0 the two arms must emit IDENTICAL token streams.
+    On CPU the kernel runs in Pallas interpret mode — the arm proves
+    plumbing and exactness there, not speed; the TPOT ratio is the
+    headline only when lowered to a real TPU."""
+    from ray_tpu.serve.engine import DecodeEngine
+
+    ps = args.page_size
+    plen = 2 * ps                             # two pages of history
+    max_new = 8 if args.smoke else 16
+    max_len = plen + max_new + ps
+    slots = 2 if args.smoke else 4
+    n_req = slots + 1                         # one lane reuses a slot
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, cfg.vocab_size, (plen,)).astype(np.int32)
+               for _ in range(n_req)]
+
+    rows = {}
+    token_streams = {}
+    for kern in ("gather", "pallas"):
+        eng = DecodeEngine(
+            params, cfg, slots=slots, chunk=4, max_len=max_len,
+            prompt_buckets=(plen,), paged=True, page_size=ps,
+            prefix_cache=False, attn_kernel=kern,
+            deployment=f"attn_{kern}_bench")
+        try:
+            list(eng.stream(prompts[0], max_new, seed=0))   # warm
+            ttfts, comps, wall, streams = _drive_burst(
+                eng, prompts, max_new, np=np)
+            token_streams[kern] = streams
+            tpots = [(comps[i] - ttfts[i]) / max(max_new - 1, 1)
+                     for i in range(n_req)]
+            st = eng.stats()
+            rows[kern] = {
+                "metric": f"serve_{model}_attn_{kern}_mode",
+                "value": round(pct(tpots, 0.5) * 1000, 3),
+                "unit": "tpot_p50_ms",
+                "ttft_p50_ms": round(pct(ttfts, 0.5) * 1000, 2),
+                "tok_s": round(n_req * max_new / wall, 1),
+                "kernel_dispatches": st.get("attn_kernel_dispatches",
+                                            0),
+                "requests": n_req, "max_new": max_new,
+                "prompt_len": plen,
+            }
+            print(json.dumps(rows[kern]))
+        finally:
+            eng.shutdown()
+    identical = all(
+        np.array_equal(token_streams["gather"][i],
+                       token_streams["pallas"][i])
+        for i in range(n_req))
+    assert identical, "kernel arm diverged from gather at temp 0"
+    import jax as _jax
+
+    print(json.dumps({
+        "metric": f"serve_{model}_attn_kernel_ab",
+        "value": round(rows["gather"]["value"]
+                       / max(rows["pallas"]["value"], 1e-9), 2),
+        "unit": "x_tpot_gather_vs_kernel",
+        "token_identical_temp0": identical,
+        "kernel_dispatches": rows["pallas"]["kernel_dispatches"],
+        "interpret_mode": _jax.default_backend() != "tpu",
         "smoke": bool(args.smoke),
     }))
 

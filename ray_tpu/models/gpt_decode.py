@@ -126,6 +126,28 @@ and set the slot's ``pos``. Slot index, page table, and length are all
 traced: the whole handoff plane adds exactly TWO compiled programs per
 engine (one export, one import) on top of the usual set, for any
 prompt length and any flat/paged pairing.
+
+Paged-attention kernel + int8 KV (ISSUE 16): two orthogonal,
+engine-static knobs on the paged hot path. ``attn_kernel="pallas"``
+swaps the decode step's gather-then-mask attention for
+:func:`paged_attention`'s fused Pallas kernel — block-parallel over
+``(slot, pass, page)`` with the page table scalar-prefetched into the
+BlockSpec index maps, so each block streams ONE physical page from HBM
+and :data:`PT_SENTINEL`/past-``pos`` blocks are skipped outright;
+off-TPU the same kernel runs in interpret mode, so CPU tier-1
+exercises the shipping block program. The kernel is two-pass so its
+probabilities quantize to the compute dtype AFTER normalization —
+exactly where the gather path casts — which keeps kernel-on vs
+kernel-off token-identical at temp 0 and under seeded sampling.
+``kv_dtype="int8"`` stores pages as symmetric int8 codes with one f32
+scale per (layer, page, head) per side (~2x the pages in the same
+HBM at bf16): scatters become page-granular requantize-and-merge
+(:func:`_merge_span_int8` — monotone scales make rewrites drift-free,
+fresh pages reset, positions past ``pos`` stay zero so page bytes are
+canonical for digests), and every read dequantizes through
+:func:`_deq_page` at the point of use. Neither knob changes the
+compiled-program COUNT: both are baked statics selecting WHICH
+program each existing factory builds.
 """
 from __future__ import annotations
 
@@ -631,14 +653,143 @@ def jit_decode_chunk_slots(cfg: GPTConfig, k: int,
 #: sentinel: traced negative indices WRAP in jnp indexing.
 PT_SENTINEL = 2 ** 30
 
+#: KV-pool storage dtypes. ``"fp"`` stores pages in the model compute
+#: dtype; ``"int8"`` stores symmetric per-page-per-head int8 codes plus
+#: one float32 scale per (layer, page, head) per side, so the same HBM
+#: budget holds ~2x the pages at bf16 compute.
+KV_DTYPES = ("fp", "int8")
+
+#: Decode attention implementations for the paged pool. ``"gather"`` is
+#: the stock-XLA page-table gather + masked full-length attention;
+#: ``"pallas"`` is the fused block-parallel kernel (interpret mode off
+#: TPU). Both are token-identical at any temperature.
+ATTN_KERNELS = ("gather", "pallas")
+
+#: Quantization scale floor: an all-zero page quantizes (and
+#: dequantizes) to exact zeros instead of dividing by zero.
+_KV_EPS = 1e-8
+
+
+def kv_bytes_per_page(cfg: GPTConfig, page_size: int,
+                      kv_dtype: str = "fp") -> int:
+    """HBM bytes ONE physical page costs across all layers, K and V
+    sides together — the unit the engine's page budget is denominated
+    in. ``"fp"`` pages hold ``page_size * H * hd`` elements of the
+    model compute dtype per side; ``"int8"`` pages hold the same
+    element count as 1-byte codes plus one float32 scale per head per
+    side."""
+    elems = page_size * cfg.n_head * cfg.head_dim
+    if kv_dtype == "int8":
+        per_layer = 2 * (elems + 4 * cfg.n_head)
+    else:
+        per_layer = 2 * elems * jnp.dtype(cfg.dtype).itemsize
+    return cfg.n_layer * per_layer
+
+
+def _deq_page(codes: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """Dequantize int8 page codes ``[..., page_size, H, hd]`` under
+    their per-(page, head) scales ``[..., H]`` into the compute dtype.
+    The gather path and the pallas kernel both read K/V through this
+    exact expression, so the two attention implementations see
+    bit-identical inputs."""
+    return (codes.astype(jnp.float32)
+            * scales[..., None, :, None]).astype(dtype)
+
+
+def _merge_span_int8(codes: jax.Array, scales: jax.Array,
+                     vals: jax.Array, pt: jax.Array, start: jax.Array,
+                     count, active: jax.Array, page_size: int):
+    """Scatter a contiguous span of fp K (or V) rows into int8 pages.
+
+    ``vals`` ``[B, S, H, hd]`` lands at each slot's virtual positions
+    ``start[b] + i`` for ``i < count`` (decode: S = count = 1; verify:
+    S = k+1; prefill: count = traced true length ≤ S bucket). Because
+    scales are page-granular, a span write is a read-modify-write on
+    every touched page: gather the page, requantize the surviving old
+    codes, insert the new rows, scatter back. Three invariants make
+    this exact and deterministic:
+
+    - **Monotone scales.** A touched page's new scale is
+      ``max(s_old, absmax(new) / 127)`` (floored at :data:`_KV_EPS`),
+      so when the scale does not change, requantizing old codes is the
+      identity (``round(q * s / s) == q``) — repeated writes to a page
+      never drift its existing codes.
+    - **Fresh pages reset.** A page with no valid old content for this
+      slot (its page-start is at/past ``start``) takes ``s_old = 0``
+      and drops its stale codes entirely: scales and garbage left by a
+      previous tenant of the physical page never leak in.
+    - **Canonical zeros.** Positions at/past ``start + count`` in a
+      touched page are zeroed, so a page's bytes are a pure function of
+      the tokens it holds — which is what lets the handoff digest and
+      the prefix cache byte-verify quantized pages.
+
+    Only touched pages scatter back (untouched shared-prefix pages are
+    never rewritten); inactive slots and unmapped targets drop, exactly
+    like every other paged scatter in this module. Returns the updated
+    ``(codes, scales)``."""
+    B, S, H, hd = vals.shape
+    n_pages = codes.shape[0]
+    ps = page_size
+    max_pages = pt.shape[1]
+    # Pages a span of S positions can straddle (static): full pages
+    # plus a partial one at each end.
+    T = (S - 1) // ps + 2
+    vp = start[:, None] // ps + jnp.arange(T)[None, :]        # [B, T]
+    page_idx = jnp.take_along_axis(
+        pt, jnp.clip(vp, 0, max_pages - 1), axis=1)           # [B, T]
+    pstart = vp * ps
+    o = jnp.arange(ps)[None, None, :]
+    src = pstart[:, :, None] + o - start[:, None, None]       # [B, T, ps]
+    wmask = (src >= 0) & (src < count)
+    bidx = jnp.arange(B)[:, None, None]
+    new = vals.astype(jnp.float32)[bidx, jnp.clip(src, 0, S - 1)]
+    new = jnp.where(wmask[..., None, None], new, 0.0)
+    pc = jnp.clip(page_idx, 0, n_pages - 1)
+    old_c = codes[pc]                                 # [B, T, ps, H, hd]
+    old_s = scales[pc]                                # [B, T, H]
+    has_old = pstart < start[:, None]                 # [B, T]
+    old_keep = (pstart[:, :, None] + o) < start[:, None, None]
+    s_base = jnp.where(has_old[..., None], old_s, 0.0)
+    s_new = jnp.maximum(
+        jnp.maximum(s_base, jnp.abs(new).max(axis=(2, 4)) / 127.0),
+        _KV_EPS)
+    ratio = (s_base / s_new)[:, :, None, :, None]
+    old_rq = jnp.where(old_keep[..., None, None],
+                       jnp.round(old_c.astype(jnp.float32) * ratio), 0.0)
+    merged = jnp.clip(
+        jnp.where(wmask[..., None, None],
+                  jnp.round(new / s_new[:, :, None, :, None]), old_rq),
+        -127, 127).astype(jnp.int8)
+    touched = wmask.any(axis=2) & (vp < max_pages) \
+        & (page_idx < n_pages) & active[:, None]
+    page_w = jnp.where(touched, page_idx, jnp.int32(PT_SENTINEL))
+    codes = codes.at[page_w].set(merged, mode="drop")
+    scales = scales.at[page_w].set(s_new, mode="drop")
+    return codes, scales
+
 
 def init_paged_cache(cfg: GPTConfig, slots: int, n_pages: int,
-                     page_size: int) -> Cache:
+                     page_size: int, kv_dtype: str = "fp") -> Cache:
     """Paged KV pool for the continuous-batching engine: physical
     storage is page-granular (``[L, n_pages, page_size, H, hd]``), a
     slot's sequence lives wherever its page table points. ``pos`` stays
-    per-slot ``[slots]`` (virtual position, exactly as flat)."""
+    per-slot ``[slots]`` (virtual position, exactly as flat). With
+    ``kv_dtype="int8"`` the page arrays hold quantized codes and the
+    pool grows ``"ks"``/``"vs"`` per-(layer, page, head) float32
+    scales."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
     shape = (cfg.n_layer, n_pages, page_size, cfg.n_head, cfg.head_dim)
+    if kv_dtype == "int8":
+        sshape = (cfg.n_layer, n_pages, cfg.n_head)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.zeros(sshape, jnp.float32),
+            "vs": jnp.zeros(sshape, jnp.float32),
+            "pos": jnp.zeros((slots,), jnp.int32),
+        }
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -646,12 +797,201 @@ def init_paged_cache(cfg: GPTConfig, slots: int, n_pages: int,
     }
 
 
+def _pallas_interpret() -> bool:
+    """Pallas lowers natively only on TPU; everywhere else (CPU tier-1,
+    dev boxes) the kernel runs in interpret mode — same grid, same
+    block program, emulated through XLA — so tests exercise the exact
+    kernel logic that ships."""
+    return jax.default_backend() != "tpu"
+
+
+def paged_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
+                    pt: jax.Array, pos: jax.Array, *, page_size: int,
+                    kernel: str = "gather", ks=None, vs=None
+                    ) -> jax.Array:
+    """One decode-step of paged attention: each slot's single query
+    ``q [B, 1, H, hd]`` attends over its virtual sequence (the pages
+    mapped by its page-table row ``pt [B, max_pages]``), valid at
+    positions ``<= pos[b]``. Returns the attention context
+    ``[B, 1, H, hd]`` in ``q.dtype``.
+
+    ``kernel="gather"`` is the reference path: gather every mapped page
+    into virtual order and run masked full-length attention (sentinel
+    entries clip to an arbitrary real page whose garbage the mask
+    hides). ``kernel="pallas"`` fuses the gather, the length masking,
+    and the softmax into one block-parallel kernel over the grid
+    ``(B, 2, max_pages)`` with the page table scalar-prefetched: each
+    block reads ONE physical page straight from the pool (no gathered
+    copy), and blocks whose page is :data:`PT_SENTINEL`-unmapped or
+    wholly past ``pos[b]`` are skipped entirely, so the kernel does
+    O(pages actually held) work instead of O(max_pages).
+
+    The kernel is two-pass (pass 0: running max + rescaled exp-sum;
+    pass 1: normalize, cast the probabilities to the compute dtype,
+    accumulate p·v in f32) — the SAME quantize-after-normalize order as
+    the gather path's ``softmax(...).astype(dtype)``, so the two paths
+    differ only by f32 summation order, far below the compute dtype's
+    resolution. That is what makes kernel-on vs kernel-off
+    token-identical in practice at temp 0 AND under seeded sampling.
+
+    With int8 pools pass ``ks``/``vs`` (per-(page, head) scales); both
+    paths dequantize through :func:`_deq_page` semantics at the point
+    of use, so the kernel/gather identity holds quantized too."""
+    if kernel == "pallas":
+        return _paged_attention_pallas(q, kc, vc, pt, pos, page_size,
+                                       ks, vs)
+    return _paged_attention_gather(q, kc, vc, pt, pos, page_size,
+                                   ks, vs)
+
+
+def _paged_attention_gather(q, kc, vc, pt, pos, page_size, ks, vs):
+    """Reference paged attention: page-table gather + masked
+    full-length softmax, verbatim the ISSUE 6 decode math (with an
+    int8 dequant at the gather when scales are supplied)."""
+    B = q.shape[0]
+    H, hd = q.shape[2], q.shape[3]
+    n_pages = kc.shape[0]
+    max_pages = pt.shape[1]
+    V = max_pages * page_size
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    ptc = jnp.clip(pt, 0, n_pages - 1)
+    hk = kc[ptc]
+    hv = vc[ptc]
+    if ks is not None:
+        hk = _deq_page(hk, ks[ptc], q.dtype)
+        hv = _deq_page(hv, vs[ptc], q.dtype)
+    hk = hk.reshape(B, V, H, hd)
+    hv = hv.reshape(B, V, H, hd)
+    valid = (jnp.arange(V)[None, :] <= pos[:, None])[:, None, None, :]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, hk,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, hv,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _paged_attention_pallas(q, kc, vc, pt, pos, page_size, ks, vs):
+    """Fused paged-attention kernel (see :func:`paged_attention`).
+
+    Grid ``(B, 2, max_pages)``: slot-major, two softmax passes, one
+    block per page-table column. ``pt``/``pos`` ride as scalar-prefetch
+    operands so the BlockSpec index maps can steer each block's HBM
+    read to the physical page — an unmapped column still *indexes* page
+    0 (clipped) but its block body is skipped, so only the (cheap,
+    unread) prefetch touches it. VMEM scratch carries the running max
+    ``m [H, 1]``, exp-sum ``l [H, 1]`` and f32 accumulator
+    ``acc [H, hd]`` across the slot's grid steps; the output block is
+    written once, on the slot's last step."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = q.shape[0]
+    H, hd = q.shape[2], q.shape[3]
+    n_pages = kc.shape[0]
+    ps = page_size
+    max_pages = pt.shape[1]
+    quant = ks is not None
+    dtype = q.dtype
+    # Python float (f32-exact) so the kernel closure stays constant-free;
+    # matches the gather path's f32(1/sqrt(hd)) bit-for-bit.
+    scale = float(np.float32(1.0) / np.sqrt(np.float32(hd)))
+
+    def kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
+        b = pl.program_id(0)
+        phase = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when((phase == 0) & (j == 0))
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, -1e30)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        # Skip condition: unmapped column, or page wholly past pos[b].
+        # A processed page always holds >= 1 valid position.
+        live = (pt_ref[b, j] != PT_SENTINEL) & (j * ps <= pos_ref[b])
+
+        def logits():
+            kv = k_ref[0]                              # [ps, H, hd]
+            if quant:
+                kv = (kv.astype(jnp.float32)
+                      * ks_ref[0][None, :, None]).astype(dtype)
+            lg = jnp.einsum("hd,phd->hp", q_ref[0], kv,
+                            preferred_element_type=jnp.float32) * scale
+            vpos = j * ps + lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+            return jnp.where(vpos <= pos_ref[b], lg, -1e30)
+
+        @pl.when(live & (phase == 0))
+        def _stats():
+            lg = logits()
+            m_prev = m_ref[...]
+            m_new = jnp.maximum(m_prev, lg.max(axis=1, keepdims=True))
+            l_ref[...] = (l_ref[...] * jnp.exp(m_prev - m_new)
+                          + jnp.exp(lg - m_new).sum(axis=1,
+                                                    keepdims=True))
+            m_ref[...] = m_new
+
+        @pl.when(live & (phase == 1))
+        def _accum():
+            lg = logits()
+            p = (jnp.exp(lg - m_ref[...]) / l_ref[...]).astype(dtype)
+            vv = v_ref[0]
+            if quant:
+                vv = (vv.astype(jnp.float32)
+                      * vs_ref[0][None, :, None]).astype(dtype)
+            acc_ref[...] += jnp.einsum(
+                "hp,phd->hd", p, vv,
+                preferred_element_type=jnp.float32)
+
+        @pl.when((phase == 1) & (j == max_pages - 1))
+        def _emit():
+            o_ref[0] = acc_ref[...].astype(dtype)
+
+    def page_map(b, phase, j, pt_s, pos_s):
+        return (jnp.clip(pt_s[b, j], 0, n_pages - 1), 0, 0, 0)
+
+    def scale_map(b, phase, j, pt_s, pos_s):
+        return (jnp.clip(pt_s[b, j], 0, n_pages - 1), 0)
+
+    def slot_map(b, phase, j, pt_s, pos_s):
+        return (b, 0, 0)
+
+    in_specs = [pl.BlockSpec((1, H, hd), slot_map),
+                pl.BlockSpec((1, ps, H, hd), page_map),
+                pl.BlockSpec((1, ps, H, hd), page_map)]
+    inputs = [q[:, 0], kc, vc]
+    if quant:
+        in_specs += [pl.BlockSpec((1, H), scale_map),
+                     pl.BlockSpec((1, H), scale_map)]
+        inputs += [ks, vs]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, 2, max_pages),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, H, hd), slot_map),
+            scratch_shapes=[pltpu.VMEM((H, 1), jnp.float32),
+                            pltpu.VMEM((H, 1), jnp.float32),
+                            pltpu.VMEM((H, hd), jnp.float32)]),
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), dtype),
+        interpret=_pallas_interpret(),
+    )(pt, pos, *inputs)
+    return out[:, None]
+
+
 def prefill_into_slot_paged(params: Params, cache: Cache,
                             tokens: jax.Array, length: jax.Array,
                             hist_len: jax.Array, pt_row: jax.Array,
                             cow_src: jax.Array, slot: jax.Array,
                             rng: jax.Array, *, cfg: GPTConfig,
-                            page_size: int, temperature: float = 0.0
+                            page_size: int, temperature: float = 0.0,
+                            kv_dtype: str = "fp"
                             ) -> Tuple[jax.Array, Cache, jax.Array]:
     """Prefill one prompt **suffix** into its page-table pages, fused
     with an optional copy-on-write fork and the first-token sample.
@@ -666,6 +1006,14 @@ def prefill_into_slot_paged(params: Params, cache: Cache,
     cached prefix that ends mid-page; pass :data:`PT_SENTINEL` for
     none): the copy is a masked in-program page copy, so COW costs zero
     extra compiled programs.
+
+    With ``kv_dtype="int8"`` the COW fork copies codes AND scales, the
+    history view dequantizes through :func:`_deq_page`, and the suffix
+    K/V land through :func:`_merge_span_int8` (page-granular
+    requantize-and-merge) instead of a per-position scatter; the block
+    math itself — including the suffix tokens' self-attention — runs on
+    the exact fp K/V, so the first sampled token is independent of the
+    quantizer.
 
     Suffix tokens sit at absolute positions ``hist_len + i`` and attend
     over (a) the history read through the page table, valid where the
@@ -699,13 +1047,25 @@ def prefill_into_slot_paged(params: Params, cache: Cache,
                                         mode="drop")
     vpool = cache["v"].at[:, dst_w].set(cache["v"][:, src_c],
                                         mode="drop")
+    quant = kv_dtype == "int8"
+    if quant:
+        kscale = cache["ks"].at[:, dst_w].set(cache["ks"][:, src_c],
+                                              mode="drop")
+        vscale = cache["vs"].at[:, dst_w].set(cache["vs"][:, src_c],
+                                              mode="drop")
 
     # History view through the page table: [L, V, H, hd] in virtual
     # order. Sentinel entries clip to page n_pages-1; their positions
     # are >= hist_len and masked below.
     ptc = jnp.clip(pt_row, 0, n_pages - 1)
-    hk = kpool[:, ptc].reshape(L, V, H, hd)
-    hv = vpool[:, ptc].reshape(L, V, H, hd)
+    if quant:
+        hk = _deq_page(kpool[:, ptc], kscale[:, ptc],
+                       cfg.dtype).reshape(L, V, H, hd)
+        hv = _deq_page(vpool[:, ptc], vscale[:, ptc],
+                       cfg.dtype).reshape(L, V, H, hd)
+    else:
+        hk = kpool[:, ptc].reshape(L, V, H, hd)
+        hv = vpool[:, ptc].reshape(L, V, H, hd)
     hist_valid = (jnp.arange(V) < hist_len)[None, None, None, :]
     self_mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
 
@@ -738,6 +1098,17 @@ def prefill_into_slot_paged(params: Params, cache: Cache,
     # Suffix K/V writes, scattered page-wise: token i lands at virtual
     # position hist_len + i → (pt_row[vpos // ps], vpos % ps). Pad
     # positions (i >= length) target the sentinel and are dropped.
+    pos = lax.dynamic_update_slice(
+        cache["pos"], jnp.reshape(hist_len + length, (1,)), (slot,))
+    if quant:
+        one = jnp.ones((1,), jnp.bool_)
+        merge = jax.vmap(lambda c, s, vl: _merge_span_int8(
+            c, s, vl[None], pt_row[None],
+            jnp.reshape(hist_len, (1,)), length, one, ps))
+        kpool, kscale = merge(kpool, kscale, k_new)
+        vpool, vscale = merge(vpool, vscale, v_new)
+        return token[0], {"k": kpool, "v": vpool, "ks": kscale,
+                          "vs": vscale, "pos": pos}, rng
     wpos = hist_len + jnp.arange(S)
     vp = wpos // ps
     page_idx = pt_row[jnp.clip(vp, 0, max_pages - 1)]
@@ -746,68 +1117,78 @@ def prefill_into_slot_paged(params: Params, cache: Cache,
     off = wpos % ps
     kpool = kpool.at[:, page_w, off].set(k_new, mode="drop")
     vpool = vpool.at[:, page_w, off].set(v_new, mode="drop")
-    pos = lax.dynamic_update_slice(
-        cache["pos"], jnp.reshape(hist_len + length, (1,)), (slot,))
     return token[0], {"k": kpool, "v": vpool, "pos": pos}, rng
 
 
 def _slot_decode_step_paged(params: Params, cache: Cache,
                             token: jax.Array, active: jax.Array,
                             pt: jax.Array, cfg: GPTConfig,
-                            page_size: int) -> Tuple[jax.Array, Cache]:
+                            page_size: int, kv_dtype: str = "fp",
+                            attn_kernel: str = "gather"
+                            ) -> Tuple[jax.Array, Cache]:
     """Paged twin of :func:`_slot_decode_step`: each active slot writes
     its new K/V at ``(pt[b, pos[b] // ps], pos[b] % ps)`` (scatter with
     drop semantics — an unmapped write target is discarded, never
-    clamped into another slot's page) and attends over its virtual
-    sequence gathered through its page-table row, valid ``<= pos[b]``.
+    clamped into another slot's page; int8 pools merge through
+    :func:`_merge_span_int8` instead) and attends over its virtual
+    sequence via :func:`paged_attention`, valid ``<= pos[b]``.
     Inactive slots neither write nor advance."""
     B = token.shape[0]
-    H, hd = cfg.n_head, cfg.head_dim
-    n_pages = cache["k"].shape[1]
     ps = page_size
     max_pages = pt.shape[1]
-    V = max_pages * ps
     pos = cache["pos"]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    quant = kv_dtype == "int8"
     x = params["embed"]["kernel"].astype(cfg.dtype)[token][:, None]
     x = x + jnp.take(params["pos_embed"],
                      jnp.clip(pos, 0, params["pos_embed"].shape[0] - 1),
                      axis=0).astype(cfg.dtype)[:, None]
-    ar = jnp.arange(V)
-    valid = (ar[None, :] <= pos[:, None])[:, None, None, :]
     vp = pos // ps
     page_idx = jnp.take_along_axis(
         pt, jnp.clip(vp, 0, max_pages - 1)[:, None], axis=1)[:, 0]
     page_w = jnp.where(active & (vp < max_pages), page_idx,
                        jnp.int32(PT_SENTINEL))
     off = pos % ps
-    ptc = jnp.clip(pt, 0, n_pages - 1)       # [B, max_pages]
+    xs = (params["block"], cache["k"], cache["v"])
+    if quant:
+        xs = xs + (cache["ks"], cache["vs"])
 
     def body(carry, layer):
         x = carry
-        p, kc, vc = layer                    # [n_pages, ps, H, hd]
+        if quant:
+            p, kc, vc, ksc, vsc = layer      # [n_pages, ps, H, hd]
+        else:
+            p, kc, vc = layer
+            ksc = vsc = None
         q, k, v = _block_kv(x, p, cfg)       # [B, 1, H, hd]
-        kc = kc.at[page_w, off].set(k[:, 0], mode="drop")
-        vc = vc.at[page_w, off].set(v[:, 0], mode="drop")
-        hk = kc[ptc].reshape(B, V, H, hd)
-        hv = vc[ptc].reshape(B, V, H, hd)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, hk,
-                            preferred_element_type=jnp.float32) * scale
-        logits = jnp.where(valid, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, hv,
-                         preferred_element_type=jnp.float32
-                         ).astype(q.dtype).reshape(B, 1, cfg.d_model)
-        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
+        if quant:
+            kc, ksc = _merge_span_int8(kc, ksc, k, pt, pos, 1,
+                                       active, ps)
+            vc, vsc = _merge_span_int8(vc, vsc, v, pt, pos, 1,
+                                       active, ps)
+        else:
+            kc = kc.at[page_w, off].set(k[:, 0], mode="drop")
+            vc = vc.at[page_w, off].set(v[:, 0], mode="drop")
+        att = paged_attention(q, kc, vc, pt, pos, page_size=ps,
+                              kernel=attn_kernel, ks=ksc, vs=vsc)
+        x = x + _mm(att.reshape(B, 1, cfg.d_model), p["wo"]["kernel"],
+                    cfg.dtype)
         x = _ffn(x, p, cfg)
+        if quant:
+            return x, (kc, vc, ksc, vsc)
         return x, (kc, vc)
 
-    x, (k_new, v_new) = lax.scan(
-        body, x, (params["block"], cache["k"], cache["v"]))
+    if quant:
+        x, (k_new, v_new, ks_new, vs_new) = lax.scan(body, x, xs)
+        cache_out = {"k": k_new, "v": v_new, "ks": ks_new,
+                     "vs": vs_new,
+                     "pos": pos + active.astype(jnp.int32)}
+    else:
+        x, (k_new, v_new) = lax.scan(body, x, xs)
+        cache_out = {"k": k_new, "v": v_new,
+                     "pos": pos + active.astype(jnp.int32)}
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = _project_vocab(x, params["embed"]["kernel"], cfg)
-    return logits[:, 0], {"k": k_new, "v": v_new,
-                          "pos": pos + active.astype(jnp.int32)}
+    return logits[:, 0], cache_out
 
 
 def decode_chunk_slots_paged(params: Params, cache: Cache,
@@ -815,12 +1196,17 @@ def decode_chunk_slots_paged(params: Params, cache: Cache,
                              active: jax.Array, pt: jax.Array, *,
                              cfg: GPTConfig, k: int, page_size: int,
                              temperature: float = 0.0,
-                             eos_token: int = -1):
+                             eos_token: int = -1,
+                             kv_dtype: str = "fp",
+                             attn_kernel: str = "gather"):
     """Paged twin of :func:`decode_chunk_slots`: k fused steps in ONE
     program with the page table held constant through the chunk (the
     engine maps pages covering ``pos + k`` before dispatching — a slot
     that cannot be covered is parked out of ``active`` instead). EOS
-    mask-and-carry and per-slot PRNG lanes are identical to flat."""
+    mask-and-carry and per-slot PRNG lanes are identical to flat.
+    ``kv_dtype``/``attn_kernel`` select the pool layout and attention
+    implementation per :func:`paged_attention` — both are STATIC knobs
+    baked into the compiled program, never retrace triggers."""
     B = token.shape[0]
     eos = jnp.asarray(eos_token, jnp.int32)
     done0 = (active & (token == eos)) if eos_token >= 0 \
@@ -830,7 +1216,8 @@ def decode_chunk_slots_paged(params: Params, cache: Cache,
         cache, tok, done, keys = carry
         logits, cache = _slot_decode_step_paged(params, cache, tok,
                                                 active, pt, cfg,
-                                                page_size)
+                                                page_size, kv_dtype,
+                                                attn_kernel)
         nxt, keys = _sample_slots(logits, temperature, keys)
         if eos_token >= 0:
             nxt = jnp.where(done, eos, nxt)
@@ -845,14 +1232,18 @@ def decode_chunk_slots_paged(params: Params, cache: Cache,
 # rtlint: program-budget: len(prompt_buckets)
 @functools.lru_cache(maxsize=64)
 def jit_prefill_into_slot_paged(cfg: GPTConfig, page_size: int,
-                                temperature: float = 0.0):
+                                temperature: float = 0.0,
+                                kv_dtype: str = "fp"):
     """Jitted :func:`prefill_into_slot_paged`; one compiled program per
     SUFFIX bucket — prefix-hit depth (``hist_len``), page-table
     contents, and COW source are all traced, so shared-prefix admission
-    never retraces. Pool donated as in :func:`jit_prefill_into_slot`."""
+    never retraces. ``kv_dtype`` is an engine-level static baked into
+    the same program set (it changes the pool layout, not the program
+    COUNT). Pool donated as in :func:`jit_prefill_into_slot`."""
     return jax.jit(functools.partial(prefill_into_slot_paged, cfg=cfg,
                                      page_size=page_size,
-                                     temperature=temperature),
+                                     temperature=temperature,
+                                     kv_dtype=kv_dtype),
                    donate_argnums=(1,))
 
 
@@ -860,14 +1251,45 @@ def jit_prefill_into_slot_paged(cfg: GPTConfig, page_size: int,
 @functools.lru_cache(maxsize=64)
 def jit_decode_chunk_slots_paged(cfg: GPTConfig, k: int, page_size: int,
                                  temperature: float = 0.0,
-                                 eos_token: int = -1):
+                                 eos_token: int = -1,
+                                 kv_dtype: str = "fp",
+                                 attn_kernel: str = "gather"):
     """Jitted :func:`decode_chunk_slots_paged`: ONE program per (pool
-    shape, k, page_size) — the page table is data. Pool donated."""
+    shape, k, page_size) — the page table is data, and the
+    ``kv_dtype``/``attn_kernel`` knobs are engine-level statics that
+    select WHICH one program is built, never additional ones. Pool
+    donated."""
     return jax.jit(functools.partial(decode_chunk_slots_paged, cfg=cfg,
                                      k=k, page_size=page_size,
                                      temperature=temperature,
-                                     eos_token=eos_token),
+                                     eos_token=eos_token,
+                                     kv_dtype=kv_dtype,
+                                     attn_kernel=attn_kernel),
                    donate_argnums=(1,))
+
+
+# rtlint: program-budget: 1
+@functools.lru_cache(maxsize=64)
+def jit_paged_attention(cfg: GPTConfig, page_size: int,
+                        attn_kernel: str = "gather",
+                        kv_dtype: str = "fp"):
+    """Jitted standalone :func:`paged_attention` (test/benchmark
+    surface; the engine hot path reaches the kernel through
+    :func:`jit_decode_chunk_slots_paged`): ONE program per (pool shape,
+    page_size, kernel, kv_dtype) — page tables and positions are
+    traced data. int8 wrappers take ``(q, kc, vc, pt, pos, ks, vs)``,
+    fp wrappers ``(q, kc, vc, pt, pos)``."""
+    if kv_dtype == "int8":
+        def fn(q, kc, vc, pt, pos, ks, vs):
+            return paged_attention(q, kc, vc, pt, pos,
+                                   page_size=page_size,
+                                   kernel=attn_kernel, ks=ks, vs=vs)
+    else:
+        def fn(q, kc, vc, pt, pos):
+            return paged_attention(q, kc, vc, pt, pos,
+                                   page_size=page_size,
+                                   kernel=attn_kernel)
+    return jax.jit(fn)
 
 
 # ------------------------------------------------------ speculative verify
@@ -1006,7 +1428,8 @@ def verify_chunk_slots_paged(params: Params, cache: Cache,
                              token: jax.Array, draft: jax.Array,
                              rngs: jax.Array, active: jax.Array,
                              pt: jax.Array, *, cfg: GPTConfig, k: int,
-                             page_size: int, temperature: float = 0.0):
+                             page_size: int, temperature: float = 0.0,
+                             kv_dtype: str = "fp"):
     """Paged twin of :func:`verify_chunk_slots`: K/V writes scatter at
     ``(pt[b, (pos+i) // ps], (pos+i) % ps)`` with drop semantics (an
     unmapped or inactive target is discarded, never clamped into
@@ -1014,7 +1437,12 @@ def verify_chunk_slots_paged(params: Params, cache: Cache,
     holds committed tokens, so rollback is just the smaller ``pos``),
     and each query attends its virtual sequence gathered through its
     page-table row, valid ``<= pos + i``. Acceptance math, variable
-    advance, and PRNG discipline are identical to flat."""
+    advance, and PRNG discipline are identical to flat. int8 pools
+    merge the k+1 drafted rows through :func:`_merge_span_int8` and
+    read them back dequantized — so accept/reject decisions are made
+    on exactly the K/V any later decode step will see; a rejected
+    span's codes past the rolled-back ``pos`` are re-zeroed by the
+    next write to that page (the merge's canonical-zeros invariant)."""
     B = token.shape[0]
     S = k + 1
     H, hd = cfg.n_head, cfg.head_dim
@@ -1040,15 +1468,33 @@ def verify_chunk_slots_paged(params: Params, cache: Cache,
     ptc = jnp.clip(pt, 0, n_pages - 1)                 # [B, max_pages]
     arv = jnp.arange(V)
     valid = arv[None, None, None, :] <= positions[:, None, :, None]
+    quant = kv_dtype == "int8"
+    xs = (params["block"], cache["k"], cache["v"])
+    if quant:
+        xs = xs + (cache["ks"], cache["vs"])
 
     def body(carry, layer):
         x = carry
-        p, kc, vc = layer                    # [n_pages, ps, H, hd]
+        if quant:
+            p, kc, vc, ksc, vsc = layer      # [n_pages, ps, H, hd]
+        else:
+            p, kc, vc = layer
+            ksc = vsc = None
         q, kk, vv = _block_kv(x, p, cfg)     # [B, S, H, hd]
-        kc = kc.at[page_w, off].set(kk, mode="drop")
-        vc = vc.at[page_w, off].set(vv, mode="drop")
-        hk = kc[ptc].reshape(B, V, H, hd)
-        hv = vc[ptc].reshape(B, V, H, hd)
+        if quant:
+            kc, ksc = _merge_span_int8(kc, ksc, kk, pt, pos, S,
+                                       active, ps)
+            vc, vsc = _merge_span_int8(vc, vsc, vv, pt, pos, S,
+                                       active, ps)
+            hk = _deq_page(kc[ptc], ksc[ptc],
+                           q.dtype).reshape(B, V, H, hd)
+            hv = _deq_page(vc[ptc], vsc[ptc],
+                           q.dtype).reshape(B, V, H, hd)
+        else:
+            kc = kc.at[page_w, off].set(kk, mode="drop")
+            vc = vc.at[page_w, off].set(vv, mode="drop")
+            hk = kc[ptc].reshape(B, V, H, hd)
+            hv = vc[ptc].reshape(B, V, H, hd)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, hk,
                             preferred_element_type=jnp.float32) * scale
         logits = jnp.where(valid, logits, -1e30)
@@ -1058,16 +1504,24 @@ def verify_chunk_slots_paged(params: Params, cache: Cache,
                          ).astype(q.dtype).reshape(B, S, cfg.d_model)
         x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
         x = _ffn(x, p, cfg)
+        if quant:
+            return x, (kc, vc, ksc, vsc)
         return x, (kc, vc)
 
-    x, (k_new, v_new) = lax.scan(
-        body, x, (params["block"], cache["k"], cache["v"]))
+    if quant:
+        x, (k_new, v_new, ks_new, vs_new) = lax.scan(body, x, xs)
+    else:
+        x, (k_new, v_new) = lax.scan(body, x, xs)
     x = _rmsnorm(x, params["ln_f_scale"])
     logits = _project_vocab(x, params["embed"]["kernel"], cfg)
     committed, n_acc, rngs = _spec_accept(logits, draft, rngs,
                                           temperature, k)
     pos2 = pos + (1 + n_acc) * active.astype(jnp.int32)
-    return committed, n_acc, {"k": k_new, "v": v_new, "pos": pos2}, rngs
+    cache_out = {"k": k_new, "v": v_new, "pos": pos2}
+    if quant:
+        cache_out["ks"] = ks_new
+        cache_out["vs"] = vs_new
+    return committed, n_acc, cache_out, rngs
 
 
 # ------------------------------------------------------- KV handoff (ship)
@@ -1091,14 +1545,17 @@ def export_slot_kv(cache: Cache, slot: jax.Array, *, cfg: GPTConfig
 
 
 def export_slot_kv_paged(cache: Cache, pt_row: jax.Array, *,
-                         cfg: GPTConfig, page_size: int
-                         ) -> Tuple[jax.Array, jax.Array]:
+                         cfg: GPTConfig, page_size: int,
+                         kv_dtype: str = "fp"):
     """Paged twin of :func:`export_slot_kv`: gather the slot's pages
     through its page-table row into virtual order — ``(k, v)`` each
     ``[L, max_pages * page_size, H, hd]``. Sentinel entries clip to a
     real page whose garbage sits past ``pos`` and is trimmed by the
     host before shipping, exactly like flat pad positions. The
-    page-table CONTENTS are traced data: one program per pool shape."""
+    page-table CONTENTS are traced data: one program per pool shape.
+    int8 pools additionally return the gathered per-page scales
+    ``(k, v, ks, vs)`` — the handoff ships codes + scales and the
+    digest covers both."""
     L = cache["k"].shape[0]
     n_pages = cache["k"].shape[1]
     H, hd = cfg.n_head, cfg.head_dim
@@ -1107,6 +1564,8 @@ def export_slot_kv_paged(cache: Cache, pt_row: jax.Array, *,
     ptc = jnp.clip(pt_row, 0, n_pages - 1)
     k = cache["k"][:, ptc].reshape(L, V, H, hd)
     v = cache["v"][:, ptc].reshape(L, V, H, hd)
+    if kv_dtype == "int8":
+        return k, v, cache["ks"][:, ptc], cache["vs"][:, ptc]
     return k, v
 
 
@@ -1134,14 +1593,17 @@ def import_slot_kv(cache: Cache, k_row: jax.Array, v_row: jax.Array,
 def import_slot_kv_paged(cache: Cache, k_pages: jax.Array,
                          v_pages: jax.Array, pt_row: jax.Array,
                          slot: jax.Array, length: jax.Array, *,
-                         cfg: GPTConfig, page_size: int) -> Cache:
+                         cfg: GPTConfig, page_size: int,
+                         ks_pages=None, vs_pages=None) -> Cache:
     """Paged twin of :func:`import_slot_kv`: scatter shipped K/V into
     the pool pages mapped by ``pt_row``. ``k_pages``/``v_pages`` are
     ``[L, max_pages, page_size, H, hd]`` (host-padded to the full table
     width — one program per pool shape); pages the host never mapped
     (``pt_row`` sentinel, or wholly past ``length``) are DROPPED, never
     clamped into another slot's page — the same write discipline as
-    every other paged scatter in this module."""
+    every other paged scatter in this module. For int8 pools the
+    shipped per-page scales ride in ``ks_pages``/``vs_pages``
+    ``[L, max_pages, H]`` and scatter under the same mask."""
     n_pages = cache["k"].shape[1]
     max_pages = pt_row.shape[0]
     ar = jnp.arange(max_pages)
@@ -1151,7 +1613,11 @@ def import_slot_kv_paged(cache: Cache, k_pages: jax.Array,
     vp = cache["v"].at[:, page_w].set(v_pages, mode="drop")
     pos = lax.dynamic_update_slice(cache["pos"],
                                    jnp.reshape(length, (1,)), (slot,))
-    return {"k": kp, "v": vp, "pos": pos}
+    out = {"k": kp, "v": vp, "pos": pos}
+    if ks_pages is not None:
+        out["ks"] = cache["ks"].at[:, page_w].set(ks_pages, mode="drop")
+        out["vs"] = cache["vs"].at[:, page_w].set(vs_pages, mode="drop")
+    return out
 
 
 # rtlint: program-budget: 1
@@ -1164,11 +1630,14 @@ def jit_export_slot_kv(cfg: GPTConfig):
 
 # rtlint: program-budget: 1
 @functools.lru_cache(maxsize=64)
-def jit_export_slot_kv_paged(cfg: GPTConfig, page_size: int):
+def jit_export_slot_kv_paged(cfg: GPTConfig, page_size: int,
+                             kv_dtype: str = "fp"):
     """Jitted :func:`export_slot_kv_paged`: ONE program per (pool
-    shape, page_size) — the page table is data. NOT donated."""
+    shape, page_size, kv_dtype) — the page table is data. NOT
+    donated."""
     return jax.jit(functools.partial(export_slot_kv_paged, cfg=cfg,
-                                     page_size=page_size))
+                                     page_size=page_size,
+                                     kv_dtype=kv_dtype))
 
 
 # rtlint: program-budget: 1
@@ -1183,9 +1652,19 @@ def jit_import_slot_kv(cfg: GPTConfig):
 
 # rtlint: program-budget: 1
 @functools.lru_cache(maxsize=64)
-def jit_import_slot_kv_paged(cfg: GPTConfig, page_size: int):
+def jit_import_slot_kv_paged(cfg: GPTConfig, page_size: int,
+                             kv_dtype: str = "fp"):
     """Jitted :func:`import_slot_kv_paged`: ONE program per (pool
-    shape, page_size). Pool donated."""
+    shape, page_size, kv_dtype) — int8 wrappers take the shipped
+    scales as trailing positional args. Pool donated."""
+    if kv_dtype == "int8":
+        def fn(cache, k_pages, v_pages, ks_pages, vs_pages, pt_row,
+               slot, length):
+            return import_slot_kv_paged(
+                cache, k_pages, v_pages, pt_row, slot, length, cfg=cfg,
+                page_size=page_size, ks_pages=ks_pages,
+                vs_pages=vs_pages)
+        return jax.jit(fn, donate_argnums=(0,))
     return jax.jit(functools.partial(import_slot_kv_paged, cfg=cfg,
                                      page_size=page_size),
                    donate_argnums=(0,))
@@ -1208,10 +1687,13 @@ def jit_verify_chunk_slots(cfg: GPTConfig, k: int,
 # rtlint: program-budget: 1
 @functools.lru_cache(maxsize=64)
 def jit_verify_chunk_slots_paged(cfg: GPTConfig, k: int, page_size: int,
-                                 temperature: float = 0.0):
+                                 temperature: float = 0.0,
+                                 kv_dtype: str = "fp"):
     """Jitted :func:`verify_chunk_slots_paged`: ONE program per (pool
-    shape, k, page_size) — the page table is data. Pool donated."""
+    shape, k, page_size, kv_dtype) — the page table is data. Pool
+    donated."""
     return jax.jit(functools.partial(verify_chunk_slots_paged, cfg=cfg,
                                      k=k, page_size=page_size,
-                                     temperature=temperature),
+                                     temperature=temperature,
+                                     kv_dtype=kv_dtype),
                    donate_argnums=(1,))

@@ -500,11 +500,19 @@ class ServeController:
                                 "admissions_deferred", "lane_parks",
                                 "preempted", "prefix_tokens_reused",
                                 "active_slots", "slots", "queue_depth",
-                                "resumed", "driver_restarts"):
+                                "resumed", "driver_restarts",
+                                "attn_kernel_dispatches"):
                         if key in est:
                             engine[key] = engine.get(key, 0) + est[key]
                     engine["paged"] = engine.get("paged", False) \
                         or bool(est.get("paged"))
+                    # Kernel/quantization identity (ISSUE 16): config,
+                    # not counters — pass through, don't sum. Replicas
+                    # of one deployment share the knobs, so last wins.
+                    for key in ("attn_kernel", "kv_dtype",
+                                "kv_bytes_per_token"):
+                        if key in est:
+                            engine[key] = est[key]
                     sp = est.get("spec")
                     if sp:
                         agg = engine.setdefault(

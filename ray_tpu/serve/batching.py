@@ -350,7 +350,9 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
           continuous: bool = False, page_size: Optional[int] = None,
           prefix_cache: Optional[bool] = None, spec_decode=None,
           draft_k: Optional[int] = None,
-          spec_threshold: Optional[float] = None):
+          spec_threshold: Optional[float] = None,
+          attn_kernel: Optional[str] = None,
+          kv_dtype: Optional[str] = None):
     """Decorator: turn a ``List[T] -> List[R]`` handler into a ``T -> R``
     callable that transparently batches concurrent callers.
 
@@ -387,8 +389,9 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
         def __call__(self, request):
             return self.decode(request)       # iterator of [j] slices
 
-    ``page_size=`` / ``prefix_cache=`` (continuous only) are the paged
-    KV-cache knobs, and ``spec_decode=`` / ``draft_k=`` the speculative
+    ``page_size=`` / ``prefix_cache=`` / ``attn_kernel=`` /
+    ``kv_dtype=`` (continuous only) are the paged KV-cache knobs, and
+    ``spec_decode=`` / ``draft_k=`` the speculative
     decoding knobs, applied to the handler's engine via
     :meth:`~.engine.DecodeEngine.apply_config` on first use: a
     flat-constructed engine is repaged / given a drafter before traffic
@@ -403,10 +406,13 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                            or prefix_cache is not None
                            or spec_decode is not None
                            or draft_k is not None
-                           or spec_threshold is not None):
+                           or spec_threshold is not None
+                           or attn_kernel is not None
+                           or kv_dtype is not None):
         raise ValueError(
-            "page_size/prefix_cache/spec_decode/draft_k/spec_threshold "
-            "are decode-engine knobs; they require continuous=True")
+            "page_size/prefix_cache/spec_decode/draft_k/spec_threshold/"
+            "attn_kernel/kv_dtype are decode-engine knobs; they "
+            "require continuous=True")
     if buckets is not None:
         bs = sorted(int(b) for b in buckets)
         if not bs or bs[0] < 1:
@@ -427,7 +433,8 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
         if continuous:
             return _decorate_continuous(fn, page_size, prefix_cache,
                                         spec_decode, draft_k,
-                                        spec_threshold)
+                                        spec_threshold, attn_kernel,
+                                        kv_dtype)
         cfg = (max_batch_size, batch_wait_timeout_s, pad_to_bucket,
                tuple(buckets) if buckets else None, stream)
         key = (getattr(fn, "__module__", ""), getattr(fn, "__qualname__", ""))
@@ -463,7 +470,9 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
 def _decorate_continuous(fn, page_size: Optional[int] = None,
                          prefix_cache: Optional[bool] = None,
                          spec_decode=None, draft_k: Optional[int] = None,
-                         spec_threshold: Optional[float] = None):
+                         spec_threshold: Optional[float] = None,
+                         attn_kernel: Optional[str] = None,
+                         kv_dtype: Optional[str] = None):
     """Engine-backed admission path: per request, the handler maps the
     item to ``(engine, submit_kwargs)`` and the wrapper feeds the
     engine's admission queue, inheriting the request's deadline (so the
@@ -492,13 +501,16 @@ def _decorate_continuous(fn, page_size: Optional[int] = None,
                 f" got {type(out).__name__}") from None
         if (page_size is not None or prefix_cache is not None
                 or spec_decode is not None or draft_k is not None
-                or spec_threshold is not None) \
+                or spec_threshold is not None
+                or attn_kernel is not None or kv_dtype is not None) \
                 and engine not in configured:
             engine.apply_config(page_size=page_size,
                                 prefix_cache=prefix_cache,
                                 spec_decode=spec_decode,
                                 draft_k=draft_k,
-                                spec_threshold=spec_threshold)
+                                spec_threshold=spec_threshold,
+                                attn_kernel=attn_kernel,
+                                kv_dtype=kv_dtype)
             configured.add(engine)
         # Disaggregated dispatch (ISSUE 14), stamped by the router's
         # two-hop routing: the prefill hop answers with a leased

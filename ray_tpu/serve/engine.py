@@ -428,7 +428,8 @@ class DecodeEngine:
                  max_driver_restarts: int = 1,
                  spec_decode=None, draft_k: int = 4,
                  spec_threshold: float = 0.0,
-                 role: str = "both", handoff_ttl_s: float = 30.0):
+                 role: str = "both", handoff_ttl_s: float = 30.0,
+                 attn_kernel: str = "gather", kv_dtype: str = "fp"):
         from ..models import gpt_decode
         from .draft import make_drafter
         from .handoff import LeaseTable
@@ -497,6 +498,25 @@ class DecodeEngine:
                 slots=self.slots, max_len=self.max_len,
                 prompt_buckets=self.prompt_buckets,
                 draft_k=self.draft_k)
+        # ---- paged-attention kernel + quantized KV (ISSUE 16): both
+        # are ENGINE-STATIC knobs baked into the compiled programs at
+        # pool build — never retrace triggers. Stored before _build_pool
+        # (which reads them) and re-read verbatim on driver restart.
+        if attn_kernel not in gpt_decode.ATTN_KERNELS:
+            raise ValueError(
+                f"unknown attn_kernel {attn_kernel!r}; expected one of "
+                f"{gpt_decode.ATTN_KERNELS}")
+        if kv_dtype not in gpt_decode.KV_DTYPES:
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}; expected one of "
+                f"{gpt_decode.KV_DTYPES}")
+        if not paged and (attn_kernel != "gather" or kv_dtype != "fp"):
+            raise ValueError(
+                "attn_kernel/kv_dtype are paged-pool knobs; construct "
+                "the engine with paged=True (or pass page_size through "
+                "the config plane)")
+        self.attn_kernel = attn_kernel
+        self.kv_dtype = kv_dtype
         # Guards the put-vs-final-drain race: once _fail_all flips
         # _draining under this lock, no new submission can land in a
         # queue nobody will ever read again. Created BEFORE the pool so
@@ -532,7 +552,8 @@ class DecodeEngine:
                        "spec_lanes": 0,
                        "handoffs_exported": 0, "handoffs_imported": 0,
                        "handoff_import_fallbacks": 0,
-                       "handoff_ship_bytes": 0}
+                       "handoff_ship_bytes": 0,
+                       "attn_kernel_dispatches": 0}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # ---- driver supervision (ISSUE 7): the driver stamps _beat at
@@ -595,9 +616,17 @@ class DecodeEngine:
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
         self.max_pages = -(-self.max_len // self.page_size)   # ceil
-        # Default budget: the SAME KV bytes as the flat pool
-        # ([slots, max_len] worth of positions), re-cut into pages.
-        self.n_pages = int(n_pages) or self.slots * self.max_pages
+        # Default budget: the SAME KV **bytes** as the flat fp pool
+        # ([slots, max_len] worth of positions), re-cut into pages of
+        # the configured kv_dtype — an int8 pool's page is ~half the
+        # bytes, so the same budget holds ~2x the pages (the ISSUE 16
+        # sizing fix: counting pages in positions instead of bytes left
+        # half an int8 engine's HBM budget unused).
+        fp_bytes = gpt_decode.kv_bytes_per_page(cfg, self.page_size)
+        kv_bytes = gpt_decode.kv_bytes_per_page(cfg, self.page_size,
+                                                self.kv_dtype)
+        self.n_pages = int(n_pages) or \
+            (self.slots * self.max_pages * fp_bytes) // kv_bytes
         if self.n_pages < self.max_pages:
             raise ValueError(
                 f"n_pages {self.n_pages} cannot hold one max_len "
@@ -608,16 +637,17 @@ class DecodeEngine:
         self._pt = np.full((self.slots, self.max_pages),
                            gpt_decode.PT_SENTINEL, np.int32)
         self._prefill = gpt_decode.jit_prefill_into_slot_paged(
-            cfg, self.page_size, self.temperature)
+            cfg, self.page_size, self.temperature, self.kv_dtype)
         self._step = gpt_decode.jit_decode_chunk_slots_paged(
             cfg, self.chunk, self.page_size, self.temperature,
-            self.eos_token)
+            self.eos_token, self.kv_dtype, self.attn_kernel)
         self._export = gpt_decode.jit_export_slot_kv_paged(
-            cfg, self.page_size)
+            cfg, self.page_size, self.kv_dtype)
         self._import = gpt_decode.jit_import_slot_kv_paged(
-            cfg, self.page_size)
+            cfg, self.page_size, self.kv_dtype)
         self._cache = gpt_decode.init_paged_cache(
-            cfg, self.slots, self.n_pages, self.page_size)
+            cfg, self.slots, self.n_pages, self.page_size,
+            self.kv_dtype)
         self._bind_verify()
 
     # rtlint: program-budget: 1
@@ -632,36 +662,62 @@ class DecodeEngine:
             self._verify = None
         elif self.paged:
             self._verify = self._gd.jit_verify_chunk_slots_paged(
-                self.cfg, self.draft_k, self.page_size, self.temperature)
+                self.cfg, self.draft_k, self.page_size,
+                self.temperature, self.kv_dtype)
         else:
             self._verify = self._gd.jit_verify_chunk_slots(
                 self.cfg, self.draft_k, self.temperature)
 
     def ensure_paging(self, page_size: Optional[int] = None,
                       prefix_cache: Optional[bool] = None,
-                      n_pages: Optional[int] = None):
+                      n_pages: Optional[int] = None,
+                      attn_kernel: Optional[str] = None,
+                      kv_dtype: Optional[str] = None):
         """Idempotently apply paging knobs from the config plane
         (``@serve.batch(continuous=True, page_size=..)`` or the
         deployment schema's ``engine:`` block). A matching engine is a
         no-op; a mismatched engine is rebuilt IF it has never admitted a
         request, else this raises — pool shape is load-bearing state,
-        not something to swap under live lanes."""
+        not something to swap under live lanes. ``attn_kernel`` /
+        ``kv_dtype`` follow the same discipline: they are baked into
+        the pool's compiled programs (and, for ``kv_dtype``, its byte
+        layout), so a mismatch triggers the same rebuild-if-unused
+        path."""
         want_ps = int(page_size) if page_size is not None else None
         if want_ps is not None and want_ps < 1:
             raise ValueError("page_size must be >= 1")
+        if attn_kernel is not None and \
+                attn_kernel not in self._gd.ATTN_KERNELS:
+            raise ValueError(
+                f"unknown attn_kernel {attn_kernel!r}; expected one of "
+                f"{self._gd.ATTN_KERNELS}")
+        if kv_dtype is not None and kv_dtype not in self._gd.KV_DTYPES:
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}; expected one of "
+                f"{self._gd.KV_DTYPES}")
         with self._admit_lock:
             if want_ps is None and not self.paged and (
-                    prefix_cache or n_pages is not None):
+                    prefix_cache or n_pages is not None or
+                    (attn_kernel or "gather") != "gather" or
+                    (kv_dtype or "fp") != "fp"):
                 # Silently no-opping would leave the operator believing
-                # prefix caching / pool sizing is active on a flat pool.
+                # prefix caching / pool sizing / the kernel / int8 KV
+                # is active on a flat pool.
                 raise ValueError(
-                    "prefix_cache/n_pages are paged-pool knobs; this "
-                    "engine is flat — pass page_size to repage it")
-            if want_ps is None and self.paged and n_pages is not None:
-                want_ps = self.page_size   # resize keeps the page size
+                    "prefix_cache/n_pages/attn_kernel/kv_dtype are "
+                    "paged-pool knobs; this engine is flat — pass "
+                    "page_size to repage it")
+            knob_change = (
+                (attn_kernel is not None and
+                 attn_kernel != self.attn_kernel) or
+                (kv_dtype is not None and kv_dtype != self.kv_dtype))
+            if want_ps is None and self.paged and (
+                    n_pages is not None or knob_change):
+                want_ps = self.page_size   # rebuild keeps the page size
             need_rebuild = want_ps is not None and (
                 not self.paged or self.page_size != want_ps or
-                (n_pages is not None and int(n_pages) != self.n_pages))
+                (n_pages is not None and int(n_pages) != self.n_pages) or
+                knob_change)
             if need_rebuild:
                 with self._stats_lock:
                     used = self._stats["admitted"]
@@ -672,6 +728,10 @@ class DecodeEngine:
                         f"{self.page_size or None} -> {want_ps}); "
                         f"construct it paged or apply the config "
                         f"before traffic")
+                if attn_kernel is not None:
+                    self.attn_kernel = attn_kernel
+                if kv_dtype is not None:
+                    self.kv_dtype = kv_dtype
                 self._build_pool(True, want_ps, int(n_pages or 0),
                                  prefix_cache if prefix_cache is not None
                                  else self._prefix is not None)
@@ -765,7 +825,8 @@ class DecodeEngine:
         return self
 
     #: Config-plane knob split for :meth:`apply_config`.
-    _PAGE_KEYS = ("page_size", "prefix_cache", "n_pages")
+    _PAGE_KEYS = ("page_size", "prefix_cache", "n_pages",
+                  "attn_kernel", "kv_dtype")
     _SPEC_KEYS = ("spec_decode", "draft_k", "spec_threshold")
     _ROLE_KEYS = ("role", "handoff_ttl_s")
 
@@ -992,6 +1053,23 @@ class DecodeEngine:
                 raise HandoffError(
                     f"shipped KV shape {tuple(payload['k'].shape)} "
                     f"does not fit this engine's model ({want})")
+            # Layout identity (ISSUE 16): quantized payloads only land
+            # on an engine with the SAME kv_dtype and page_size — int8
+            # codes are meaningless without their page-aligned scales,
+            # and scales are page-granular. Any mismatch (int8->fp,
+            # fp->int8, or a different page cut) degrades to the local
+            # re-prefill, which is token-identical by determinism.
+            ship_dt = payload.get("kv_dtype", "fp")
+            mine = self.kv_dtype if self.paged else "fp"
+            if ship_dt != mine:
+                raise HandoffError(
+                    f"shipped kv_dtype {ship_dt!r} does not match this "
+                    f"engine's ({mine!r})")
+            if ship_dt == "int8" and \
+                    int(payload.get("page_size", 0)) != self.page_size:
+                raise HandoffError(
+                    f"shipped page_size {payload.get('page_size')} "
+                    f"does not match this engine's ({self.page_size})")
         except HandoffError:
             payload = None
         if payload is None:
@@ -1296,10 +1374,14 @@ class DecodeEngine:
             if self._prefix is not None:
                 out["prefix_cache_entries"] = len(self._prefix)
                 out["prefix_evictions"] = self._prefix.evictions
+            out["attn_kernel"] = self.attn_kernel
+            out["kv_dtype"] = self.kv_dtype
+            out["kv_bytes_per_token"] = self._gd.kv_bytes_per_page(
+                self.cfg, self.page_size, self.kv_dtype) / self.page_size
         else:
             for k in ("prefix_hits", "prefix_tokens_reused",
                       "cow_copies", "admissions_deferred", "lane_parks",
-                      "preempted"):
+                      "preempted", "attn_kernel_dispatches"):
                 out.pop(k, None)
         return out
 
@@ -1452,6 +1534,10 @@ class DecodeEngine:
         labels = {"deployment": self.deployment}
         sm["engine_pages_free"].set(free, labels=labels)
         sm["engine_pages_used"].set(self.n_pages - free, labels=labels)
+        sm["engine_kv_bytes_per_token"].set(
+            self._gd.kv_bytes_per_page(self.cfg, self.page_size,
+                                       self.kv_dtype) / self.page_size,
+            labels=labels)
 
     def _sweep_leases(self):  # rtlint: owner=driver
         """Reclaim expired handoff leases once per driver loop
@@ -1729,7 +1815,12 @@ class DecodeEngine:
         """
         from . import handoff as _ho
 
-        if self.paged:
+        quant = self.paged and self.kv_dtype == "int8"
+        ks = vs = None
+        if quant:
+            k_dev, v_dev, ks_dev, vs_dev = self._export(
+                self._cache, self._pt[slot])
+        elif self.paged:
             k_dev, v_dev = self._export(self._cache, self._pt[slot])
         else:
             k_dev, v_dev = self._export(self._cache, np.int32(slot))
@@ -1742,13 +1833,27 @@ class DecodeEngine:
         k = np.asarray(k_dev)[:, :P].copy()
         # rtlint: sync-ok=ship second half of the same payload
         v = np.asarray(v_dev)[:, :P].copy()
+        if quant:
+            # int8 ships CODES (trimmed like fp — the merge writes
+            # canonical zeros past pos, so page bytes are a pure
+            # function of held tokens) plus the per-page scales for
+            # the covering pages. The digest covers both.
+            n_cover = -(-P // self.page_size)
+            # rtlint: sync-ok=ship per-page K scales ride the payload
+            ks = np.asarray(ks_dev)[:, :n_cover].copy()
+            # rtlint: sync-ok=ship per-page V scales ride the payload
+            vs = np.asarray(vs_dev)[:, :n_cover].copy()
         rng = np.asarray(self._rngs[slot], np.uint32).copy()
         if pages:
             self._pool.unref(pages)
             self._pt[slot, :] = self._gd.PT_SENTINEL
         payload = _ho.build_payload(k=k, v=v, prompt=req.prompt, pos=P,
                                     first=first, rng=rng, seed=req.seed,
-                                    max_new=req.max_new)
+                                    max_new=req.max_new, ks=ks, vs=vs,
+                                    kv_dtype=self.kv_dtype if quant
+                                    else None,
+                                    page_size=self.page_size if quant
+                                    else None)
         fields, nbytes = _ho.ship_payload(payload)
         lease_id, expires = self._leases.grant(
             epoch=self._epoch, pin=fields.get("ref"), nbytes=nbytes,
@@ -1808,11 +1913,26 @@ class DecodeEngine:
             v_pad = np.zeros((L, self.max_pages * ps, H, hd), dt)
             k_pad[:, :P] = payload["k"]
             v_pad[:, :P] = payload["v"]
-            cache = self._import(
-                self._cache,
-                k_pad.reshape(L, self.max_pages, ps, H, hd),
-                v_pad.reshape(L, self.max_pages, ps, H, hd),
-                pt_row, np.int32(slot), np.int32(P))
+            if self.kv_dtype == "int8":
+                # Quantized handoff: the codes pad/reshape exactly like
+                # fp K/V; the per-page scales pad to the full table
+                # width and scatter under the same page mask.
+                ks_pad = np.zeros((L, self.max_pages, H), np.float32)
+                vs_pad = np.zeros((L, self.max_pages, H), np.float32)
+                ks_pad[:, :n_cover] = payload["ks"]
+                vs_pad[:, :n_cover] = payload["vs"]
+                cache = self._import(
+                    self._cache,
+                    k_pad.reshape(L, self.max_pages, ps, H, hd),
+                    v_pad.reshape(L, self.max_pages, ps, H, hd),
+                    ks_pad, vs_pad,
+                    pt_row, np.int32(slot), np.int32(P))
+            else:
+                cache = self._import(
+                    self._cache,
+                    k_pad.reshape(L, self.max_pages, ps, H, hd),
+                    v_pad.reshape(L, self.max_pages, ps, H, hd),
+                    pt_row, np.int32(slot), np.int32(P))
             if epoch >= 0 and epoch != self._epoch:
                 pool.unref(pages)     # stale driver: hand pages back
                 return True
@@ -1993,6 +2113,12 @@ class DecodeEngine:
         sm["engine_dispatches"].inc(
             labels={"deployment": self.deployment})
         self._count(dispatches=1, occupancy_sum=n_active / self.slots)
+        if self.paged and self.attn_kernel == "pallas":
+            # One fused-kernel dispatch per chunk program launch (the
+            # kernel runs k times per layer inside it).
+            sm["engine_attn_kernel_dispatches"].inc(
+                labels={"deployment": self.deployment})
+            self._count(attn_kernel_dispatches=1)
         with self._stats_lock:
             self._stats["peak_active"] = max(self._stats["peak_active"],
                                              n_active)

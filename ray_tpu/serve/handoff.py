@@ -63,7 +63,11 @@ def payload_digest(payload: Dict[str, Any]) -> str:
     """SHA-256 over the shipped K/V bytes AND every replay-relevant
     field — byte-verification of the shipped pages, not just a length
     check. Deterministic across flat/paged exporters because both trim
-    to the true prompt length before hashing."""
+    to the true prompt length before hashing. Quantized payloads
+    (ISSUE 16) additionally fold the per-page scales and the layout
+    identity (``kv_dtype``, ``page_size``) into the hash — ONLY when
+    present, so fp digests are byte-for-byte what they were before the
+    int8 plane existed."""
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(payload["k"]).tobytes())
     h.update(np.ascontiguousarray(payload["v"]).tobytes())
@@ -72,18 +76,32 @@ def payload_digest(payload: Dict[str, Any]) -> str:
     h.update(np.ascontiguousarray(
         np.asarray(payload["rng"], np.uint32)).tobytes())
     h.update(_meta_bytes(payload))
+    if payload.get("ks") is not None:
+        h.update(np.ascontiguousarray(
+            np.asarray(payload["ks"], np.float32)).tobytes())
+        h.update(np.ascontiguousarray(
+            np.asarray(payload["vs"], np.float32)).tobytes())
+        h.update((f"kv_dtype={payload.get('kv_dtype', 'int8')};"
+                  f"page_size={int(payload.get('page_size', 0))}"
+                  ).encode())
     return h.hexdigest()
 
 
 def build_payload(*, k: np.ndarray, v: np.ndarray, prompt: np.ndarray,
                   pos: int, first: int, rng: np.ndarray, seed: int,
-                  max_new: int) -> Dict[str, Any]:
+                  max_new: int, ks: Optional[np.ndarray] = None,
+                  vs: Optional[np.ndarray] = None,
+                  kv_dtype: Optional[str] = None,
+                  page_size: Optional[int] = None) -> Dict[str, Any]:
     """Assemble one ship buffer: the slot's K/V trimmed to ``pos``
     (``[L, pos, H, hd]``, contiguous), the first sampled token, the
     post-prefill PRNG lane, and the replay identity (prompt, seed,
     max_new) — everything a decode engine needs to continue the stream
     bit-exactly, and everything a survivor needs to re-prefill it from
-    scratch if the bytes are lost."""
+    scratch if the bytes are lost. int8 exporters (ISSUE 16) pass the
+    codes as ``k``/``v`` plus the per-page scales ``ks``/``vs``
+    (``[L, n_cover, H]``) and the layout identity; the digest then
+    covers codes AND scales."""
     payload = {
         "k": np.ascontiguousarray(k),
         "v": np.ascontiguousarray(v),
@@ -94,6 +112,11 @@ def build_payload(*, k: np.ndarray, v: np.ndarray, prompt: np.ndarray,
         "seed": int(seed),
         "max_new": int(max_new),
     }
+    if ks is not None:
+        payload["ks"] = np.ascontiguousarray(np.asarray(ks, np.float32))
+        payload["vs"] = np.ascontiguousarray(np.asarray(vs, np.float32))
+        payload["kv_dtype"] = str(kv_dtype or "int8")
+        payload["page_size"] = int(page_size or 0)
     payload["digest"] = payload_digest(payload)
     return payload
 
@@ -111,7 +134,10 @@ def verify_payload(payload: Dict[str, Any]) -> None:
 
 
 def payload_nbytes(payload: Dict[str, Any]) -> int:
-    return int(payload["k"].nbytes) + int(payload["v"].nbytes)
+    n = int(payload["k"].nbytes) + int(payload["v"].nbytes)
+    if payload.get("ks") is not None:
+        n += int(payload["ks"].nbytes) + int(payload["vs"].nbytes)
+    return n
 
 
 def ship_payload(payload: Dict[str, Any]) -> Tuple[Dict[str, Any], int]:

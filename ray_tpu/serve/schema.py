@@ -55,7 +55,8 @@ class DeploymentSchema:
     _ENGINE_KEYS = frozenset({"page_size", "prefix_cache", "n_pages",
                               "spec_decode", "draft_k",
                               "spec_threshold", "role", "roles",
-                              "handoff_ttl_s"})
+                              "handoff_ttl_s", "attn_kernel",
+                              "kv_dtype"})
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSchema":

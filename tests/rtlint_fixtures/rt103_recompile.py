@@ -76,3 +76,29 @@ class SpecDriver:
     def suppressed(self, cfg, draft):
         # rtlint: disable=RT103 bounded: draft is always [slots, draft_k]
         return jit_verify_chunk_slots(cfg, draft.shape[1])
+
+
+@functools.lru_cache(maxsize=64)
+def jit_decode_chunk_slots_paged(cfg, k, page_size, temperature=0.0,
+                                 eos_token=-1, kv_dtype="fp",
+                                 attn_kernel="gather"):
+    return lambda *a: a
+
+
+class KernelKnobDriver:
+    """ISSUE 16: ``kv_dtype``/``attn_kernel`` are STATIC engine knobs —
+    bounded config strings, one program per (pool shape, knob tuple) —
+    never values derived from the request or the pool state."""
+
+    def __init__(self, cfg, page_size):
+        # Bounded string knobs from config: clean.
+        self.step = jit_decode_chunk_slots_paged(
+            cfg, 8, page_size, 0.0, -1, "int8", "pallas")
+
+    def hazard_unhashable_kernel(self, cfg):
+        return jit_decode_chunk_slots_paged(
+            cfg, 8, 16, attn_kernel=["pallas"])  # FIRES RT103
+
+    def hazard_pool_derived_pages(self, cfg, pages):
+        return jit_decode_chunk_slots_paged(
+            cfg, 8, len(pages), kv_dtype="int8")  # FIRES RT103

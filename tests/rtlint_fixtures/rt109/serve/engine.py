@@ -99,6 +99,36 @@ def make_step_fixture(cfg, n):
     return jax.jit(lambda *a: a, static_argnums=(1,))
 
 
+class KnobbedEngine:
+    """ISSUE 16 negative case: static kernel/quantization knobs do not
+    change the budget arithmetic — the pool binds ONE chunk program
+    (for its configured knob tuple) plus the bucketed prefill, exactly
+    like the fp/gather engine."""
+
+    # rtlint: program-budget: len(prompt_buckets) + 1
+    def _build(self, cfg, kv_dtype, attn_kernel):
+        self._pf = jit_budget_fixture(cfg)
+        self._chunkprog = jit_budget_fixture(cfg, 4)
+
+    def admit(self, req):
+        bucket = next(b for b in self.prompt_buckets
+                      if b >= len(req.prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        return self._pf(padded)
+
+
+class BothKernelsBound:
+    """Positive case: binding BOTH kernel variants at once busts a
+    budget declared for one — the engine's discipline is one variant
+    per pool, rebound on reconfigure, never both resident."""
+
+    # FIRES-BELOW RT109
+    # rtlint: program-budget: 1
+    def _build(self, cfg):
+        self._gather = jit_budget_fixture(cfg)
+        self._pallas = jit_budget_fixture(cfg, 4)
+
+
 class MissingBinder:
     def _build(self, cfg):  # FIRES RT109
         self._x = jit_budget_fixture(cfg)

@@ -1,0 +1,457 @@
+"""Fused paged-attention kernel + int8 quantized KV cache (ISSUE 16).
+
+The exactness contract under test:
+
+- ``attn_kernel="pallas"`` (Pallas ``pallas_call`` on TPU, interpret
+  mode on CPU — tier-1 exercises the REAL kernel body either way) is
+  token-identical to the XLA gather reference at temperature 0 AND
+  under seeded sampling, across sentinel-padded page tables, mid-page
+  COW prefix forks, and the int8 cache layout.
+- ``kv_dtype="int8"`` (per-page-per-head scales, quantize on scatter /
+  dequantize at attention) bounds its round-trip error by one quantum
+  (``1/127`` relative to the page's absmax) and documents a temp-0
+  divergence RATE vs fp rather than pretending bit-identity: measured
+  ~0.2 of streams diverge somewhere on random nano weights, asserted
+  here under a loose 0.5 ceiling, with the FIRST token exact (the
+  prefill's own forward runs in fp).
+- Both knobs preserve the ``len(prompt_buckets) + k`` compiled-program
+  budget and the handoff plane (int8 ships codes + scales; the digest
+  covers both; any layout mismatch degrades to the counted local
+  re-prefill).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+
+def _drain(lane):
+    from ray_tpu.serve.batching import _EngineStream
+
+    return np.concatenate(list(_EngineStream(lane)))
+
+
+@pytest.fixture(scope="module")
+def nano():
+    from ray_tpu.models import gpt
+
+    return gpt.CONFIGS["nano"]
+
+
+@pytest.fixture(scope="module")
+def nano_params(nano):
+    import jax
+
+    from ray_tpu.models import gpt
+
+    return gpt.init_params(jax.random.PRNGKey(0), nano)
+
+
+def _make(nano, nano_params, **kw):
+    from ray_tpu.serve.engine import DecodeEngine
+
+    kw.setdefault("slots", 2)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_buckets", (8, 16))
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 8)
+    return DecodeEngine(nano_params, nano, **kw)
+
+
+def _drain_concurrent(eng, prompts, max_news, seeds=None):
+    outs = {}
+
+    def consume(i):
+        kw = {"seed": seeds[i]} if seeds else {}
+        outs[i] = np.concatenate(
+            list(eng.stream(prompts[i], max_news[i], **kw)))
+
+    threads = [threading.Thread(target=consume, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outs
+
+
+def _prefix_prompts(nano, rng, n_fresh=2):
+    """A shared 12-token system prompt with fresh 4-token tails: the
+    second admission hits the prefix cache mid-page (12 % 8 != 0) and
+    forks the partial page copy-on-write."""
+    sysp = rng.integers(0, nano.vocab_size, (12,)).astype(np.int32)
+    out = []
+    for _ in range(n_fresh):
+        tail = rng.integers(0, nano.vocab_size, (4,)).astype(np.int32)
+        out.append(np.concatenate([sysp, tail]))
+    return out
+
+
+# --------------------------------------------------- kernel vs reference
+def test_paged_attention_matches_gather_direct(nano, nano_params):
+    """Direct kernel-vs-reference on a hand-built pool: random pages,
+    page tables with SENTINEL padding and out-of-order mappings, per
+    -slot lengths that end mid-page. The fused kernel must match the
+    gather reference to f32-accumulation-reorder noise (well below one
+    bf16 ulp of the output scale) — and garbage in pages past a slot's
+    pos must not leak in (the length mask and the sentinel skip are
+    fused into the kernel)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt_decode
+
+    H, hd, ps, n_pages, max_pages, B = nano.n_head, nano.head_dim, 8, \
+        16, 4, 3
+    rng = np.random.default_rng(21)
+    kc = jnp.asarray(rng.standard_normal((n_pages, ps, H, hd)),
+                     nano.dtype)
+    vc = jnp.asarray(rng.standard_normal((n_pages, ps, H, hd)),
+                     nano.dtype)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), nano.dtype)
+    pt = np.full((B, max_pages), gpt_decode.PT_SENTINEL, np.int32)
+    pt[0, :2] = [5, 3]            # out of order, 2 pages + sentinels
+    pt[1, :4] = [7, 0, 9, 2]      # full table
+    pt[2, :1] = [11]              # single page, ends mid-page
+    pos = jnp.asarray([12, 30, 4], jnp.int32)   # mid-page lengths
+    ref = gpt_decode.paged_attention(q, kc, vc, jnp.asarray(pt), pos,
+                                     page_size=ps, kernel="gather")
+    out = gpt_decode.paged_attention(q, kc, vc, jnp.asarray(pt), pos,
+                                     page_size=ps, kernel="pallas")
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=0, atol=1e-2)
+    # Sentinel/length fusion: clobber every page the tables never map
+    # AND the tail of slot 2's single page past pos=4 — outputs for
+    # the mapped slots must not move at all.
+    live = {5, 3, 7, 0, 9, 2, 11}
+    kc2, vc2 = np.array(kc, np.float32), np.array(vc, np.float32)
+    for p in range(n_pages):
+        if p not in live:
+            kc2[p] = 1e4
+            vc2[p] = 1e4
+    kc2[11, 5:] = 1e4             # past slot 2's pos, same page
+    vc2[11, 5:] = 1e4
+    out2 = gpt_decode.paged_attention(
+        jnp.asarray(q), jnp.asarray(kc2, nano.dtype),
+        jnp.asarray(vc2, nano.dtype), jnp.asarray(pt), pos,
+        page_size=ps, kernel="pallas")
+    assert np.array_equal(np.asarray(out2, np.float32),
+                          np.asarray(out, np.float32))
+
+
+@pytest.mark.parametrize("kv_dtype", ["fp", "int8"])
+def test_kernel_token_identity_greedy(nano, nano_params, kv_dtype):
+    """Kernel on vs off at temperature 0: identical token streams for
+    every lane — mixed prompt lengths (sentinel-padded tables), a
+    shared prefix hit that forks mid-page (COW), concurrent slots —
+    on BOTH cache layouts. The kernel's exactness contract is against
+    the gather reference on the SAME cache bytes, so it holds for int8
+    exactly as for fp."""
+    ref = _make(nano, nano_params, prefix_cache=True,
+                prompt_buckets=(8, 16), kv_dtype=kv_dtype,
+                attn_kernel="gather")
+    ker = _make(nano, nano_params, prefix_cache=True,
+                prompt_buckets=(8, 16), kv_dtype=kv_dtype,
+                attn_kernel="pallas")
+    try:
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, nano.vocab_size,
+                                (n,)).astype(np.int32)
+                   for n in (5, 11, 16)] + _prefix_prompts(nano, rng)
+        max_news = [9, 7, 12, 8, 8]
+        of = _drain_concurrent(ref, prompts, max_news)
+        ok = _drain_concurrent(ker, prompts, max_news)
+        for i in range(len(prompts)):
+            assert (of[i] == ok[i]).all(), (i, of[i], ok[i])
+        st = ker.stats()
+        assert st["attn_kernel"] == "pallas"
+        assert st["attn_kernel_dispatches"] > 0
+        assert ref.stats()["attn_kernel_dispatches"] == 0
+    finally:
+        ref.shutdown()
+        ker.shutdown()
+
+
+@pytest.mark.parametrize("kv_dtype", ["fp", "int8"])
+def test_kernel_token_identity_temperature(nano, nano_params, kv_dtype):
+    """Seeded sampling (temp 1.0): kernel on vs off reproduces the
+    same per-slot PRNG chains token-for-token; a different seed still
+    diverges (the identity is not an artifact of a dead sampler)."""
+    ref = _make(nano, nano_params, temperature=1.0, prefix_cache=False,
+                kv_dtype=kv_dtype, attn_kernel="gather")
+    ker = _make(nano, nano_params, temperature=1.0, prefix_cache=False,
+                kv_dtype=kv_dtype, attn_kernel="pallas")
+    try:
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, nano.vocab_size,
+                                (n,)).astype(np.int32)
+                   for n in (8, 13)]
+        max_news = [8, 10]
+        seeds = [7, 11]
+        of = _drain_concurrent(ref, prompts, max_news, seeds)
+        ok = _drain_concurrent(ker, prompts, max_news, seeds)
+        for i in range(2):
+            assert (of[i] == ok[i]).all(), (i, of[i], ok[i])
+        other = np.concatenate(list(ker.stream(prompts[0], 8, seed=8)))
+        assert not (other == ok[0]).all()
+    finally:
+        ref.shutdown()
+        ker.shutdown()
+
+
+# ------------------------------------------------------------ int8 layout
+def test_int8_roundtrip_error_bound(nano):
+    """Quantize-on-scatter round trip: one page written through
+    ``_merge_span_int8`` dequantizes back within ONE quantum — the
+    per-page-per-head scale is absmax/127, so |x - deq(q(x))| <=
+    scale/2 elementwise, i.e. rel err <= 1/127 of the page-head
+    absmax. Codes past the written span must be canonical zeros (page
+    bytes are a pure function of held tokens — what the handoff digest
+    relies on)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt_decode
+
+    H, hd, ps = nano.n_head, nano.head_dim, 8
+    rng = np.random.default_rng(5)
+    vals = rng.standard_normal((1, 6, H, hd)).astype(np.float32)
+    codes = jnp.zeros((4, ps, H, hd), jnp.int8)     # per-layer pool
+    scales = jnp.zeros((4, H), jnp.float32)
+    pt = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    c2, s2 = gpt_decode._merge_span_int8(
+        codes, scales, jnp.asarray(vals), pt, jnp.asarray([0]),
+        jnp.asarray(6), jnp.asarray([True]), ps)
+    deq = np.asarray(c2, np.float32) * \
+        np.asarray(s2)[:, None, :, None]
+    absmax = np.abs(vals[0, :6]).max(axis=(0, 2))       # per head
+    err = np.abs(deq[0, :6] - vals[0, :6])
+    assert (err <= absmax[None, :, None] / 127.0 + 1e-7).all()
+    # Canonical zeros past the span, in codes AND untouched pages.
+    assert (np.asarray(c2)[0, 6:] == 0).all()
+    assert (np.asarray(c2)[1:] == 0).all()
+    assert (np.asarray(s2)[1:] == 0).all()
+
+
+def test_int8_divergence_rate_documented(nano, nano_params):
+    """fp vs int8 at temperature 0 on the SAME weights: the FIRST
+    token of every stream is exact (prefill's forward runs in fp; only
+    the CACHE is quantized), and the stream-divergence rate sits under
+    the documented 0.5 ceiling (measured ~0.2 on random nano weights —
+    real checkpoints with peaked logits sit far lower)."""
+    fp = _make(nano, nano_params, slots=2, prefix_cache=False,
+               kv_dtype="fp")
+    q8 = _make(nano, nano_params, slots=2, prefix_cache=False,
+               kv_dtype="int8")
+    try:
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, nano.vocab_size,
+                                (int(n),)).astype(np.int32)
+                   for n in rng.integers(5, 16, 10)]
+        max_news = [8] * len(prompts)
+        of = _drain_concurrent(fp, prompts, max_news)
+        oq = _drain_concurrent(q8, prompts, max_news)
+        diverged = 0
+        for i in range(len(prompts)):
+            assert of[i][0] == oq[i][0], "first token must be exact"
+            if not (of[i] == oq[i]).all():
+                diverged += 1
+        rate = diverged / len(prompts)
+        assert rate <= 0.5, f"int8 divergence rate {rate} > 0.5 bound"
+    finally:
+        fp.shutdown()
+        q8.shutdown()
+
+
+def test_int8_spec_decode_identity(nano, nano_params):
+    """Speculative decoding on a quantized pool: the verify forward
+    reads the SAME int8 cache as plain decode, so spec on vs off is
+    token-identical at temp 0 — acceptance arithmetic never sees the
+    quantization, only the committed tokens do."""
+    plain = _make(nano, nano_params, prefix_cache=False,
+                  kv_dtype="int8")
+    spec = _make(nano, nano_params, prefix_cache=False,
+                 kv_dtype="int8", spec_decode="ngram", draft_k=4)
+    try:
+        rng = np.random.default_rng(7)
+        base = rng.integers(0, nano.vocab_size, (4,)).astype(np.int32)
+        prompts = [np.tile(base, 3)[:n] for n in (9, 12)]  # repetitive
+        max_news = [10, 8]
+        op = _drain_concurrent(plain, prompts, max_news)
+        os_ = _drain_concurrent(spec, prompts, max_news)
+        for i in range(2):
+            assert (op[i] == os_[i]).all(), (i, op[i], os_[i])
+    finally:
+        plain.shutdown()
+        spec.shutdown()
+
+
+# ------------------------------------------------------- quantized handoff
+def test_quantized_handoff_roundtrip(nano, nano_params):
+    """int8 prefill engine -> int8 decode engine: the payload ships
+    CODES + per-page scales, the digest covers both, and the decode
+    stream is token-identical to an uninterrupted run on one int8
+    engine. Tampering with a shipped scale fails byte-verification and
+    degrades to the counted local re-prefill; so does landing the int8
+    payload on an fp engine (layout mismatch)."""
+    kw = dict(paged=True, page_size=8, prefix_cache=False,
+              kv_dtype="int8")
+    pre = _make(nano, nano_params, role="prefill", **kw)
+    dec = _make(nano, nano_params, role="decode", **kw)
+    ref_eng = _make(nano, nano_params, **kw)
+    fp_dec = _make(nano, nano_params, role="decode", paged=True,
+                   page_size=8, prefix_cache=False, kv_dtype="fp")
+    try:
+        rng = np.random.default_rng(8)
+        prompt = rng.integers(0, nano.vocab_size, (11,)).astype(np.int32)
+        ref = np.concatenate(list(ref_eng.stream(prompt, 10, seed=3)))
+        desc = pre.handoff(prompt, 10, seed=3)
+        payload = desc["payload"]
+        assert payload["k"].dtype == np.int8
+        assert payload["kv_dtype"] == "int8"
+        assert payload["page_size"] == 8
+        assert payload["ks"].shape == (nano.n_layer, 2, nano.n_head)
+        out = _drain(dec.admit_prefilled(desc))
+        assert (out == ref).all(), (out, ref)
+        assert dec.stats()["handoff"]["imported"] == 1
+        # Scale tamper: the digest covers the scales, so a flipped
+        # scale fails verification -> local re-prefill, same tokens.
+        bad = dict(desc)
+        bad["payload"] = dict(payload)
+        bad["payload"]["ks"] = np.array(payload["ks"])
+        bad["payload"]["ks"][0, 0, 0] *= 2
+        out_t = _drain(dec.admit_prefilled(bad))
+        assert (out_t == ref).all()
+        assert dec.stats()["handoff"]["import_fallbacks"] == 1
+        # Layout mismatch: int8 payload on an fp engine falls back to
+        # a local fp re-prefill (token-identical by determinism).
+        fp_ref = np.concatenate(list(
+            _ref_fp_stream(nano, nano_params, prompt)))
+        out_fp = _drain(fp_dec.admit_prefilled(desc))
+        assert (out_fp == fp_ref).all()
+        assert fp_dec.stats()["handoff"]["import_fallbacks"] == 1
+        assert fp_dec.stats()["handoff"]["imported"] == 0
+    finally:
+        pre.shutdown()
+        dec.shutdown()
+        ref_eng.shutdown()
+        fp_dec.shutdown()
+
+
+def _ref_fp_stream(nano, nano_params, prompt):
+    eng = _make(nano, nano_params, paged=True, page_size=8,
+                prefix_cache=False, kv_dtype="fp")
+    try:
+        return list(eng.stream(prompt, 10, seed=3))
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------- program budget
+def test_recompile_guard_both_knobs(nano, nano_params):
+    """With attn_kernel=pallas AND kv_dtype=int8 the compiled-program
+    set is STILL ``len(prompt_buckets)`` prefill programs + 1 fused
+    chunk program — quantization scatter, scale updates, and the
+    kernel dispatch are all inside the same jitted programs, keyed by
+    static knobs only. page_size=24 is unique to this test, so the
+    (process-wide, lru-shared) wrappers count only this pool's
+    programs."""
+    from ray_tpu.models.gpt_decode import (jit_decode_chunk_slots_paged,
+                                           jit_prefill_into_slot_paged)
+
+    eng = _make(nano, nano_params, slots=3, max_len=48,
+                prompt_buckets=(8, 16), page_size=24,
+                prefix_cache=True, kv_dtype="int8",
+                attn_kernel="pallas")
+    try:
+        rng = np.random.default_rng(9)
+        sysp = rng.integers(0, nano.vocab_size, (12,)).astype(np.int32)
+
+        def storm(lens):
+            prompts = []
+            for i, n in enumerate(lens):
+                if i % 3 == 0:
+                    tail = rng.integers(0, nano.vocab_size,
+                                        (4,)).astype(np.int32)
+                    prompts.append(np.concatenate([sysp, tail]))
+                else:
+                    prompts.append(rng.integers(
+                        0, nano.vocab_size, (int(n),)).astype(np.int32))
+            _drain_concurrent(eng, prompts,
+                              [int(rng.integers(1, 10))
+                               for _ in prompts])
+
+        storm([5, 16, 8])                     # warm every bucket
+        pre_prefill = eng._prefill._cache_size()
+        pre_step = eng._step._cache_size()
+        assert pre_prefill == len(eng.prompt_buckets)
+        assert pre_step == 1
+        storm([1, 3, 7, 9, 12, 15, 16, 2])    # mixed-shape storm
+        assert eng._prefill._cache_size() == pre_prefill
+        assert eng._step._cache_size() == pre_step
+        # lru wrappers keyed on the FULL static-knob tuple.
+        assert jit_prefill_into_slot_paged(nano, 24, 0.0, "int8") \
+            is eng._prefill
+        assert jit_decode_chunk_slots_paged(
+            nano, 4, 24, 0.0, -1, "int8", "pallas") is eng._step
+    finally:
+        eng.shutdown()
+
+
+# ----------------------------------------------------------- plumbing
+def test_knob_validation_and_plumbing(nano, nano_params):
+    """Config-plane guards: the knobs are paged-pool-only and
+    validated everywhere they enter — engine ctor, ensure_paging,
+    @serve.batch, and the deployment schema."""
+    from ray_tpu.serve import batching
+    from ray_tpu.serve.schema import DeploymentSchema
+
+    with pytest.raises(ValueError, match="attn_kernel"):
+        _make(nano, nano_params, attn_kernel="fused")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        _make(nano, nano_params, kv_dtype="int4")
+    with pytest.raises(ValueError, match="paged"):
+        _make(nano, nano_params, paged=False, page_size=None,
+              kv_dtype="int8")
+    with pytest.raises(ValueError, match="continuous"):
+        batching.batch(kv_dtype="int8")(lambda xs: xs)
+    with pytest.raises(ValueError, match="continuous"):
+        batching.batch(attn_kernel="pallas")(lambda xs: xs)
+    DeploymentSchema.from_dict({
+        "name": "d",
+        "engine": {"page_size": 8, "kv_dtype": "int8",
+                   "attn_kernel": "pallas"}})
+    with pytest.raises(ValueError, match="unknown engine config"):
+        DeploymentSchema.from_dict({"name": "d",
+                                    "engine": {"kv_dtyp": "int8"}})
+    # Live reconfigure through the same applier the deployment path
+    # uses: flat engine + knobs repages; knob change rebuilds the pool.
+    eng = _make(nano, nano_params, paged=False, page_size=None)
+    try:
+        eng.apply_config(page_size=8, kv_dtype="int8",
+                         attn_kernel="pallas")
+        assert eng.paged and eng.kv_dtype == "int8"
+        assert eng.attn_kernel == "pallas"
+        st = eng.stats()
+        assert st["kv_dtype"] == "int8"
+        assert st["kv_bytes_per_token"] < 2 * nano.n_layer * \
+            nano.n_head * nano.head_dim * 2   # below the bf16 cost
+        out = np.concatenate(list(eng.stream(
+            np.arange(5, dtype=np.int32) % nano.vocab_size, 4)))
+        assert out.shape == (4,)
+    finally:
+        eng.shutdown()
+
+
+def test_kv_bytes_per_page_accounting(nano):
+    """The sizing fix: ``kv_bytes_per_page`` charges the CONFIGURED
+    element size (int8 codes + amortized f32 scales), so the default
+    ``n_pages`` budget admits ~2x lanes — not the param dtype."""
+    from ray_tpu.models import gpt_decode
+
+    fp = gpt_decode.kv_bytes_per_page(nano, 8)
+    i8 = gpt_decode.kv_bytes_per_page(nano, 8, "int8")
+    assert fp == nano.n_layer * 2 * 8 * nano.n_head * nano.head_dim * 2
+    assert i8 == nano.n_layer * 2 * (8 * nano.n_head * nano.head_dim
+                                     + 4 * nano.n_head)
+    assert fp / i8 > 1.5

@@ -333,9 +333,10 @@ def test_paged_recompile_guard_with_prefix_hits(nano, nano_params):
         st = eng.stats()
         assert st["prefix_hits"] >= 2 and st["cow_copies"] >= 1
         # lru wrappers shared per static-knob tuple across engines
-        assert jit_prefill_into_slot_paged(nano, 16, 0.0) is eng._prefill
-        assert jit_decode_chunk_slots_paged(nano, 4, 16, 0.0, -1) \
-            is eng._step
+        assert jit_prefill_into_slot_paged(nano, 16, 0.0, "fp") \
+            is eng._prefill
+        assert jit_decode_chunk_slots_paged(
+            nano, 4, 16, 0.0, -1, "fp", "gather") is eng._step
     finally:
         eng.shutdown()
 
@@ -432,7 +433,10 @@ def test_paged_smoke_benchmark():
     """Satellite CI hook: the benchmark's --paged --smoke A/B (flat vs
     paged pool at the SAME KV-byte budget + shared-prefix TTFT probe)
     runs end to end and emits the summary line with the slot
-    multiplier."""
+    multiplier. ISSUE 16 rides the same subprocess: --kv-dtype int8
+    and --attn-kernel pallas append their own A/B arms (fp-vs-int8
+    lane capacity at equal KV bytes; gather-vs-pallas TPOT with a
+    token-identity check), so one smoke run covers all three."""
     import json
     import os
     import subprocess
@@ -442,7 +446,8 @@ def test_paged_smoke_benchmark():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "benchmarks", "serve_gpt.py"),
-         "--paged", "--smoke"],
+         "--paged", "--smoke", "--kv-dtype", "int8",
+         "--attn-kernel", "pallas"],
         capture_output=True, text=True, timeout=420, env=env, cwd=root)
     assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
     rows = [json.loads(line) for line in proc.stdout.splitlines()
@@ -456,6 +461,18 @@ def test_paged_smoke_benchmark():
     assert any("paged_paged_mode" in m for m in modes)
     paged_row = next(r for m, r in modes.items() if "paged_paged_mode" in m)
     assert paged_row["prefix_hits"] > 0     # the probe actually hit
+
+    # ISSUE 16 arm: int8 KV admits >= 1.5x lanes at equal KV bytes.
+    kv_ab = [r for r in rows if r["metric"].endswith("kv_dtype_ab")]
+    assert kv_ab, rows
+    assert kv_ab[0]["value"] >= 1.5
+    assert kv_ab[0]["bytes_per_token_ratio"] > 1.5
+    # ISSUE 16 arm: the pallas kernel streams token-identical output.
+    kern_ab = [r for r in rows if r["metric"].endswith("attn_kernel_ab")]
+    assert kern_ab, rows
+    assert kern_ab[0]["token_identical_temp0"] is True
+    kern_mode = next(r for m, r in modes.items() if "attn_pallas_mode" in m)
+    assert kern_mode["kernel_dispatches"] > 0
 
 
 def test_prefix_cache_survives_pinned_eviction():
