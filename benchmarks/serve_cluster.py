@@ -1,0 +1,418 @@
+"""Million-user cluster-serving harness: the standing A/B for every
+scaling PR (ISSUE 17).
+
+Drives the SLO-driven autoscaler end to end with a synthetic workload
+shaped like real multi-tenant traffic:
+
+- **diurnal arrival curve**: open-loop Poisson arrivals whose rate
+  sweeps a raised-cosine between ``--rate-lo`` and ``--rate-hi`` over
+  ``--period`` seconds (a compressed day);
+- **heavy-tailed lengths**: per-tenant lognormal output lengths (the
+  p99 stream is ~an order of magnitude longer than the median);
+- **multi-tenant mix**: tenants with zipf-ish weights and distinct
+  length profiles, users drawn from a million-id space so cache-key
+  cardinality looks like production, not like a loop variable;
+- **chaos**: a replica kill AND a controller kill mid-ramp. Replicas
+  are detached named actors and the desired state is journaled, so the
+  revived controller must adopt the fleet (zero orphans) and every
+  client stream must survive (resumable replay; routers degrade to
+  cached membership while the controller is down).
+
+Two standing comparisons:
+
+- the **chaos row** (``serve_cluster_autoscale_chaos``): zero broken
+  streams, zero orphan replicas after convergence, and the convergence
+  time after each fault;
+- the **A/B row** (``serve_cluster_goodput_ab``, full mode): goodput
+  per chip-second — completed in-SLO tokens divided by the integral of
+  live replica count — autoscaled vs a static fleet pinned at
+  ``max_replicas``, same workload seed. Idle accelerator time is the
+  dominant serving cost on TPUs; the autoscaled run must win this at
+  equal SLO.
+
+``--smoke`` is the tier-1 CI hook: a short curve, both chaos kills,
+asserts convergence + zero broken streams + zero orphans.
+
+JSON lines on stdout, one row per metric (serve_gpt.py idiom).
+"""
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VOCAB = 50257
+
+#: tenant -> (arrival weight, lognormal mu, sigma) for output lengths.
+TENANTS = {
+    "chat": (0.6, 2.2, 0.6),
+    "code": (0.3, 2.8, 0.8),
+    "batch": (0.1, 3.2, 1.0),
+}
+USER_SPACE = 1_000_000
+
+
+def token_at(seed: int, i: int) -> int:
+    """The deterministic stream: token i of the stream seeded ``seed``.
+    Shared by replica and client, so a resumed stream is verifiable
+    token by token."""
+    return (seed * 1_000_003 + i * 7_919) % VOCAB
+
+
+def sample_request(rng: random.Random, max_out: int) -> dict:
+    tenant = rng.choices(list(TENANTS), weights=[w for w, _, _ in
+                                                TENANTS.values()])[0]
+    _, mu, sigma = TENANTS[tenant]
+    out = max(2, min(max_out, int(rng.lognormvariate(mu, sigma))))
+    user = rng.randrange(USER_SPACE)
+    return {"tenant": tenant, "user": user, "out": out,
+            "seed": (user * 2_654_435_761 + out) % (1 << 31)}
+
+
+def diurnal_rate(t: float, period: float, lo: float, hi: float) -> float:
+    """Raised-cosine arrival rate: trough at t=0, peak at period/2."""
+    return lo + (hi - lo) * 0.5 * (1 - math.cos(2 * math.pi * t / period))
+
+
+def make_deployment(serve, *, autoscaled: bool, max_replicas: int,
+                    tok_s: float):
+    ac = None
+    num = max_replicas
+    if autoscaled:
+        ac = serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=max_replicas,
+            target_ongoing_requests=1.5, upscale_delay_s=0.2,
+            downscale_delay_s=1.0, metrics_interval_s=0.1,
+            ema_tau_s=0.5, hysteresis=0.1, upscale_step=2,
+            downscale_step=1)
+        num = 1
+
+    @serve.deployment(num_replicas=num, max_ongoing_requests=4,
+                      autoscaling_config=ac, health_check_period_s=0.3,
+                      graceful_shutdown_timeout_s=15.0)
+    class SynthLLM:
+        """Deterministic synthetic decode: one token per ``tok_s`` of
+        driver sleep. A resumed stream replays identically (the prefix
+        is suppressed replica-side), so chaos correctness is checkable
+        token by token."""
+
+        def __call__(self, request):
+            seed, out = int(request["seed"]), int(request["out"])
+            for i in range(out):
+                time.sleep(tok_s)
+                yield token_at(seed, i)
+
+    return SynthLLM
+
+
+class FleetSampler(threading.Thread):
+    """Polls serve.status() to integrate replica count over time —
+    the chip-seconds denominator of the goodput metric — and records
+    the replica timeline for convergence analysis."""
+
+    def __init__(self, serve, app: str, dname: str, poll_s: float = 0.2):
+        super().__init__(daemon=True, name="fleet-sampler")
+        self.serve, self.app, self.dname = serve, app, dname
+        self.poll_s = poll_s
+        self.chip_seconds = 0.0
+        self.timeline = []          # (t, replicas, target)
+        self.peak = 0
+        self._halt = threading.Event()
+
+    def run(self):
+        last = time.monotonic()
+        while not self._halt.is_set():
+            time.sleep(self.poll_s)
+            now = time.monotonic()
+            try:
+                st = self.serve.status()["applications"][self.app][
+                    "deployments"][self.dname]
+                n, tgt = int(st["replicas"]), int(st["target"])
+            except Exception:  # noqa: BLE001 - controller down mid-chaos
+                continue
+            self.chip_seconds += n * (now - last)
+            last = now
+            self.peak = max(self.peak, n)
+            self.timeline.append((now, n, tgt))
+
+    def stop(self):
+        self._halt.set()
+
+
+def live_replica_names(app: str) -> set:
+    from ray_tpu.util.state import list_actors
+
+    prefix = f"SERVE_REPLICA:{app}:"
+    return {a["name"] for a in list_actors()
+            if a["state"] == "ALIVE"
+            and (a.get("name") or "").startswith(prefix)}
+
+
+def membership_names(app: str, dname: str) -> set:
+    import ray_tpu as rt
+    from ray_tpu.serve.autoscaler import replica_actor_name
+    from ray_tpu.serve.config import SERVE_CONTROLLER_NAME
+
+    ctrl = rt.get_actor(SERVE_CONTROLLER_NAME, timeout=10)
+    info = rt.get(ctrl.get_replicas.remote(app, dname), timeout=15)
+    return {replica_actor_name(app, rid)
+            for rid in (info or {"replicas": {}})["replicas"]}
+
+
+def wait_converged(app: str, dname: str, timeout_s: float = 45.0):
+    """Seconds until the live named-actor census exactly matches the
+    controller membership (no orphans, no ghosts); None on timeout."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            members = membership_names(app, dname)
+            if members and live_replica_names(app) == members:
+                return time.monotonic() - t0
+        except Exception:  # noqa: BLE001 - controller mid-revival
+            pass
+        time.sleep(0.3)
+    return None
+
+
+def revive_controller(timeout_s: float = 45.0):
+    import ray_tpu as rt
+    from ray_tpu.serve import api as sapi
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            ctrl = sapi._get_or_create_controller()
+            rt.get(ctrl.status.remote(), timeout=5)
+            with sapi._client_lock:
+                sapi._client["controller"] = ctrl
+            return ctrl
+        except Exception:  # noqa: BLE001 - dead name not reaped yet
+            time.sleep(0.3)
+    raise TimeoutError("controller did not revive")
+
+
+def run_cell(args, *, autoscaled: bool, chaos: bool) -> dict:
+    """One A/B cell: the full diurnal curve against one fleet config.
+    Returns the stats row; callers own the asserts."""
+    import ray_tpu as rt
+    from ray_tpu import serve
+
+    app = "cluster_auto" if autoscaled else "cluster_static"
+    dname = "SynthLLM"
+    serve.start(proxy=False)
+    SynthLLM = make_deployment(serve, autoscaled=autoscaled,
+                               max_replicas=args.max_replicas,
+                               tok_s=args.tok_s)
+    handle = serve.run(SynthLLM.bind(), name=app, route_prefix=None)
+    # Warm: one full stream before the clock starts.
+    warm = {"seed": 1, "out": 4, "tenant": "chat", "user": 0}
+    assert [int(x) for x in handle.options(stream=True).remote(warm)] == \
+        [token_at(1, i) for i in range(4)]
+
+    sampler = FleetSampler(serve, app, dname)
+    sampler.start()
+
+    rng = random.Random(args.seed)
+    lock = threading.Lock()
+    stats = {"requests": 0, "completed": 0, "good": 0, "good_tokens": 0,
+             "tokens": 0, "broken": [], "max_stall_ms": 0.0}
+    threads = []
+
+    def client(req: dict):
+        t0 = time.monotonic()
+        slo_s = req["out"] * args.tok_s * 6 + 3.0
+        toks, last, stall = [], time.monotonic(), 0.0
+        try:
+            it = handle.options(stream=True, resumable=True,
+                                timeout_s=slo_s + 60.0).remote(req)
+            for item in it:
+                now = time.monotonic()
+                stall = max(stall, now - last)
+                last = now
+                toks.append(int(item))
+            expect = [token_at(req["seed"], i) for i in range(req["out"])]
+            if toks != expect:
+                raise AssertionError(
+                    f"stream corrupted: {toks[:4]}... != {expect[:4]}...")
+            wall = time.monotonic() - t0
+            with lock:
+                stats["completed"] += 1
+                stats["tokens"] += len(toks)
+                stats["max_stall_ms"] = max(stats["max_stall_ms"],
+                                            stall * 1000)
+                if wall <= slo_s:
+                    stats["good"] += 1
+                    stats["good_tokens"] += len(toks)
+        except Exception as e:  # noqa: BLE001 - every failure is a
+            # broken client stream, the thing this harness exists to
+            # count; asserted zero by the caller
+            with lock:
+                stats["broken"].append(repr(e)[:200])
+
+    kills = 0
+    convergences = []
+
+    def chaos_monkey():
+        """One replica kill, then one controller kill, both mid-ramp
+        (the autoscaler is actively moving targets when they land)."""
+        nonlocal kills
+        time.sleep(args.duration * 0.3)
+        try:
+            victims = membership_names(app, dname)
+            if victims:
+                victim = sorted(victims)[0]
+                rt.kill(rt.get_actor(victim, timeout=5))
+                kills += 1
+                c = wait_converged(app, dname)
+                convergences.append(("replica_kill", c))
+        except Exception as e:  # noqa: BLE001 - surfaced via the row
+            convergences.append(("replica_kill", f"error: {e!r}"))
+        time.sleep(args.duration * 0.2)
+        try:
+            from ray_tpu.serve.config import SERVE_CONTROLLER_NAME
+
+            rt.kill(rt.get_actor(SERVE_CONTROLLER_NAME, timeout=5))
+            kills += 1
+            revive_controller()
+            c = wait_converged(app, dname)
+            convergences.append(("controller_kill", c))
+        except Exception as e:  # noqa: BLE001 - surfaced via the row
+            convergences.append(("controller_kill", f"error: {e!r}"))
+
+    monkey = None
+    if chaos:
+        monkey = threading.Thread(target=chaos_monkey, daemon=True,
+                                  name="chaos-monkey")
+        monkey.start()
+
+    # Open-loop Poisson arrivals along the diurnal curve.
+    t_start = time.monotonic()
+    while True:
+        t = time.monotonic() - t_start
+        if t >= args.duration:
+            break
+        rate = diurnal_rate(t, args.period, args.rate_lo, args.rate_hi)
+        time.sleep(rng.expovariate(rate) if rate > 0 else 0.1)
+        req = sample_request(rng, args.max_out)
+        stats["requests"] += 1
+        th = threading.Thread(target=client, args=(req,), daemon=True)
+        th.start()
+        threads.append(th)
+
+    for th in threads:
+        th.join(timeout=180)
+    if monkey is not None:
+        monkey.join(timeout=180)
+    final_conv = wait_converged(app, dname)
+    sampler.stop()
+    sampler.join(timeout=10)
+
+    members = membership_names(app, dname)
+    census = live_replica_names(app)
+    orphans = sorted(census - members)
+    wall = time.monotonic() - t_start
+    chips = max(sampler.chip_seconds, 1e-9)
+    row = {
+        "app": app, "autoscaled": autoscaled, "chaos": chaos,
+        "wall_s": round(wall, 2),
+        "requests": stats["requests"], "completed": stats["completed"],
+        "broken_streams": len(stats["broken"]),
+        "broken_detail": stats["broken"][:4],
+        "in_slo": stats["good"],
+        "tokens": stats["tokens"],
+        "chip_seconds": round(chips, 2),
+        "goodput_tokens_per_chip_s": round(stats["good_tokens"] / chips,
+                                           3),
+        "peak_replicas": sampler.peak,
+        "max_stall_ms": round(stats["max_stall_ms"], 1),
+        "kills": kills,
+        "convergence": [(k, round(c, 2) if isinstance(c, float) else c)
+                        for k, c in convergences],
+        "converged": final_conv is not None and all(
+            isinstance(c, float) for _, c in convergences),
+        "orphans": len(orphans),
+        "orphan_names": orphans,
+    }
+    serve.delete(app)
+    serve.shutdown()
+    return row
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 hook: short curve, both chaos kills, "
+                        "hard asserts")
+    p.add_argument("--duration", type=float, default=60.0,
+                   help="seconds of arrival curve per cell")
+    p.add_argument("--period", type=float, default=40.0,
+                   help="diurnal period (the compressed day)")
+    p.add_argument("--rate-lo", type=float, default=0.5)
+    p.add_argument("--rate-hi", type=float, default=6.0)
+    p.add_argument("--max-replicas", type=int, default=3)
+    p.add_argument("--max-out", type=int, default=48,
+                   help="output-length cap (heavy tail clamps here)")
+    p.add_argument("--tok-s", type=float, default=0.02,
+                   help="synthetic decode seconds per token")
+    p.add_argument("--seed", type=int, default=17)
+    p.add_argument("--no-ab", action="store_true",
+                   help="skip the static baseline cell")
+    args = p.parse_args()
+
+    if args.smoke:
+        args.duration = 18.0
+        args.period = 12.0
+        args.rate_lo, args.rate_hi = 0.5, 4.0
+        args.max_out = 24
+        args.tok_s = 0.01
+        args.no_ab = True
+
+    import ray_tpu as rt
+
+    rt.init(num_cpus=8, num_tpus=0, ignore_reinit_error=True)
+    try:
+        auto = run_cell(args, autoscaled=True, chaos=True)
+        auto_row = dict(auto, metric="serve_cluster_autoscale_chaos",
+                        value=auto["broken_streams"],
+                        unit="broken_streams", smoke=bool(args.smoke))
+        print(json.dumps(auto_row))
+
+        if not args.no_ab:
+            static = run_cell(args, autoscaled=False, chaos=False)
+            print(json.dumps(dict(static,
+                                  metric="serve_cluster_static_baseline",
+                                  value=static[
+                                      "goodput_tokens_per_chip_s"],
+                                  unit="tokens_per_chip_s")))
+            ab = {
+                "metric": "serve_cluster_goodput_ab",
+                "value": round(auto["goodput_tokens_per_chip_s"]
+                               - static["goodput_tokens_per_chip_s"], 3),
+                "unit": "tokens_per_chip_s_delta",
+                "autoscaled": auto["goodput_tokens_per_chip_s"],
+                "static": static["goodput_tokens_per_chip_s"],
+                "autoscaled_in_slo": auto["in_slo"],
+                "static_in_slo": static["in_slo"],
+            }
+            print(json.dumps(ab))
+            assert auto["goodput_tokens_per_chip_s"] > \
+                static["goodput_tokens_per_chip_s"], \
+                "autoscaled fleet must beat the static fleet on " \
+                "goodput per chip-second at equal SLO"
+
+        assert auto["broken_streams"] == 0, auto["broken_detail"]
+        assert auto["orphans"] == 0, auto["orphan_names"]
+        assert auto["kills"] >= 1, "chaos never landed a kill"
+        assert auto["converged"], auto["convergence"]
+    finally:
+        rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -154,6 +154,47 @@ class Histogram(_Instrument):
             return [(k, list(v)) for k, v in self._values.items()]
 
 
+class EMA:
+    """Exponential moving average with TIME-CONSTANT semantics for
+    irregularly-sampled gauge signals (the autoscaler's queue-depth /
+    occupancy inputs are too noisy to act on raw — ISSUE 17).
+
+    Each update folds the sample in with ``alpha = 1 - exp(-dt / tau)``
+    where ``dt`` is the time since the previous sample: after ``tau``
+    seconds of steady samples the average has closed ~63.2% of the gap
+    to the new level, after ``3 * tau`` ~95% — independent of the
+    sampling rate, unlike a fixed-alpha EMA (the property the unit
+    tests pin). The first sample initializes the average outright; a
+    non-positive ``dt`` (clock skew, duplicate timestamp) is treated as
+    ``alpha = 0`` (hold). Not thread-safe — owned by one control loop.
+    """
+
+    def __init__(self, tau_s: float):
+        if tau_s <= 0:
+            raise ValueError("tau_s must be > 0")
+        self.tau_s = float(tau_s)
+        self.value: Optional[float] = None
+        self.last_t: Optional[float] = None
+
+    def update(self, sample: float, t: float) -> float:
+        import math
+
+        if self.value is None:
+            self.value = float(sample)
+            self.last_t = float(t)
+            return self.value
+        dt = float(t) - self.last_t
+        if dt > 0:
+            alpha = 1.0 - math.exp(-dt / self.tau_s)
+            self.value += alpha * (float(sample) - self.value)
+            self.last_t = float(t)
+        return self.value
+
+    def reset(self):
+        self.value = None
+        self.last_t = None
+
+
 class MetricsRegistry:
     def __init__(self, strict: Optional[bool] = None):
         self._lock = threading.Lock()
@@ -528,6 +569,18 @@ def serve_metrics() -> dict:
                 "prefill (where=router: no prefill replica answered; "
                 "where=engine: shipped payload unavailable or failed "
                 "byte verification)"),
+            # ---- SLO-driven autoscaler (ISSUE 17). Observed by the
+            # controller's reconcile loop, once per applied decision /
+            # per held tick.
+            autoscale_decisions=Counter(
+                "serve_autoscale_decisions_total",
+                "Autoscaler decisions applied, by direction (up | "
+                "down); labels carry deployment and role group"),
+            autoscale_held=Counter(
+                "serve_autoscale_held_total",
+                "Autoscaler ticks that degraded to a conservative hold, "
+                "by reason (stale_signal | missing_signal | cold_start "
+                "| cooldown | stabilizing | idle_wait)"),
         )
         return _serve
 

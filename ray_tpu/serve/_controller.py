@@ -16,6 +16,8 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
+from .autoscaler import (PLAIN_GROUP, Autoscaler, DesiredStateJournal,
+                         replica_actor_name)
 from .config import AutoscalingConfig, DeploymentConfig
 
 
@@ -31,6 +33,21 @@ class ServeController:
         self._apps: Dict[str, dict] = {}
         self._http_info: Optional[dict] = None
         self._replica_counter = 0
+        # SLO-driven autoscaling + crash-safe desired state (ISSUE 17):
+        # the autoscaler turns health-pass signals into bounded scaling
+        # decisions; the journal write-aheads every target change and
+        # replica intent to the cluster KV so a SIGKILLed controller's
+        # successor resumes reconciliation idempotently (_maybe_recover).
+        self._autoscaler = Autoscaler()
+        self._journal = DesiredStateJournal()
+        self._recovered = False
+        # dname -> (tpot_p95_or_None, fetched_at): head-merged latency,
+        # refreshed at most ~1/s for deployments with a TPOT SLO.
+        self._tpot_cache: Dict[str, tuple] = {}
+        # Test hook (mirrors engine.inject_fault): named reconcile
+        # points that hard-exit the controller process, for crash-safe
+        # reconciliation chaos tests.
+        self._crash_points: set = set()
         # Proxy fleet (reference: proxy_state_manager — one proxy per
         # node): node_id -> {"handle", "info"}. Populated once
         # ensure_proxies() records the bind options.
@@ -55,6 +72,10 @@ class ServeController:
         """
         name = spec["name"]
         with self._reconcile_lock:
+            # Adopt any journaled fleet FIRST: a redeploy racing a
+            # controller restart must see the adopted replicas or it
+            # would start a duplicate set (double scale-up).
+            self._maybe_recover()
             with self._lock:
                 app = self._apps.setdefault(
                     name, {"name": name, "route_prefix": None,
@@ -78,6 +99,10 @@ class ServeController:
                     deferred.extend(self._apply_deployment(app, dspec))
             for action in deferred:
                 action()
+            # Journal the app spec + desired targets BEFORE the first
+            # reconcile actuates them: a controller killed mid-rollout
+            # must find the full desired state, not a torso.
+            self._journal_app(name)
             self._reconcile_once()
         deadline = time.time() + 60
         while time.time() < deadline:
@@ -175,10 +200,10 @@ class ServeController:
                 dstate["version"] += 1
             self._drain_and_kill(
                 victims, dstate["config"].graceful_shutdown_timeout_s,
-                dstate["name"])
+                dstate["name"], app_name=dstate.get("app"))
 
     def _drain_and_kill(self, victims: list, timeout_s: float,
-                        deployment: str):
+                        deployment: str, app_name: Optional[str] = None):
         """Graceful drain before any teardown (reconfigure, scale-down,
         health replacement, app delete), then the kill: each replica
         stops admitting (retryable pushback → routers re-pick), running
@@ -187,12 +212,28 @@ class ServeController:
         under ONE shared budget — N stalled victims cost the same wall
         time as one, so a wide scale-down cannot wedge the control
         loop. Drain count/duration are observed HERE — the controller
-        outlives the replica, so the observation always ships."""
+        outlives the replica, so the observation always ships.
+
+        With ``app_name`` the victims are journaled CONDEMNED before
+        the first drain RPC (crash-safe scale-down, ISSUE 17): a
+        controller killed anywhere in this method leaves its successor
+        a durable instruction to re-drain and kill them — named
+        replicas are detached actors and would otherwise outlive
+        everyone as orphans."""
         from .. import api as rt
         from .._private.metrics import serve_metrics
 
         if not victims:
             return
+        if app_name is not None:
+            try:
+                self._journal_intents(
+                    app_name, deployment,
+                    {r["rid"]: ("condemned", r.get("role"))
+                     for r in victims if r.get("rid")})
+            except Exception:  # noqa: BLE001 - journal lag; drain anyway
+                traceback.print_exc()
+            self._maybe_crash("drain_condemned")
         t0 = time.time()
         refs = []
         for r in victims:
@@ -206,6 +247,7 @@ class ServeController:
                         timeout=timeout_s + 2)
             except Exception:  # noqa: BLE001 - degrade to the kills
                 pass
+        self._maybe_crash("drain_pre_kill")
         sm = serve_metrics()
         labels = {"deployment": deployment}
         dt = time.time() - t0
@@ -216,10 +258,26 @@ class ServeController:
                 rt.kill(r["handle"])
             except Exception:  # noqa: BLE001
                 pass
+        if app_name is not None:
+            try:
+                self._journal_intents(
+                    app_name, deployment,
+                    {r["rid"]: None for r in victims if r.get("rid")})
+            except Exception:  # noqa: BLE001 - stale CONDEMNED entries
+                # are re-killed (idempotent) by the next recovery sweep
+                traceback.print_exc()
 
     # ------------------------------------------------------------ queries
-    def get_replicas(self, app_name: str, deployment_name: str
+    def get_replicas(self, app_name: str, deployment_name: str,
+                     pending: int = 0, router_id: str = ""
                      ) -> Optional[dict]:
+        # Routers piggyback their blocked-admission queue depth on the
+        # membership refresh (ISSUE 17): with zero replicas there is no
+        # replica to report load, so this is the scale-from-zero demand
+        # signal. Reports of 0 matter too — they clear the demand.
+        if router_id:
+            self._autoscaler.note_pending(app_name, deployment_name,
+                                          router_id, pending, time.time())
         with self._lock:
             app = self._apps.get(app_name)
             if app is None:
@@ -304,6 +362,29 @@ class ServeController:
                     # prefix hits, COW forks), same health-pass ride.
                     if d.get("engine"):
                         deps[dname]["engine"] = dict(d["engine"])
+                    # Autoscaler diagnosability (ISSUE 17): per-group
+                    # signal freshness next to the engine block — a
+                    # held decision (stale_signal / missing_signal) is
+                    # explicable from status() alone — plus the last
+                    # decision per group.
+                    if d["config"].autoscaling_config is not None \
+                            or role_targets:
+                        groups: Dict[str, list] = {}
+                        if role_targets:
+                            for role in role_targets:
+                                groups[role] = [
+                                    rid for rid, r in
+                                    d["replicas"].items()
+                                    if (r.get("role") or "both") == role]
+                        else:
+                            groups[PLAIN_GROUP] = list(d["replicas"])
+                        deps[dname]["signal_age_s"] = \
+                            self._autoscaler.signal_ages(
+                                name, dname, groups, time.time())
+                        last = self._autoscaler.last_decisions(name,
+                                                               dname)
+                        if last:
+                            deps[dname]["autoscale"] = last
                 apps[name] = {"route_prefix": app["route_prefix"],
                               "ingress": app["ingress"],
                               "deployments": deps}
@@ -371,6 +452,15 @@ class ServeController:
             return False
         for d in app["deployments"].values():
             self._teardown_deployment(d)
+        # Journal LAST: the condemn/kill path above is crash-safe on
+        # its own, and clearing first would leave a killed controller's
+        # successor no instruction to finish the teardown.
+        try:
+            self._journal.del_app(name)
+        except Exception:  # noqa: BLE001 - stale journal; recovery
+            # re-drains the (already dead) fleet idempotently
+            traceback.print_exc()
+        self._autoscaler.forget(name)
         return True
 
     def shutdown_serve(self):
@@ -415,6 +505,10 @@ class ServeController:
     def _reconcile_once(self):
         with self._reconcile_lock:
             try:
+                self._maybe_recover()
+            except Exception:  # noqa: BLE001 - retried next tick
+                traceback.print_exc()
+            try:
                 self._reconcile_proxies()
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
@@ -442,6 +536,13 @@ class ServeController:
         from .. import api as rt
 
         period = d["config"].health_check_period_s
+        ac = d["config"].autoscaling_config
+        if ac is not None:
+            # The health pass doubles as the autoscaler's signal
+            # scrape: cap its cadence at the configured metrics
+            # interval so decision freshness tracks the config, not
+            # the (coarser) health period.
+            period = min(period, max(ac.metrics_interval_s, 0.05))
         if time.time() - d["last_health"] < period:
             return
         d["last_health"] = time.time()
@@ -490,6 +591,11 @@ class ServeController:
             try:
                 m = rt.get(mref,
                            timeout=max(deadline - time.monotonic(), 0.1))
+                # Autoscaler signal feed (ISSUE 17): a replica whose
+                # scrape fails simply records nothing this round, and
+                # the decision loop degrades to a hold for its group.
+                self._autoscaler.record(d["app"], d["name"], rid, m,
+                                        time.time())
                 life["expired"] += int(m.get("expired", 0))
                 life["overloaded"] += int(m.get("overloaded", 0))
                 life["total"] += int(m.get("total", 0))
@@ -537,6 +643,12 @@ class ServeController:
                                 ho.get(key, 0))
             except Exception:  # noqa: BLE001 - totals dip this round
                 pass
+        # Prune autoscaler signals for replicas the controller no
+        # longer lists (dead, drained, or scaled away) — a ghost entry
+        # would keep feeding a stale load reading into the decision.
+        with self._lock:
+            live = set(d["replicas"])
+        self._autoscaler.prune(d["app"], d["name"], live, time.time())
         d["lifecycle"] = life
         if engine:
             sp = engine.get("spec")
@@ -569,49 +681,97 @@ class ServeController:
             # window per victim.
             self._drain_and_kill(
                 victims, min(d["config"].graceful_shutdown_timeout_s,
-                             self._HEALTH_PROBE_TIMEOUT_S), d["name"])
+                             self._HEALTH_PROBE_TIMEOUT_S), d["name"],
+                app_name=d["app"])
 
     def _autoscale(self, d: dict):
-        from .. import api as rt
-
+        """SLO-driven autoscale tick (ISSUE 17): per role group, turn
+        the health-pass signal book into a bounded target change. The
+        decision logic lives in ``autoscaler.decide`` (hysteresis,
+        cooldowns, step caps, stale-signal holds, scale-to-zero,
+        cold-start grace); this method only snapshots the groups,
+        applies the returned targets, and journals them — actuation
+        stays with ``_scale_to_target``, whose scale-down path drains
+        before every kill."""
         ac: Optional[AutoscalingConfig] = d["config"].autoscaling_config
         if ac is None:
             return
-        if d.get("role_targets"):
-            # Role groups scale declaratively (the roles block IS the
-            # target per role); a single ongoing-requests signal cannot
-            # apportion replicas between compute-bound prefill and
+        role_targets = d.get("role_targets")
+        if role_targets and not ac.roles:
+            # Without per-role autoscaling overrides the roles block IS
+            # the target per role (declarative disaggregation, ISSUE
+            # 14); a fleet-wide ongoing signal cannot apportion
+            # replicas between compute-bound prefill and
             # bandwidth-bound decode.
             return
-        if time.time() - d["scale"]["last_metric"] < ac.metrics_interval_s:
+        now = time.time()
+        if now - d["scale"]["last_metric"] < ac.metrics_interval_s:
             return
-        d["scale"]["last_metric"] = time.time()
+        d["scale"]["last_metric"] = now
+        app_name, dname = d["app"], d["name"]
         with self._lock:
-            refs = [r["handle"].get_metrics.remote()
-                    for r in d["replicas"].values()]
-        total_ongoing = 0.0
-        for ref in refs:
+            if role_targets:
+                groups = {
+                    role: {"cur": tgt,
+                           "rids": [rid for rid, r in
+                                    d["replicas"].items()
+                                    if (r.get("role") or "both") == role]}
+                    for role, tgt in role_targets.items()}
+            else:
+                groups = {PLAIN_GROUP: {"cur": d["target"],
+                                        "rids": list(d["replicas"])}}
+        decisions = self._autoscaler.tick(
+            app_name, dname, ac, groups, now,
+            tpot_p95=self._tpot_p95(dname, ac, now))
+        changed = False
+        with self._lock:
+            for group, dec in decisions.items():
+                if dec.direction == "hold":
+                    continue
+                if group == PLAIN_GROUP:
+                    if d["target"] != dec.target:
+                        d["target"] = dec.target
+                        changed = True
+                elif d.get("role_targets") is not None and \
+                        d["role_targets"].get(group) != dec.target:
+                    d["role_targets"][group] = dec.target
+                    changed = True
+        if changed:
             try:
-                m = rt.get(ref, timeout=5)
-                total_ongoing += m["ongoing"]
-            except Exception:  # noqa: BLE001 - health loop reaps it
-                pass
-        cur = d["target"]
-        desired = math.ceil(total_ongoing / max(ac.target_ongoing_requests,
-                                                1e-9))
-        desired = max(ac.min_replicas, min(ac.max_replicas, desired))
-        sc = d["scale"]
-        if desired == cur:
-            sc["desired"] = None
-            return
-        if sc["desired"] != desired:
-            sc["desired"] = desired
-            sc["since"] = time.time()
-            return
-        delay = ac.upscale_delay_s if desired > cur else ac.downscale_delay_s
-        if time.time() - sc["since"] >= delay:
-            d["target"] = desired
-            sc["desired"] = None
+                self._journal_desired(app_name)
+            except Exception:  # noqa: BLE001 - journal lag: a crash
+                # now resumes from the previous targets, which the
+                # next tick's decision re-derives from live signals
+                traceback.print_exc()
+
+    def _tpot_p95(self, dname: str, ac: AutoscalingConfig,
+                  now: float) -> Optional[float]:
+        """Cluster-merged TPOT p95 for one deployment, cached ~1 s.
+        Only fetched when a TPOT SLO is configured; any head hiccup
+        degrades the SLO overlay to absent rather than failing the
+        tick."""
+        wants = ac.tpot_slo_s is not None or any(
+            (o or {}).get("tpot_slo_s") is not None
+            for o in (ac.roles or {}).values())
+        if not wants:
+            return None
+        cached = self._tpot_cache.get(dname)
+        if cached and now - cached[1] < max(ac.metrics_interval_s, 1.0):
+            return cached[0]
+        val = None
+        try:
+            from ..core.worker import CoreWorker
+
+            from .._private.metrics import histogram_summary
+
+            merged = CoreWorker.current().head_call("metrics_merged")
+            s = histogram_summary(merged, "serve_tpot_seconds",
+                                  {"deployment": dname})
+            val = s.get("p95_s") if s else None
+        except Exception:  # noqa: BLE001 - SLO overlay absent this tick
+            pass
+        self._tpot_cache[dname] = (val, now)
+        return val
 
     def _scale_to_target(self, app_name: str, dname: str, d: dict):
         with self._lock:
@@ -645,7 +805,8 @@ class ServeController:
             d["version"] += 1
             cfg = d["config"]
         self._drain_and_kill(list(stray.values()),
-                             cfg.graceful_shutdown_timeout_s, dname)
+                             cfg.graceful_shutdown_timeout_s, dname,
+                             app_name=d["app"])
 
     def _scale_role(self, app_name: str, dname: str, d: dict,
                     role: Optional[str], target: Optional[int]):
@@ -660,8 +821,15 @@ class ServeController:
                 target = d["target"]
             cfg = d["config"]
         if have < target:
-            new = [self._start_replica(app_name, dname, d, role=role)
-                   for _ in range(target - have)]
+            new = []
+            for _ in range(target - have):
+                try:
+                    new.append(self._start_replica(app_name, dname, d,
+                                                   role=role))
+                except Exception:  # noqa: BLE001 - journal/create
+                    # failure: retried next tick (intent, if written,
+                    # is swept by recovery)
+                    traceback.print_exc()
             ok = []
             for rid, handle in new:
                 try:
@@ -671,17 +839,40 @@ class ServeController:
                                          timeout=10)
                     except Exception:  # noqa: BLE001 - routing hint only
                         node_id = None
+                    self._maybe_crash("scale_up_created")
                     ok.append((rid, handle, node_id))
                 except Exception:  # noqa: BLE001
                     traceback.print_exc()
+                    # Never-ready replica: kill it and clear its
+                    # intent, or the named (detached) actor would
+                    # linger as an orphan no journal entry describes.
+                    try:
+                        rt.kill(handle)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    try:
+                        self._journal_intents(app_name, dname,
+                                              {rid: None})
+                    except Exception:  # noqa: BLE001 - swept later
+                        pass
             if ok:
                 with self._lock:
                     for rid, handle, node_id in ok:
                         d["replicas"][rid] = {"handle": handle,
+                                              "rid": rid,
                                               "node_id": node_id,
                                               "role": role,
                                               "created": time.time()}
                     d["version"] += 1
+                # Confirm AFTER membership: a crash in between leaves
+                # STARTING + a live actor, which recovery adopts.
+                try:
+                    self._journal_intents(
+                        app_name, dname,
+                        {rid: ("live", role) for rid, _h, _n in ok})
+                except Exception:  # noqa: BLE001 - stays STARTING;
+                    # recovery adopts it the same way
+                    traceback.print_exc()
         elif have > target:
             with self._lock:
                 victims = sorted(members.items(),
@@ -691,7 +882,8 @@ class ServeController:
                     d["replicas"].pop(rid, None)
                 d["version"] += 1
             self._drain_and_kill([r for _rid, r in victims],
-                                 cfg.graceful_shutdown_timeout_s, dname)
+                                 cfg.graceful_shutdown_timeout_s, dname,
+                                 app_name=app_name)
 
     def drain_role(self, app_name: str, deployment_name: str, role: str,
                    remove: bool = True,
@@ -745,9 +937,194 @@ class ServeController:
                 if d.get("role_targets"):
                     d["role_targets"][role] = 0
                 d["version"] += 1
+            try:
+                self._journal_desired(app_name)
+            except Exception:  # noqa: BLE001 - recovery re-zeroes via
+                # the condemned intents below
+                traceback.print_exc()
             self._drain_and_kill(list(victims.values()), budget,
-                                 deployment_name)
+                                 deployment_name, app_name=app_name)
             return sorted(victims)
+
+    # -------------------------- crash-safe desired state (ISSUE 17)
+    def _journal_app(self, name: str):
+        """Journal one app's full spec (payloads + configs) and its
+        desired targets. Raises on journal failure — deploy_app is the
+        only caller and a deploy that cannot be made durable should
+        fail loudly, not silently lose crash safety."""
+        with self._lock:
+            app = self._apps.get(name)
+            if app is None:
+                return
+            blob = {"name": name,
+                    "route_prefix": app["route_prefix"],
+                    "ingress": app["ingress"],
+                    "stream": bool(app.get("stream")),
+                    "deployments": [
+                        {"name": d["name"], "payload": d["payload"],
+                         "config": d["config"]}
+                        for d in app["deployments"].values()]}
+        self._journal.put_app(name, blob)
+        self._journal_desired(name)
+
+    def _journal_desired(self, app_name: str):
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return
+            desired = {dname: {"target": d["target"],
+                               "role_targets": d.get("role_targets")}
+                       for dname, d in app["deployments"].items()}
+        self._journal.put_desired(app_name, desired)
+
+    def _journal_intents(self, app_name: str, dname: str,
+                         updates: Dict[str, Any]):
+        """Apply ``{rid: None | (state, role)}`` to the app's replica
+        intent document (one read-modify-write; every caller holds
+        ``_reconcile_lock``, which serializes them)."""
+        intents = self._journal.get_replicas(app_name)
+        ents = intents.setdefault(dname, {})
+        for rid, up in updates.items():
+            if up is None:
+                ents.pop(rid, None)
+            else:
+                state, role = up
+                ents[rid] = {"role": role, "state": state,
+                             "t": time.time()}
+        if not ents:
+            intents.pop(dname, None)
+        self._journal.put_replicas(app_name, intents)
+
+    def _maybe_recover(self):
+        """Resume reconciliation from the journal after a controller
+        restart (idempotent, runs once per controller life).
+
+        For every journaled app: rebuild deployment state from the
+        spec + desired-target documents, then reconcile the replica
+        intents against reality — a LIVE/STARTING entry whose named
+        actor answers is ADOPTED (counted toward its group's target,
+        so no double scale-up), an entry with no live actor is dropped
+        (the create never landed, or the replica died with nobody
+        watching), and CONDEMNED entries are re-drained and killed
+        (the predecessor was mid-scale-down; clients resume on the
+        survivors). Orphans are impossible as long as intents are
+        written ahead of creates — every live replica has an entry,
+        and every entry is either adopted or torn down here."""
+        with self._lock:
+            if self._recovered:
+                return
+            self._recovered = True
+        try:
+            names = self._journal.list_apps()
+        except Exception:  # noqa: BLE001 - head unreachable: flip the
+            # gate back so the next tick retries recovery
+            with self._lock:
+                self._recovered = False
+            return
+        for name in names:
+            with self._lock:
+                if name in self._apps:
+                    continue
+            try:
+                self._recover_app(name)
+            except Exception:  # noqa: BLE001 - one app's bad journal
+                # must not block the others (or the loop)
+                traceback.print_exc()
+
+    def _recover_app(self, name: str):
+        from .. import api as rt
+
+        blob = self._journal.get_app(name)
+        if blob is None:
+            return
+        desired = self._journal.get_desired(name)
+        intents = self._journal.get_replicas(name)
+        app = {"name": name, "route_prefix": blob.get("route_prefix"),
+               "ingress": blob.get("ingress"),
+               "stream": bool(blob.get("stream")), "deployments": {}}
+        for dspec in blob.get("deployments", []):
+            dname = dspec["name"]
+            cfg: DeploymentConfig = dspec["config"]
+            want = desired.get(dname) or {}
+            app["deployments"][dname] = {
+                "app": name, "name": dname,
+                "payload": dspec["payload"], "config": cfg,
+                "target": int(want.get("target",
+                                       cfg.initial_target())),
+                "role_targets": want.get("role_targets",
+                                         self._role_targets(cfg)),
+                "version": 0, "replicas": {},
+                "scale": {"desired": None, "since": 0.0,
+                          "last_metric": 0.0},
+                "last_health": 0.0,
+            }
+        survivors: Dict[str, dict] = {}
+        condemned: Dict[str, list] = {}
+        for dname, ents in intents.items():
+            d = app["deployments"].get(dname)
+            for rid, ent in ents.items():
+                try:
+                    n = int(rid.rsplit("#", 1)[1])
+                except (IndexError, ValueError):
+                    n = 0
+                # Past the journaled ids, or a fresh create would
+                # collide with an adopted name.
+                self._replica_counter = max(self._replica_counter, n)
+                try:
+                    handle = rt.get_actor(replica_actor_name(name, rid),
+                                          timeout=2)
+                except Exception:  # noqa: BLE001 - no such actor
+                    handle = None
+                if handle is None:
+                    continue       # entry dropped: nothing to adopt
+                if d is None or ent.get("state") == "condemned":
+                    # Keep the entry CONDEMNED until the kill below
+                    # completes — a crash mid-recovery must leave the
+                    # re-drain instruction in place.
+                    survivors.setdefault(dname, {})[rid] = {
+                        "role": ent.get("role"), "state": "condemned",
+                        "t": time.time()}
+                    condemned.setdefault(dname, []).append(
+                        {"handle": handle, "rid": rid,
+                         "role": ent.get("role")})
+                    continue
+                try:
+                    node_id = rt.get(handle.get_node_id.remote(),
+                                     timeout=5)
+                except Exception:  # noqa: BLE001 - routing hint only
+                    node_id = None
+                d["replicas"][rid] = {"handle": handle, "rid": rid,
+                                      "node_id": node_id,
+                                      "role": ent.get("role"),
+                                      "created": time.time()}
+                survivors.setdefault(dname, {})[rid] = {
+                    "role": ent.get("role"), "state": "live",
+                    "t": time.time()}
+        with self._lock:
+            self._apps[name] = app
+        self._journal.put_replicas(name, survivors)
+        for dname, victims in condemned.items():
+            d = app["deployments"].get(dname)
+            budget = d["config"].graceful_shutdown_timeout_s if d \
+                else 5.0
+            self._drain_and_kill(victims, budget, dname, app_name=name)
+
+    def inject_crash(self, point: str) -> bool:
+        """Chaos-test hook (mirrors ``engine.inject_fault``): hard-exit
+        the controller process (``os._exit(44)``) the next time the
+        reconcile path passes ``point``. Points: ``scale_up_intent``
+        (intent journaled, actor not yet created), ``scale_up_created``
+        (actor live, membership/journal not yet confirmed),
+        ``drain_condemned`` (victims condemned, drain not yet sent),
+        ``drain_pre_kill`` (drained, not yet killed)."""
+        self._crash_points.add(point)
+        return True
+
+    def _maybe_crash(self, point: str):
+        if point in self._crash_points:
+            import os
+
+            os._exit(44)
 
     def _start_replica(self, app_name: str, dname: str, d: dict,
                        role: Optional[str] = None):
@@ -757,12 +1134,23 @@ class ServeController:
         cfg: DeploymentConfig = d["config"]
         self._replica_counter += 1
         rid = f"{dname}#{self._replica_counter}"
+        # WRITE-AHEAD (ISSUE 17): the intent reaches the journal BEFORE
+        # the create RPC, so every replica that can possibly exist has
+        # an entry a restarted controller reconciles against — adopt if
+        # it came up, sweep if it never did. A failed journal write
+        # aborts the create (the safe side: no actor without an entry).
+        self._journal_intents(app_name, dname, {rid: ("starting", role)})
+        self._maybe_crash("scale_up_intent")
         opts = dict(cfg.ray_actor_options)
         opts.setdefault("num_cpus", 1)
         # Replicas spread across nodes by default so one node's death
         # never takes a whole deployment down (reference:
         # deployment_scheduler.py spread policy).
         opts.setdefault("scheduling_strategy", "SPREAD")
+        # Named => DETACHED in this runtime: the replica survives a
+        # SIGKILLed controller (streams keep flowing) and the successor
+        # re-attaches by name instead of starting a duplicate.
+        opts["name"] = replica_actor_name(app_name, rid)
         actor_cls = rt.remote(Replica).options(
             max_concurrency=cfg.max_ongoing_requests + 4, **opts)
         # Role stamping (ISSUE 14): the replica sees its OWN role in

@@ -1,0 +1,472 @@
+"""SLO-driven per-role-group autoscaling + crash-safe desired state.
+
+The control loop (ISSUE 17) closes the signal -> decision -> actuation
+pipeline over signals the stack already exports:
+
+- **signal**: the controller's health pass piggybacks each replica's
+  ``get_metrics()`` (engine ``queue_depth`` / ``active_slots`` /
+  ``slots``, replica ``ongoing``) into this module's signal book, and
+  every router reports its blocked-admission ``pending`` count when it
+  refreshes membership — the scale-from-zero demand signal, since a
+  zero-replica group has no replica to report anything.
+- **decision**: :func:`decide` turns one group's aggregated signals
+  into a bounded target — EMA-smoothed load (see
+  ``_private.metrics.EMA``), hysteresis dead-band, stability delays,
+  per-direction cooldowns, capped step sizes. Stale or missing signals
+  (a replica that missed its health pass) degrade to a conservative
+  hold; a scale-from-zero stamps a cold-start grace window so the
+  burst that queued behind the compiling replica doesn't panic-scale.
+- **actuation**: the controller applies the returned targets through
+  its existing reconcile machinery, so scale-down always routes
+  through the graceful drain path (never kills an in-flight stream).
+
+Role groups decide independently: prefill replicas track admission
+backlog (burst arrival), decode replicas track slot occupancy and the
+TPOT p95 SLO, each under its own :meth:`AutoscalingConfig.for_role`
+view.
+
+Crash safety lives in :class:`DesiredStateJournal`: desired targets and
+replica intents are written ahead to the cluster KV store (head-side,
+WAL-persisted — it survives a SIGKILLed controller), replicas are
+named/detached actors, and a restarted controller adopts the journaled
+fleet instead of double-scaling or orphaning it.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .._private.metrics import EMA, serve_metrics
+from .config import AutoscalingConfig
+
+#: Cluster-KV namespace shared with the declarative config plane.
+KV_NS = "serve"
+_APP_PREFIX = "journal/app/"
+_DESIRED_PREFIX = "journal/desired/"
+_REPLICA_PREFIX = "journal/replicas/"
+
+#: Plain (non-disaggregated) deployments decide as one group under
+#: this key; role-split deployments use their role names.
+PLAIN_GROUP = "all"
+
+
+def replica_actor_name(app_name: str, rid: str) -> str:
+    """Cluster-wide name of a replica actor. Named actors are DETACHED
+    in this runtime (they survive their creator), which is exactly what
+    lets a restarted controller adopt the fleet instead of the old
+    unnamed replicas being garbage-collected mid-stream."""
+    return f"SERVE_REPLICA:{app_name}:{rid}"
+
+
+# ---------------------------------------------------------------- journal
+class DesiredStateJournal:
+    """Write-ahead desired-state journal in the cluster KV store.
+
+    Three keys per application, all full-document overwrites (one
+    head-side op each, so a crash can only ever lose the newest write,
+    never corrupt the document):
+
+    - ``journal/app/{app}``: cloudpickled app spec (payloads +
+      configs) — enough to rebuild controller state from nothing;
+    - ``journal/desired/{app}``: JSON ``{dname: {"target", "role_targets"}}``;
+    - ``journal/replicas/{app}``: JSON ``{dname: {rid: {"role",
+      "state": starting|live|condemned, "t"}}}`` — intents are written
+      BEFORE the actor create / drain they describe, so every replica
+      that can possibly exist has a journal entry to reconcile against.
+    """
+
+    @staticmethod
+    def _kv():
+        from ..core.worker import CoreWorker
+
+        return CoreWorker.current()
+
+    # -- app spec ------------------------------------------------------
+    def put_app(self, app: str, spec_blob: dict):
+        import cloudpickle
+
+        self._kv().kv_put(_APP_PREFIX + app, cloudpickle.dumps(spec_blob),
+                          ns=KV_NS)
+
+    def get_app(self, app: str) -> Optional[dict]:
+        import cloudpickle
+
+        raw = self._kv().kv_get(_APP_PREFIX + app, ns=KV_NS)
+        return cloudpickle.loads(raw) if raw else None
+
+    def list_apps(self) -> List[str]:
+        keys = self._kv().kv_keys(_APP_PREFIX, ns=KV_NS)
+        return sorted(k[len(_APP_PREFIX):] for k in keys)
+
+    def del_app(self, app: str):
+        kv = self._kv()
+        for prefix in (_APP_PREFIX, _DESIRED_PREFIX, _REPLICA_PREFIX):
+            try:
+                kv.kv_del(prefix + app, ns=KV_NS)
+            except Exception:  # noqa: BLE001 - absent key; nothing to clear
+                pass
+
+    # -- desired targets ----------------------------------------------
+    def put_desired(self, app: str, desired: Dict[str, dict]):
+        self._kv().kv_put(_DESIRED_PREFIX + app,
+                          json.dumps(desired).encode(), ns=KV_NS)
+
+    def get_desired(self, app: str) -> Dict[str, dict]:
+        raw = self._kv().kv_get(_DESIRED_PREFIX + app, ns=KV_NS)
+        return json.loads(raw) if raw else {}
+
+    # -- replica intents ----------------------------------------------
+    def put_replicas(self, app: str, intents: Dict[str, dict]):
+        self._kv().kv_put(_REPLICA_PREFIX + app,
+                          json.dumps(intents).encode(), ns=KV_NS)
+
+    def get_replicas(self, app: str) -> Dict[str, dict]:
+        raw = self._kv().kv_get(_REPLICA_PREFIX + app, ns=KV_NS)
+        return json.loads(raw) if raw else {}
+
+
+# ----------------------------------------------------------------- signals
+@dataclass
+class GroupSignals:
+    """One role group's aggregated signal snapshot, as :func:`decide`
+    consumes it. ``fresh`` counts members whose newest signal is within
+    the config's staleness window; ``newest_age`` is the age of the
+    freshest signal in the group (``inf`` when none exists)."""
+
+    n: int = 0
+    fresh: int = 0
+    ongoing: float = 0.0
+    queue_depth: float = 0.0
+    active_slots: float = 0.0
+    slots: float = 0.0
+    newest_age: float = math.inf
+    pending: float = 0.0
+    tpot_p95: Optional[float] = None
+
+
+@dataclass
+class Decision:
+    target: int
+    direction: str  # "up" | "down" | "hold"
+    reason: str
+
+
+class GroupState:
+    """Per-group decision memory: the EMA of the load ratio, the
+    stability window, cooldown stamps, the idle clock for
+    scale-to-zero, and the cold-start grace deadline."""
+
+    def __init__(self, tau_s: float):
+        self.ema = EMA(tau_s)
+        self.desired: Optional[int] = None
+        self.since = 0.0
+        self.last_up = -math.inf
+        self.last_down = -math.inf
+        self.idle_since: Optional[float] = None
+        self.cold_until = 0.0
+        self.last_decision: Optional[dict] = None
+
+
+def _load_mode(cfg: AutoscalingConfig,
+               sig: GroupSignals) -> tuple:
+    """(load, per_replica_capacity, mode): the group's demand in the
+    unit its config targets, and how much of it one replica absorbs."""
+    if cfg.target_occupancy is not None and sig.slots > 0:
+        per = cfg.target_occupancy * (sig.slots / max(sig.n, 1))
+        # Waiting work needs slots just as much as admitted work.
+        return sig.active_slots + sig.queue_depth, per, "occupancy"
+    if cfg.target_queue_depth is not None:
+        return (sig.queue_depth + sig.pending,
+                max(cfg.target_queue_depth, 1e-9), "queue_depth")
+    return (sig.ongoing + sig.pending,
+            max(cfg.target_ongoing_requests, 1e-9), "ongoing")
+
+
+def decide(cfg: AutoscalingConfig, cur: int, sig: GroupSignals,
+           st: GroupState, now: float) -> Decision:
+    """One bounded scaling decision for one role group.
+
+    Pure up to ``st`` (its decision memory); no I/O, no clock reads —
+    unit-testable tick by tick. The ordering below IS the degradation
+    contract: freshness gates everything (a missed health pass can
+    only ever hold), the cold-start grace gates upscale, stability and
+    cooldown gate both directions, and the step cap bounds whatever
+    survives.
+    """
+    # Scale-from-zero: no replica exists to report a signal, so router
+    # pending demand is the only input. Bypasses the stability delay
+    # (the burst is already queued) and stamps the cold-start grace.
+    if cur == 0:
+        if cfg.min_replicas > 0:
+            return Decision(cfg.min_replicas, "up", "min_replicas")
+        if sig.pending > 0:
+            st.cold_until = now + cfg.cold_start_grace_s
+            st.ema.reset()
+            st.desired = None
+            st.idle_since = None
+            _, per, _ = _load_mode(cfg, sig)
+            want = math.ceil(sig.pending / max(per, 1e-9))
+            target = max(1, min(cfg.max_replicas, cfg.upscale_step, want))
+            st.last_up = now
+            return Decision(target, "up", "scale_from_zero")
+        return Decision(0, "hold", "idle")
+
+    # Freshness gate: a group whose signals all rotted holds outright;
+    # one member missing its health pass also holds (conservative — we
+    # cannot tell an idle replica from a wedged probe).
+    if sig.n > 0 and sig.fresh == 0:
+        return Decision(cur, "hold", "stale_signal")
+    if sig.fresh < sig.n:
+        return Decision(cur, "hold", "missing_signal")
+
+    load, per, mode = _load_mode(cfg, sig)
+    smoothed = st.ema.update(load / per, now)
+
+    # Latency SLO overlay: a breached TPOT p95 forces at least one
+    # replica of upscale pressure no matter what occupancy says.
+    if cfg.tpot_slo_s is not None and sig.tpot_p95 is not None \
+            and sig.tpot_p95 > cfg.tpot_slo_s:
+        smoothed = max(smoothed, cur + 1)
+        mode = "slo"
+
+    # Hysteresis dead-band around the current size, then clamp.
+    if abs(smoothed - cur) <= cfg.hysteresis * max(cur, 1):
+        desired = cur
+    else:
+        desired = math.ceil(smoothed)
+    desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+
+    # Idle clock for scale-to-zero (explicit opt-in; without it a
+    # zero-min group still floors at one live replica).
+    if load <= 0 and sig.pending <= 0:
+        if st.idle_since is None:
+            st.idle_since = now
+    else:
+        st.idle_since = None
+    if desired == 0:
+        idle_ok = (cfg.scale_to_zero_idle_s is not None
+                   and st.idle_since is not None
+                   and now - st.idle_since >= cfg.scale_to_zero_idle_s)
+        if not idle_ok:
+            desired = 1
+            if cur == 1:
+                return Decision(cur, "hold", "idle_wait")
+        else:
+            mode = "scale_to_zero"
+
+    if desired == cur:
+        st.desired = None
+        return Decision(cur, "hold", "steady")
+
+    if desired > cur and now < st.cold_until:
+        return Decision(cur, "hold", "cold_start")
+
+    # Stability window: the desired size must survive unchanged for
+    # the direction's delay before it actuates (flap damping).
+    if st.desired != desired:
+        st.desired = desired
+        st.since = now
+        return Decision(cur, "hold", "stabilizing")
+    delay = cfg.upscale_delay_s if desired > cur else cfg.downscale_delay_s
+    if now - st.since < delay:
+        return Decision(cur, "hold", "stabilizing")
+
+    if desired > cur and now - st.last_up < cfg.upscale_cooldown_s:
+        return Decision(cur, "hold", "cooldown")
+    if desired < cur and now - st.last_down < cfg.downscale_cooldown_s:
+        return Decision(cur, "hold", "cooldown")
+
+    if desired > cur:
+        target = min(desired, cur + cfg.upscale_step)
+        st.last_up = now
+        direction = "up"
+    else:
+        target = max(desired, cur - cfg.downscale_step)
+        st.last_down = now
+        direction = "down"
+    st.desired = None
+    return Decision(target, direction, mode)
+
+
+# -------------------------------------------------------------- autoscaler
+class Autoscaler:
+    """Signal book + per-group decision state for one controller.
+
+    ``record``/``prune`` run on the controller's reconcile thread (the
+    health pass feeds them); ``note_pending`` runs on RPC threads (the
+    routers' membership refresh carries it) — the book lock covers
+    both. ``tick`` is reconcile-thread only: it snapshots the book,
+    decides every group, and returns the targets to actuate.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (app, dname) -> rid -> {"t", "role", "ongoing", "queue_depth",
+        #                          "active_slots", "slots", "draining"}
+        self._signals: Dict[tuple, Dict[str, dict]] = {}
+        # (app, dname) -> router_id -> (pending, t)
+        self._pending: Dict[tuple, Dict[str, tuple]] = {}
+        # (app, dname, group) -> GroupState
+        self._states: Dict[tuple, GroupState] = {}
+
+    # ------------------------------------------------------------ intake
+    def record(self, app: str, dname: str, rid: str, metrics: dict,
+               now: float):
+        """Fold one replica's health-pass ``get_metrics()`` payload
+        into the signal book."""
+        sig = {"t": now,
+               "role": None,
+               "ongoing": float(metrics.get("ongoing", 0) or 0),
+               "queue_depth": 0.0, "active_slots": 0.0, "slots": 0.0,
+               "draining": bool(metrics.get("draining"))}
+        for est in metrics.get("engines") or []:
+            sig["queue_depth"] += float(est.get("queue_depth", 0) or 0)
+            sig["active_slots"] += float(est.get("active_slots", 0) or 0)
+            sig["slots"] += float(est.get("slots", 0) or 0)
+            if est.get("role"):
+                sig["role"] = est["role"]
+        with self._lock:
+            self._signals.setdefault((app, dname), {})[rid] = sig
+
+    def note_pending(self, app: str, dname: str, router_id: str,
+                     pending: int, now: float):
+        """A router reported its blocked-admission queue depth on a
+        membership refresh — the demand signal that exists even when
+        the group has zero replicas."""
+        with self._lock:
+            book = self._pending.setdefault((app, dname), {})
+            book[router_id] = (int(pending), now)
+
+    def prune(self, app: str, dname: str, live_rids, now: float,
+              staleness_s: float = 30.0):
+        """Drop signal entries for replicas the controller no longer
+        lists (satellite: the book must not accrete ghosts) and
+        pending reports from routers that went quiet."""
+        with self._lock:
+            sigs = self._signals.get((app, dname))
+            if sigs is not None:
+                for rid in list(sigs):
+                    if rid not in live_rids:
+                        sigs.pop(rid, None)
+            pend = self._pending.get((app, dname))
+            if pend is not None:
+                for router_id, (_, t) in list(pend.items()):
+                    if now - t > staleness_s:
+                        pend.pop(router_id, None)
+
+    def forget(self, app: str, dname: Optional[str] = None):
+        """Deployment (or whole app) torn down: drop its book and
+        decision state so a later same-name deploy starts cold."""
+        with self._lock:
+            for key in list(self._signals):
+                if key[0] == app and (dname is None or key[1] == dname):
+                    self._signals.pop(key, None)
+                    self._pending.pop(key, None)
+            for key in list(self._states):
+                if key[0] == app and (dname is None or key[1] == dname):
+                    self._states.pop(key, None)
+
+    # ----------------------------------------------------------- querying
+    def signal_ages(self, app: str, dname: str, groups: Dict[str, list],
+                    now: float) -> Dict[str, Optional[float]]:
+        """Freshest signal age per role group (``None`` when the group
+        has no signal at all) — surfaced as ``signal_age_s`` in
+        ``serve.status()`` so a held decision is diagnosable."""
+        with self._lock:
+            sigs = dict(self._signals.get((app, dname)) or {})
+        out: Dict[str, Optional[float]] = {}
+        for group, rids in groups.items():
+            ages = [now - sigs[rid]["t"] for rid in rids if rid in sigs]
+            out[group] = round(min(ages), 3) if ages else None
+        return out
+
+    def pending_total(self, app: str, dname: str, now: float,
+                      window_s: float = 5.0) -> int:
+        with self._lock:
+            pend = self._pending.get((app, dname)) or {}
+            return sum(p for p, t in pend.values() if now - t <= window_s)
+
+    def last_decisions(self, app: str, dname: str) -> Dict[str, dict]:
+        with self._lock:
+            out = {}
+            for (a, d, group), st in self._states.items():
+                if a == app and d == dname and st.last_decision:
+                    out[group] = dict(st.last_decision)
+            return out
+
+    # ------------------------------------------------------------- decide
+    # rtlint: entry=driver
+    def tick(self, app: str, dname: str, ac: AutoscalingConfig,
+             groups: Dict[str, dict], now: float,
+             tpot_p95: Optional[float] = None) -> Dict[str, Decision]:
+        """Decide every role group of one deployment.
+
+        ``groups`` maps group name (:data:`PLAIN_GROUP` or a role) to
+        ``{"cur": int, "rids": [...]}`` — the controller's view of the
+        group's current target and membership. Returns the full
+        decision map; the caller actuates ``direction != "hold"``
+        entries through its drain-aware reconcile machinery.
+        """
+        with self._lock:
+            sigs = dict(self._signals.get((app, dname)) or {})
+        pending = self.pending_total(app, dname, now)
+        decisions: Dict[str, Decision] = {}
+        for group, info in groups.items():
+            cfg = ac.for_role(None if group == PLAIN_GROUP else group)
+            key = (app, dname, group)
+            with self._lock:
+                st = self._states.get(key)
+                if st is None:
+                    st = self._states[key] = GroupState(cfg.ema_tau_s)
+            sig = self._aggregate(cfg, info["rids"], sigs, pending, now)
+            sig.tpot_p95 = tpot_p95
+            d = self._decide_group(cfg, int(info["cur"]), sig, st, now)
+            st.last_decision = {"target": d.target,
+                                "direction": d.direction,
+                                "reason": d.reason, "t": now}
+            self._observe(dname, group, d)
+            decisions[group] = d
+        return decisions
+
+    # rtlint: owner=driver
+    def _decide_group(self, cfg: AutoscalingConfig, cur: int,
+                      sig: GroupSignals, st: GroupState,
+                      now: float) -> Decision:
+        return decide(cfg, cur, sig, st, now)
+
+    @staticmethod
+    def _aggregate(cfg: AutoscalingConfig, rids, sigs: dict,
+                   pending: int, now: float) -> GroupSignals:
+        out = GroupSignals(pending=float(pending))
+        for rid in rids:
+            s = sigs.get(rid)
+            if s is not None and s.get("draining"):
+                continue
+            out.n += 1
+            if s is None:
+                continue
+            age = now - s["t"]
+            out.newest_age = min(out.newest_age, age)
+            if age <= cfg.signal_staleness_s:
+                out.fresh += 1
+                out.ongoing += s["ongoing"]
+                out.queue_depth += s["queue_depth"]
+                out.active_slots += s["active_slots"]
+                out.slots += s["slots"]
+        return out
+
+    @staticmethod
+    def _observe(dname: str, group: str, d: Decision):
+        sm = serve_metrics()
+        if d.direction in ("up", "down"):
+            sm["autoscale_decisions"].inc(labels={
+                "deployment": dname, "group": group,
+                "direction": d.direction})
+        elif d.reason not in ("steady", "idle"):
+            sm["autoscale_held"].inc(labels={
+                "deployment": dname, "group": group,
+                "reason": d.reason})

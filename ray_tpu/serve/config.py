@@ -15,12 +15,30 @@ from typing import Any, Dict, Optional
 class AutoscalingConfig:
     """Target-driven replica autoscaling.
 
-    The controller computes ``desired = ceil(total_ongoing /
-    target_ongoing_requests)`` from replica-reported metrics and applies it
-    after the decision has been stable for ``upscale_delay_s`` /
-    ``downscale_delay_s`` (reference:
+    The controller computes a desired replica count from replica-reported
+    signals and applies it after the decision has been stable for
+    ``upscale_delay_s`` / ``downscale_delay_s`` (reference:
     ``serve/_private/autoscaling_state.py:262`` and
     ``serve/autoscaling_policy.py``).
+
+    Signal selection (ISSUE 17, SLO-driven loop in
+    ``serve/autoscaler.py``): ``target_occupancy`` scales on the
+    engine's active-slot fraction (decode groups), ``target_queue_depth``
+    on per-replica admission backlog (prefill groups / bursty arrivals),
+    and with neither set the loop falls back to the classic
+    ``target_ongoing_requests`` ratio. ``tpot_slo_s`` layers a latency
+    SLO on top: a p95 TPOT above it forces upscale pressure regardless
+    of occupancy. Decisions are bounded — ``hysteresis`` dead-band,
+    ``upscale_step``/``downscale_step`` caps, per-direction cooldowns —
+    and degrade to a conservative hold when signals are missing or older
+    than ``signal_staleness_s``. ``scale_to_zero_idle_s`` (with
+    ``min_replicas=0``) opts a group into scale-to-zero after that much
+    idle; a scale-from-zero stamps a ``cold_start_grace_s`` window
+    during which further upscale is suppressed (the first burst after
+    idle queues behind a compiling replica and must not panic-scale).
+    Disaggregated deployments autoscale per role group via the
+    ``roles:`` override map (``{"decode": {"max_replicas": 4}}``);
+    without it a ``roles:`` engine block keeps its declarative targets.
     """
 
     min_replicas: int = 1
@@ -30,10 +48,61 @@ class AutoscalingConfig:
     downscale_delay_s: float = 10.0
     metrics_interval_s: float = 0.25
     initial_replicas: Optional[int] = None
+    # ---- SLO-driven signals (ISSUE 17) --------------------------------
+    target_occupancy: Optional[float] = None
+    target_queue_depth: Optional[float] = None
+    tpot_slo_s: Optional[float] = None
+    scale_to_zero_idle_s: Optional[float] = None
+    hysteresis: float = 0.1
+    upscale_step: int = 2
+    downscale_step: int = 1
+    upscale_cooldown_s: float = 0.0
+    downscale_cooldown_s: float = 0.0
+    signal_staleness_s: float = 10.0
+    cold_start_grace_s: float = 10.0
+    ema_tau_s: float = 2.0
+    #: Per-role-group overrides for disaggregated deployments:
+    #: role name ("prefill" | "decode" | "both") -> field overrides.
+    #: Presence of this map is ALSO the opt-in that lets the autoscaler
+    #: move a ``roles:`` block's targets at all.
+    roles: Optional[Dict[str, Dict[str, Any]]] = None
+
+    _ROLE_NAMES = ("prefill", "decode", "both")
 
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
             raise ValueError("need 0 <= min_replicas <= max_replicas")
+        if self.hysteresis < 0:
+            raise ValueError("hysteresis must be >= 0")
+        if self.upscale_step < 1 or self.downscale_step < 1:
+            raise ValueError("scale steps must be >= 1")
+        if self.signal_staleness_s <= 0:
+            raise ValueError("signal_staleness_s must be > 0")
+        if self.target_occupancy is not None and \
+                not 0 < self.target_occupancy <= 1:
+            raise ValueError("target_occupancy must be in (0, 1]")
+        if self.roles:
+            fields = set(self.__dataclass_fields__) - {"roles"}
+            for role, over in self.roles.items():
+                if role not in self._ROLE_NAMES:
+                    raise ValueError(
+                        f"unknown role {role!r} in autoscaling roles "
+                        f"block; known: {list(self._ROLE_NAMES)}")
+                bad = set(over or {}) - fields
+                if bad:
+                    raise ValueError(
+                        f"unknown autoscaling keys {sorted(bad)} in "
+                        f"roles[{role!r}] override")
+
+    def for_role(self, role: Optional[str]) -> "AutoscalingConfig":
+        """This config with the ``roles[role]`` overrides applied (the
+        per-group view the autoscaler decides with)."""
+        if not role or not self.roles or role not in self.roles:
+            return self
+        from dataclasses import replace
+
+        over = dict(self.roles[role] or {})
+        return replace(self, roles=None, **over)
 
 
 @dataclass

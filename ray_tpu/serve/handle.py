@@ -635,6 +635,11 @@ class Router:
         self._max_ongoing = 16
         self._max_queued = 64
         self._pending = 0  # callers blocked in the admission wait loop
+        # Stable id for demand reports piggybacked on membership polls
+        # (the controller keys scale-from-zero pending counts by router).
+        import uuid
+
+        self._router_id = uuid.uuid4().hex[:12]
         self.budget = RetryBudget()
         self._last_refresh = 0.0
         self._outstanding: Dict[Any, str] = {}  # ObjectRef -> rid
@@ -662,12 +667,29 @@ class Router:
             if not force and now - self._last_refresh < self.MEMBERSHIP_TTL_S:
                 return
             self._last_refresh = now
-        info = self._controller().get_replicas.remote(
-            self.app_name, self.deployment_name)
+            pending = self._pending
         from .. import api as rt
 
-        info = rt.get(info, timeout=30)
+        try:
+            info = self._controller().get_replicas.remote(
+                self.app_name, self.deployment_name,
+                pending=pending, router_id=self._router_id)
+            info = rt.get(info, timeout=30)
+        except Exception:  # noqa: BLE001 - controller down (e.g. a chaos
+            # kill mid-reconcile): degrade to the cached membership so
+            # in-flight streams keep routing to replicas we already know
+            # about — named replica actors are detached and outlive the
+            # controller, and the revived controller re-adopts them.
+            if self._replicas:
+                return
+            raise
         if info is None:
+            # A just-revived controller answers RPCs before its journal
+            # replay finishes; with a cached membership the right move
+            # is to keep serving it (the named replicas are still up),
+            # not to error every in-flight request.
+            if self._replicas:
+                return
             raise RayTpuError(
                 f"deployment {self.app_name}/{self.deployment_name} not found")
         self._apply_membership(info)

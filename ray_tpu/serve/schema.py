@@ -29,7 +29,21 @@ class DeploymentSchema:
     admission once every replica is saturated — beyond it the request is
     shed (``BackPressureError``; HTTP ``503`` + ``Retry-After`` at the
     proxy). Bounded queues keep accepted-request tail latency flat under
-    overload instead of letting it grow with the queue."""
+    overload instead of letting it grow with the queue.
+
+    ``autoscaling:`` (ISSUE 17) declares the SLO-driven control loop
+    for the deployment — ``min_replicas``/``max_replicas`` bounds,
+    one load signal (``target_occupancy`` for decode slot fraction,
+    ``target_queue_depth`` for admission backlog,
+    ``target_ongoing_requests`` as the classic fallback), an optional
+    ``tpot_slo_s`` latency overlay, ``scale_to_zero_idle_s`` opt-in,
+    and the bounding knobs (``hysteresis``, ``upscale_step`` /
+    ``downscale_step``, per-direction cooldowns). Disaggregated
+    deployments scale per role group via ``autoscaling: {roles:
+    {prefill: {...}, decode: {...}}}`` — see
+    :class:`ray_tpu.serve.config.AutoscalingConfig`. The block is
+    validated at config-parse time so a bad key or range fails the
+    ``serve deploy`` before anything is touched."""
 
     name: str
     num_replicas: Optional[int] = None
@@ -72,6 +86,16 @@ class DeploymentSchema:
                 raise ValueError(
                     f"unknown engine config keys {sorted(bad)}; "
                     f"known: {sorted(cls._ENGINE_KEYS)}")
+        ac = d.get("autoscaling_config")
+        if ac is not None:
+            from .config import AutoscalingConfig
+
+            try:
+                AutoscalingConfig(**ac)  # parse-time validation only
+            except TypeError as e:
+                raise ValueError(
+                    f"bad autoscaling block for deployment "
+                    f"{d.get('name')!r}: {e}") from None
         return cls(**d)
 
 

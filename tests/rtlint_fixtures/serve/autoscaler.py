@@ -1,0 +1,61 @@
+"""RT102/RT108 fixture: the autoscaling control loop (ISSUE 17) joins
+the driver-ownership path scope — ``serve/autoscaler.py`` is in RT102's
+``applies()`` set and RT108's ``ENTRY_SCOPE``, and RT107 already covers
+it via the ``serve/`` path prefix. Never imported."""
+
+
+# rtlint: program-budget: 1
+def jit_probe_fixture(cfg):
+    def step(params):
+        return params
+    return step
+
+
+class FixtureLoop:
+    # rtlint: program-budget: 1
+    def __init__(self, cfg):
+        # Binding a factory result is construction, not a dispatch.
+        self._step = jit_probe_fixture(cfg)
+
+    # rtlint: entry=driver
+    def run(self, params):
+        return self._tick(params)
+
+    # rtlint: owner=driver
+    def _tick(self, params):
+        return self._step(params)        # owned dispatch: clean
+
+    def rogue_tick(self, params):
+        return self._step(params)  # FIRES RT102
+
+    # rtlint: owner=driver holds=_missing_lock
+    def drifted(self, params):  # FIRES RT108
+        return self._step(params)
+
+
+class FixtureUnanchored:
+    # rtlint: program-budget: 1
+    def __init__(self, cfg):
+        self._step = jit_probe_fixture(cfg)
+
+    # rtlint: owner=driver
+    def _tick(self, params):  # FIRES RT108 (no entry=driver anywhere)
+        return self._step(params)
+
+
+def reconcile_swallow(groups):
+    for g in groups:
+        try:
+            g.decide()
+        # FIRES-BELOW RT107 (a same-line comment would read as the
+        # justification, so the marker sits above)
+        except Exception:
+            pass
+
+
+def reconcile_justified(groups):
+    for g in groups:
+        try:
+            g.decide()
+        except Exception:  # noqa: BLE001 - conservative hold; next tick retries
+            continue

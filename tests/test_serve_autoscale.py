@@ -1,0 +1,653 @@
+"""SLO-driven autoscaling + crash-safe reconciliation (ISSUE 17).
+
+Three layers:
+
+- unit: ``EMA`` time-constant semantics (``_private/metrics.py``) and
+  the pure ``decide()`` contract — hysteresis, step caps, cooldowns,
+  stale/missing-signal holds, scale-to-zero idle gate, cold-start
+  grace, scale-from-zero, TPOT SLO overlay — tick by tick with a fake
+  clock, no cluster;
+- integration: a live deployment scales up under load and back down
+  when idle, scale-down routes through the drain path, and
+  ``serve.status()`` surfaces ``signal_age_s`` + the last decision;
+- chaos: the controller is SIGKILLed (``os._exit``) mid-scale-up and
+  mid-drain via the ``inject_crash`` hook; a revived controller
+  converges to the journaled desired state with zero orphan replicas
+  and zero failed client calls.
+"""
+import math
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance(rt_cluster):
+    serve.start(proxy=False)
+    yield serve
+    serve.shutdown()
+
+
+# ------------------------------------------------------------------- EMA
+def test_ema_time_constant_semantics():
+    from ray_tpu._private.metrics import EMA
+
+    with pytest.raises(ValueError):
+        EMA(0.0)
+
+    # First sample initializes outright.
+    e = EMA(tau_s=2.0)
+    assert e.update(10.0, t=100.0) == 10.0
+
+    # One step of exactly tau closes ~63.2% of the gap to the new
+    # level; 3*tau closes ~95% — the defining time-constant property.
+    e = EMA(tau_s=2.0)
+    e.update(0.0, t=0.0)
+    v = e.update(1.0, t=2.0)
+    assert abs(v - (1 - math.exp(-1))) < 1e-9
+    e = EMA(tau_s=2.0)
+    e.update(0.0, t=0.0)
+    v = e.update(1.0, t=6.0)
+    assert abs(v - (1 - math.exp(-3))) < 1e-9
+
+    # Rate independence: sampling a steady level every 0.1 s or every
+    # 1.0 s lands at the same value at the same wall-clock time (the
+    # property a fixed-alpha EMA does NOT have).
+    fine, coarse = EMA(tau_s=2.0), EMA(tau_s=2.0)
+    fine.update(0.0, t=0.0)
+    coarse.update(0.0, t=0.0)
+    for i in range(1, 41):
+        fine.update(1.0, t=i * 0.1)
+    for i in range(1, 5):
+        coarse.update(1.0, t=i * 1.0)
+    assert abs(fine.value - coarse.value) < 1e-9
+
+    # Non-positive dt holds (clock skew must not corrupt the average).
+    e = EMA(tau_s=2.0)
+    e.update(5.0, t=10.0)
+    assert e.update(100.0, t=10.0) == 5.0
+    assert e.update(100.0, t=9.0) == 5.0
+
+    e.reset()
+    assert e.value is None and e.update(7.0, t=0.0) == 7.0
+
+
+# ---------------------------------------------------------------- decide()
+def _cfg(**kw):
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    base = dict(min_replicas=1, max_replicas=10,
+                target_ongoing_requests=1.0, upscale_delay_s=0.0,
+                downscale_delay_s=0.0, hysteresis=0.1, upscale_step=2,
+                downscale_step=1, ema_tau_s=0.001)
+    base.update(kw)
+    return AutoscalingConfig(**base)
+
+
+def _sig(**kw):
+    from ray_tpu.serve.autoscaler import GroupSignals
+
+    return GroupSignals(**kw)
+
+
+def _st(cfg):
+    from ray_tpu.serve.autoscaler import GroupState
+
+    return GroupState(cfg.ema_tau_s)
+
+
+def test_decide_scale_from_zero_and_cold_grace():
+    from ray_tpu.serve.autoscaler import decide
+
+    # min_replicas > 0 never sits at zero.
+    cfg = _cfg(min_replicas=1)
+    d = decide(cfg, 0, _sig(), _st(cfg), now=0.0)
+    assert (d.target, d.direction, d.reason) == (1, "up", "min_replicas")
+
+    # min=0, no demand: stay at zero.
+    cfg = _cfg(min_replicas=0, cold_start_grace_s=30.0)
+    st = _st(cfg)
+    d = decide(cfg, 0, _sig(), st, now=0.0)
+    assert (d.target, d.direction, d.reason) == (0, "hold", "idle")
+
+    # Router-pending demand wakes the group (bypassing the stability
+    # delay — the burst is already queued) and stamps the grace window.
+    d = decide(cfg, 0, _sig(pending=5.0), st, now=1.0)
+    assert d.direction == "up" and d.reason == "scale_from_zero"
+    assert 1 <= d.target <= cfg.upscale_step
+    assert st.cold_until == 1.0 + cfg.cold_start_grace_s
+
+    # During the grace window further upscale is suppressed: the burst
+    # queued behind the compiling replica must not panic-scale...
+    sig = _sig(n=1, fresh=1, ongoing=50.0)
+    d = decide(cfg, 1, sig, st, now=2.0)
+    assert (d.target, d.direction, d.reason) == (1, "hold", "cold_start")
+    # ...but after it expires the same load scales (capped by step).
+    d = decide(cfg, 1, sig, st, now=2.0 + cfg.cold_start_grace_s)
+    assert d.reason == "stabilizing"
+    d = decide(cfg, 1, sig, st, now=2.1 + cfg.cold_start_grace_s)
+    assert (d.target, d.direction) == (1 + cfg.upscale_step, "up")
+
+
+def test_decide_freshness_degrades_to_hold():
+    from ray_tpu.serve.autoscaler import decide
+
+    cfg = _cfg()
+    # Every signal rotted: hold, no matter how big the last load was.
+    d = decide(cfg, 3, _sig(n=2, fresh=0, ongoing=99.0), _st(cfg), now=0.0)
+    assert (d.target, d.direction, d.reason) == (3, "hold", "stale_signal")
+    # One member missed its health pass: conservative hold (we cannot
+    # tell an idle replica from a wedged probe).
+    d = decide(cfg, 3, _sig(n=2, fresh=1, ongoing=99.0), _st(cfg), now=0.0)
+    assert (d.target, d.direction, d.reason) == (3, "hold",
+                                                 "missing_signal")
+
+
+def test_decide_hysteresis_steps_cooldowns():
+    from ray_tpu.serve.autoscaler import decide
+
+    # Hysteresis dead-band: a load within 10% of the current size is
+    # steady, no flap.
+    cfg = _cfg()
+    d = decide(cfg, 4, _sig(n=4, fresh=4, ongoing=4.3), _st(cfg), now=0.0)
+    assert (d.target, d.reason) == (4, "steady")
+
+    # Upscale is step-capped and needs the desired size to survive the
+    # stability window (one extra tick at delay 0).
+    st = _st(cfg)
+    sig = _sig(n=2, fresh=2, ongoing=8.0)
+    assert decide(cfg, 2, sig, st, now=0.0).reason == "stabilizing"
+    d = decide(cfg, 2, sig, st, now=0.1)
+    assert (d.target, d.direction) == (2 + cfg.upscale_step, "up")
+
+    # Downscale is step-capped independently.
+    st = _st(cfg)
+    idle = _sig(n=4, fresh=4, ongoing=0.0)
+    assert decide(cfg, 4, idle, st, now=0.0).reason == "stabilizing"
+    d = decide(cfg, 4, idle, st, now=0.1)
+    assert (d.target, d.direction) == (4 - cfg.downscale_step, "down")
+
+    # Per-direction cooldown: right after an up actuation, another up
+    # holds until the window passes.
+    cfg = _cfg(upscale_cooldown_s=100.0)
+    st = _st(cfg)
+    sig = _sig(n=1, fresh=1, ongoing=9.0)
+    decide(cfg, 1, sig, st, now=0.0)
+    d = decide(cfg, 1, sig, st, now=0.1)
+    assert d.direction == "up"            # first actuation
+    sig = _sig(n=3, fresh=3, ongoing=27.0)
+    decide(cfg, 3, sig, st, now=0.2)      # stabilizing
+    d = decide(cfg, 3, sig, st, now=0.3)
+    assert (d.target, d.direction, d.reason) == (3, "hold", "cooldown")
+    assert decide(cfg, 3, sig, st, now=200.0).direction == "up"
+
+
+def test_decide_scale_to_zero_is_opt_in():
+    from ray_tpu.serve.autoscaler import decide
+
+    # Without the opt-in a zero-min group still floors at one replica.
+    cfg = _cfg(min_replicas=0)
+    st = _st(cfg)
+    d = decide(cfg, 1, _sig(n=1, fresh=1), st, now=0.0)
+    assert (d.target, d.direction, d.reason) == (1, "hold", "idle_wait")
+
+    # With the opt-in, the group must be idle for the full window, then
+    # the decision still rides the stability delay before actuating.
+    cfg = _cfg(min_replicas=0, scale_to_zero_idle_s=5.0)
+    st = _st(cfg)
+    idle = _sig(n=1, fresh=1)
+    assert decide(cfg, 1, idle, st, now=0.0).reason == "idle_wait"
+    assert decide(cfg, 1, idle, st, now=2.0).reason == "idle_wait"
+    assert decide(cfg, 1, idle, st, now=6.0).reason == "stabilizing"
+    d = decide(cfg, 1, idle, st, now=6.1)
+    assert (d.target, d.direction, d.reason) == (0, "down",
+                                                 "scale_to_zero")
+
+    # Any load resets the idle clock.
+    st = _st(cfg)
+    decide(cfg, 1, idle, st, now=0.0)
+    decide(cfg, 1, _sig(n=1, fresh=1, ongoing=1.0), st, now=4.0)
+    assert st.idle_since is None
+
+
+def test_decide_slo_overlay_and_occupancy_mode():
+    from ray_tpu.serve.autoscaler import decide
+
+    # A breached TPOT p95 forces upscale pressure even at low load.
+    cfg = _cfg(tpot_slo_s=0.1)
+    st = _st(cfg)
+    sig = _sig(n=2, fresh=2, ongoing=1.0, tpot_p95=0.5)
+    assert decide(cfg, 2, sig, st, now=0.0).reason == "stabilizing"
+    d = decide(cfg, 2, sig, st, now=0.1)
+    assert (d.target, d.direction, d.reason) == (3, "up", "slo")
+
+    # Occupancy mode: queued work counts against the slot budget just
+    # like admitted work (2 replicas * 4 slots * 0.5 target = 4 per
+    # replica; 10 active+queued slots over target -> upscale).
+    cfg = _cfg(target_occupancy=0.5)
+    st = _st(cfg)
+    sig = _sig(n=2, fresh=2, active_slots=6.0, queue_depth=4.0, slots=8.0)
+    assert decide(cfg, 2, sig, st, now=0.0).reason == "stabilizing"
+    d = decide(cfg, 2, sig, st, now=0.1)
+    assert d.direction == "up" and d.reason == "occupancy"
+
+
+def test_autoscaler_signal_book_prune_and_pending():
+    from ray_tpu.serve.autoscaler import PLAIN_GROUP, Autoscaler
+
+    a = Autoscaler()
+    a.record("app", "D", "D#1",
+             {"ongoing": 2, "engines": [{"queue_depth": 3,
+                                         "active_slots": 1, "slots": 4,
+                                         "role": "decode"}]}, now=100.0)
+    a.record("app", "D", "D#2", {"ongoing": 1}, now=100.5)
+    ages = a.signal_ages("app", "D", {"g": ["D#1", "D#2"], "h": ["D#9"]},
+                         now=101.0)
+    assert ages["g"] == 0.5 and ages["h"] is None
+
+    # Ghost entries (replicas the controller no longer lists) are
+    # pruned; quiet routers' pending reports expire.
+    a.note_pending("app", "D", "router-a", 4, now=100.0)
+    a.note_pending("app", "D", "router-b", 2, now=130.0)
+    assert a.pending_total("app", "D", now=131.0) == 2
+    a.prune("app", "D", live_rids={"D#2"}, now=131.0)
+    assert a.signal_ages("app", "D", {"g": ["D#1"]}, now=131.0) == \
+        {"g": None}
+    assert a.pending_total("app", "D", now=131.0, window_s=5.0) == 2
+
+    # tick() decides per group and remembers the decision for status().
+    cfg = _cfg()
+    a.record("app", "D", "D#2", {"ongoing": 9}, now=131.0)
+    groups = {PLAIN_GROUP: {"cur": 1, "rids": ["D#2"]}}
+    a.tick("app", "D", cfg, groups, now=131.0)
+    decs = a.tick("app", "D", cfg, groups, now=131.2)
+    assert decs[PLAIN_GROUP].direction == "up"
+    assert a.last_decisions("app", "D")[PLAIN_GROUP]["direction"] == "up"
+
+    # forget() drops book + decision state (same-name redeploys start
+    # cold).
+    a.forget("app")
+    assert a.last_decisions("app", "D") == {}
+
+
+def test_autoscaling_config_validation_and_roles():
+    from ray_tpu.serve.config import AutoscalingConfig
+    from ray_tpu.serve.schema import DeploymentSchema
+
+    with pytest.raises(ValueError):
+        AutoscalingConfig(min_replicas=2, max_replicas=1)
+    with pytest.raises(ValueError):
+        AutoscalingConfig(target_occupancy=1.5)
+    with pytest.raises(ValueError):
+        AutoscalingConfig(roles={"bogus_role": {}})
+    with pytest.raises(ValueError):
+        AutoscalingConfig(roles={"decode": {"not_a_knob": 1}})
+
+    ac = AutoscalingConfig(max_replicas=8, target_queue_depth=4.0,
+                           roles={"decode": {"target_occupancy": 0.8,
+                                             "target_queue_depth": None,
+                                             "max_replicas": 6}})
+    dec = ac.for_role("decode")
+    assert dec.target_occupancy == 0.8 and dec.max_replicas == 6
+    assert dec.roles is None
+    assert ac.for_role("prefill").target_queue_depth == 4.0
+    assert ac.for_role(None) is ac
+
+    # The declarative surface validates the block at parse time.
+    with pytest.raises(ValueError):
+        DeploymentSchema.from_dict(
+            {"name": "D", "autoscaling_config": {"bogus": 1}})
+    with pytest.raises(ValueError):
+        DeploymentSchema.from_dict(
+            {"name": "D",
+             "autoscaling_config": {"min_replicas": 3, "max_replicas": 1}})
+    DeploymentSchema.from_dict(
+        {"name": "D", "autoscaling_config": {"max_replicas": 4,
+                                             "target_occupancy": 0.7}})
+
+
+# -------------------------------------------------------------- integration
+def _drain_count(dname: str) -> float:
+    try:
+        text = rt.metrics_text()
+    except Exception:  # noqa: BLE001 - head mid-flush
+        return 0.0
+    return sum(float(line.rsplit(" ", 1)[1])
+               for line in text.splitlines()
+               if line.startswith("ray_tpu_serve_replica_drains_total")
+               and f'deployment="{dname}"' in line)
+
+
+def test_autoscale_up_down_drains_and_status(serve_instance):
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+            upscale_delay_s=0.2, downscale_delay_s=0.4,
+            metrics_interval_s=0.1, ema_tau_s=0.3, hysteresis=0.1))
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x
+
+    h = serve.run(Slow.bind(), name="auto17", route_prefix=None)
+    failures = []
+
+    def hammer():
+        for _ in range(10):
+            try:
+                h.remote(1).result(timeout=60)
+            except Exception as e:  # noqa: BLE001 - counted, asserted 0
+                failures.append(repr(e))
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    saw_up = False
+    deadline = time.time() + 25
+    while time.time() < deadline:
+        st = serve.status()["applications"]["auto17"]["deployments"]["Slow"]
+        if st["replicas"] > 1:
+            saw_up = True
+            break
+        time.sleep(0.2)
+    assert saw_up, "never scaled above 1 replica under load"
+
+    # Diagnosability satellite: per-group signal freshness + the last
+    # decision ride status() next to the engine block.
+    assert "signal_age_s" in st and "all" in st["signal_age_s"]
+    age = st["signal_age_s"]["all"]
+    assert age is None or age >= 0.0
+    assert st["autoscale"]["all"]["direction"] in ("up", "down", "hold")
+
+    for t in threads:
+        t.join()
+    assert failures == [], failures
+
+    # Idle -> back to min, and the scale-down DRAINED its victims (the
+    # drain counter moved; no in-flight call was killed — failures
+    # above stayed empty while scaling was happening).
+    base_drains = None
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = serve.status()["applications"]["auto17"]["deployments"]["Slow"]
+        if st["replicas"] == 1 and st["target"] == 1:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("never scaled back down to 1 replica")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        base_drains = _drain_count("Slow")
+        if base_drains >= 1:
+            break
+        time.sleep(0.5)
+    assert base_drains >= 1, "scale-down did not route through drain"
+
+    # The decision metrics reach the cluster-merged /metrics (they
+    # count in the controller process, so they must ride the export).
+    deadline = time.time() + 20
+    found = ""
+    while time.time() < deadline:
+        try:
+            text = rt.metrics_text()
+        except Exception:  # noqa: BLE001 - head mid-flush
+            text = ""
+        found = [line for line in text.splitlines()
+                 if line.startswith("ray_tpu_serve_autoscale_decisions"
+                                    "_total")
+                 and 'direction="up"' in line]
+        if found:
+            break
+        time.sleep(0.5)
+    assert found, "autoscale decision counter never reached /metrics"
+    serve.delete("auto17")
+
+
+def test_scale_to_zero_and_back(serve_instance):
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=0, max_replicas=2, target_ongoing_requests=2,
+            initial_replicas=1, upscale_delay_s=0.1,
+            downscale_delay_s=0.2, metrics_interval_s=0.1,
+            scale_to_zero_idle_s=1.0, ema_tau_s=0.2,
+            cold_start_grace_s=2.0))
+    class Echo:
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(Echo.bind(), name="zero17", route_prefix=None)
+    assert h.remote(1).result(timeout=30) == 2
+
+    # Idle past the opt-in window: the group drains to ZERO replicas.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = serve.status()["applications"]["zero17"]["deployments"]["Echo"]
+        if st["replicas"] == 0 and st["target"] == 0:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"never reached zero replicas: {st}")
+
+    # A blocked caller's router reports pending demand on its refresh
+    # -> scale-from-zero brings one replica back and the call lands.
+    assert h.remote(5).result(timeout=60) == 6
+    st = serve.status()["applications"]["zero17"]["deployments"]["Echo"]
+    assert st["replicas"] >= 1
+    serve.delete("zero17")
+
+
+# -------------------------------------------------------------------- chaos
+def _revive_controller(timeout_s: float = 40.0):
+    """Wait out the crashed controller's death, then re-create it under
+    the same name (what ``serve.start`` would do) and re-point the
+    cached client handle at the successor."""
+    from ray_tpu.serve import api as sapi
+
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        try:
+            ctrl = sapi._get_or_create_controller()
+            rt.get(ctrl.status.remote(), timeout=5)
+            with sapi._client_lock:
+                sapi._client["controller"] = ctrl
+            return ctrl
+        except Exception as e:  # noqa: BLE001 - name not reaped yet
+            last = e
+            time.sleep(0.3)
+    raise TimeoutError(f"controller did not revive: {last!r}")
+
+
+def _live_replica_names(app_name: str) -> set:
+    from ray_tpu.util.state import list_actors
+
+    prefix = f"SERVE_REPLICA:{app_name}:"
+    return {a["name"] for a in list_actors()
+            if a["state"] == "ALIVE"
+            and (a.get("name") or "").startswith(prefix)}
+
+
+def _membership_names(ctrl, app_name: str, dname: str) -> set:
+    from ray_tpu.serve.autoscaler import replica_actor_name
+
+    info = rt.get(ctrl.get_replicas.remote(app_name, dname), timeout=15)
+    return {replica_actor_name(app_name, rid)
+            for rid in (info or {"replicas": {}})["replicas"]}
+
+
+def _assert_converged(app_name: str, dname: str, want_n: int,
+                      timeout_s: float = 40.0):
+    """Membership == the journaled target AND the cluster's live named
+    replica actors == membership (zero orphans, zero ghosts)."""
+    from ray_tpu.serve import api as sapi
+
+    deadline = time.time() + timeout_s
+    state = None
+    while time.time() < deadline:
+        try:
+            ctrl = sapi._controller()
+            members = _membership_names(ctrl, app_name, dname)
+            census = _live_replica_names(app_name)
+            state = (sorted(members), sorted(census))
+            if len(members) == want_n and census == members:
+                return
+        except Exception as e:  # noqa: BLE001 - controller mid-revival
+            state = repr(e)
+        time.sleep(0.4)
+    pytest.fail(f"no convergence to {want_n} replicas: {state}")
+
+
+def test_controller_crash_mid_scale_up_converges(serve_instance):
+    """SIGKILL the controller after a scale-up replica went live but
+    BEFORE membership/journal confirmation: the successor adopts the
+    journaled fleet (no orphan, no double scale-up) and client calls
+    never fail — routers degrade to cached membership while the
+    controller is down, and the replicas are detached actors."""
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+            upscale_delay_s=0.2, downscale_delay_s=30.0,
+            metrics_interval_s=0.1, ema_tau_s=0.3))
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.25)
+            return x
+
+    h = serve.run(Slow.bind(), name="crashup", route_prefix=None)
+    assert h.remote(0).result(timeout=30) == 0
+
+    ctrl = rt.get_actor("SERVE_CONTROLLER", timeout=10)
+    assert rt.get(ctrl.inject_crash.remote("scale_up_created"),
+                  timeout=10)
+
+    failures, done = [], []
+
+    def hammer():
+        for i in range(14):
+            try:
+                h.remote(i).result(timeout=90)
+            except Exception as e:  # noqa: BLE001 - counted, asserted 0
+                failures.append(repr(e))
+        done.append(1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+
+    # The load forces an upscale; the armed crash point kills the
+    # controller the moment the new replica reports ready.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            rt.get(ctrl.status.remote(), timeout=3)
+        except Exception:  # noqa: BLE001 - the crash landed
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("controller never hit the armed crash point")
+
+    ctrl2 = _revive_controller()
+    # Journal replay is asynchronous on the successor's reconcile
+    # thread; poll until the app reappears.
+    info, deadline = None, time.time() + 30
+    while info is None and time.time() < deadline:
+        info = rt.get(ctrl2.get_replicas.remote("crashup", "Slow"),
+                      timeout=15)
+        time.sleep(0.3)
+    assert info is not None, "journaled app was not recovered"
+
+    for t in threads:
+        t.join(timeout=120)
+    assert len(done) == len(threads)
+    assert failures == [], failures
+
+    # Converge to the journaled desired state: membership matches the
+    # live actor census exactly (no orphans), within the configured
+    # bounds, and the adopted scale-up replica was not re-created.
+    st = serve.status()["applications"]["crashup"]["deployments"]["Slow"]
+    assert 1 <= st["target"] <= 3
+    _assert_converged("crashup", "Slow", st["target"])
+    serve.delete("crashup")
+    assert _live_replica_names("crashup") == set()
+
+
+def test_controller_crash_mid_drain_converges(serve_instance):
+    """SIGKILL the controller after scale-down victims were journaled
+    CONDEMNED but before their drain ran: the successor re-drains them
+    from the journal and converges to the new target — and the calls
+    in flight during the whole sequence all succeed."""
+    @serve.deployment(num_replicas=3)
+    class Echo:
+        def __call__(self, x):
+            time.sleep(0.05)
+            return x * 2
+
+    h = serve.run(Echo.bind(), name="crashdown", route_prefix=None)
+    assert h.remote(2).result(timeout=30) == 4
+    assert len(_live_replica_names("crashdown")) == 3
+
+    ctrl = rt.get_actor("SERVE_CONTROLLER", timeout=10)
+    assert rt.get(ctrl.inject_crash.remote("drain_condemned"), timeout=10)
+
+    failures, stop = [], []
+
+    def trickle():
+        while not stop:
+            try:
+                h.remote(1).result(timeout=60)
+            except Exception as e:  # noqa: BLE001 - counted, asserted 0
+                failures.append(repr(e))
+            time.sleep(0.05)
+
+    t = threading.Thread(target=trickle)
+    t.start()
+    try:
+        # Redeploy at num_replicas=1: the scale-down journals its two
+        # victims condemned, then the armed point kills the controller.
+        with pytest.raises(Exception):
+            serve.run(Echo.options(num_replicas=1).bind(),
+                      name="crashdown", route_prefix=None)
+        _revive_controller()
+        _assert_converged("crashdown", "Echo", 1)
+    finally:
+        stop.append(1)
+        t.join(timeout=60)
+    assert failures == [], failures
+    serve.delete("crashdown")
+    assert _live_replica_names("crashdown") == set()
+
+
+# -------------------------------------------------------------------- smoke
+def test_cluster_smoke_benchmark():
+    """Satellite CI hook: ``benchmarks/serve_cluster.py --smoke`` runs a
+    short diurnal curve with one replica kill and one controller kill
+    mid-ramp and asserts convergence, zero broken streams, and zero
+    orphans."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "benchmarks", "serve_cluster.py"), "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=root)
+    assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
+    rows = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.strip().startswith("{")]
+    chaos = [r for r in rows if r["metric"].endswith("autoscale_chaos")]
+    assert chaos, rows
+    row = chaos[0]
+    assert row["smoke"] is True
+    assert row["broken_streams"] == 0
+    assert row["orphans"] == 0
+    assert row["kills"] >= 1
+    assert row["converged"] is True

@@ -169,8 +169,10 @@ class LockGuardRule(Rule):
 class DriverOwnershipRule(Rule):
     """RT102: device-dispatch calls in the decode engine (its drafters
     — ISSUE 9 — the offline batch-inference pipeline driver,
-    ``data/llm.py`` — ISSUE 11 — and the disaggregation handoff plane,
-    ``serve/handoff.py`` — ISSUE 14) must run on the driver thread.
+    ``data/llm.py`` — ISSUE 11 — the disaggregation handoff plane,
+    ``serve/handoff.py`` — ISSUE 14 — and the autoscaling control
+    loop, ``serve/autoscaler.py`` — ISSUE 17) must run on the driver
+    thread (the reconcile thread, for the autoscaler).
     Lexically: calls to the bound jit wrappers (``self._prefill`` /
     ``self._step`` / ``self._verify`` / ``self._ingest`` /
     ``self._export`` / ``self._import``) or an immediately-invoked
@@ -189,6 +191,7 @@ class DriverOwnershipRule(Rule):
         return mod.relpath.endswith(("serve/engine.py",
                                      "serve/draft.py",
                                      "serve/handoff.py",
+                                     "serve/autoscaler.py",
                                      "data/llm.py"))
 
     def check(self, mod: Module) -> Iterable[Finding]:
@@ -651,7 +654,8 @@ class AnnotationDriftRule(Rule):
     id = "RT108"
     summary = "owner=/holds= annotation names a lock/registration that does not exist"
 
-    ENTRY_SCOPE = ("serve/engine.py", "serve/draft.py", "data/llm.py")
+    ENTRY_SCOPE = ("serve/engine.py", "serve/draft.py",
+                   "serve/autoscaler.py", "data/llm.py")
 
     def check(self, mod: Module) -> Iterable[Finding]:
         in_entry_scope = mod.relpath.endswith(self.ENTRY_SCOPE)

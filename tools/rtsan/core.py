@@ -73,6 +73,7 @@ DEFAULT_MODULES = (
     "ray_tpu.serve.engine",
     "ray_tpu.serve.draft",
     "ray_tpu.serve.handoff",
+    "ray_tpu.serve.autoscaler",
     "ray_tpu.serve._replica",
     "ray_tpu.serve._controller",
     "ray_tpu.data.llm",
