@@ -31,7 +31,17 @@ Two standing comparisons:
   equal SLO.
 
 ``--smoke`` is the tier-1 CI hook: a short curve, both chaos kills,
-asserts convergence + zero broken streams + zero orphans.
+asserts convergence + zero broken streams + zero orphans — and (via
+the implied ``--blackbox``) that the flight recorder reconstructs the
+killed-replica request's full story from the dead process's ring.
+
+``--blackbox`` (ISSUE 19) arms the cluster flight recorder: every
+process (harness, router, replicas, controller) appends to a crash-
+durable ring under a shared events directory; after the chaos run the
+harness merges the rings — including the SIGKILLed replica's — into
+one timeline and reconstructs the resumed request's cross-process
+story (admission → dispatches → kill → router resume → token-identity
+verdict).
 
 JSON lines on stdout, one row per metric (serve_gpt.py idiom).
 """
@@ -41,10 +51,13 @@ import math
 import os
 import random
 import sys
+import tempfile
 import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private import events as _events  # noqa: E402
 
 VOCAB = 50257
 
@@ -221,13 +234,16 @@ def run_cell(args, *, autoscaled: bool, chaos: bool) -> dict:
     rng = random.Random(args.seed)
     lock = threading.Lock()
     stats = {"requests": 0, "completed": 0, "good": 0, "good_tokens": 0,
-             "tokens": 0, "broken": [], "max_stall_ms": 0.0}
+             "tokens": 0, "broken": [], "max_stall_ms": 0.0,
+             "resumed": []}
+
     threads = []
 
     def client(req: dict):
         t0 = time.monotonic()
         slo_s = req["out"] * args.tok_s * 6 + 3.0
         toks, last, stall = [], time.monotonic(), 0.0
+        it = None
         try:
             it = handle.options(stream=True, resumable=True,
                                 timeout_s=slo_s + 60.0).remote(req)
@@ -237,7 +253,13 @@ def run_cell(args, *, autoscaled: bool, chaos: bool) -> dict:
                 last = now
                 toks.append(int(item))
             expect = [token_at(req["seed"], i) for i in range(req["out"])]
-            if toks != expect:
+            identical = toks == expect
+            # The client-side close of the correlation loop: the
+            # flight recorder's reconstruction ends on this verdict.
+            _events.emit("client.verdict", request=it.request_id,
+                         ok=identical, identical=identical,
+                         tokens=len(toks), resumes=it.resumes)
+            if not identical:
                 raise AssertionError(
                     f"stream corrupted: {toks[:4]}... != {expect[:4]}...")
             wall = time.monotonic() - t0
@@ -246,17 +268,63 @@ def run_cell(args, *, autoscaled: bool, chaos: bool) -> dict:
                 stats["tokens"] += len(toks)
                 stats["max_stall_ms"] = max(stats["max_stall_ms"],
                                             stall * 1000)
+                if it.resumes:
+                    stats["resumed"].append((it.request_id, it.resumes))
                 if wall <= slo_s:
                     stats["good"] += 1
                     stats["good_tokens"] += len(toks)
         except Exception as e:  # noqa: BLE001 - every failure is a
             # broken client stream, the thing this harness exists to
             # count; asserted zero by the caller
+            if it is not None:
+                _events.emit("client.verdict", request=it.request_id,
+                             ok=False, identical=False,
+                             tokens=len(toks),
+                             cause=type(e).__name__)
             with lock:
                 stats["broken"].append(repr(e)[:200])
 
     kills = 0
     convergences = []
+    # The flight-recorder anchor stream (--blackbox): one long pinned
+    # request launched just before the replica kill, whose SERVING
+    # replica becomes the kill target — so the chaos run always
+    # produces a request whose story crosses a dead process's ring.
+    pinned = {"rid": None, "request": None}
+
+    def pinned_client():
+        req = {"seed": 424_242, "tenant": "chat", "user": 0,
+               "out": max(16, int(4.0 / args.tok_s))}
+        expect = [token_at(req["seed"], i) for i in range(req["out"])]
+        toks = []
+        it = None
+        try:
+            it = handle.options(stream=True, resumable=True,
+                                timeout_s=180.0).remote(req)
+            for item in it:
+                toks.append(int(item))
+                if pinned["rid"] is None:
+                    pinned["request"] = it.request_id
+                    pinned["rid"] = it._rid
+            identical = toks == expect
+            _events.emit("client.verdict", request=it.request_id,
+                         ok=identical, identical=identical,
+                         tokens=len(toks), resumes=it.resumes)
+            with lock:
+                if it.resumes:
+                    stats["resumed"].insert(
+                        0, (it.request_id, it.resumes))
+                if not identical:
+                    stats["broken"].append(
+                        f"pinned stream corrupted: {toks[:4]}...")
+        except Exception as e:  # noqa: BLE001 - a broken pinned
+            # stream is a broken stream like any other
+            if it is not None:
+                _events.emit("client.verdict", request=it.request_id,
+                             ok=False, identical=False,
+                             tokens=len(toks), cause=type(e).__name__)
+            with lock:
+                stats["broken"].append(f"pinned: {e!r}"[:200])
 
     def chaos_monkey():
         """One replica kill, then one controller kill, both mid-ramp
@@ -264,9 +332,25 @@ def run_cell(args, *, autoscaled: bool, chaos: bool) -> dict:
         nonlocal kills
         time.sleep(args.duration * 0.3)
         try:
-            victims = membership_names(app, dname)
-            if victims:
-                victim = sorted(victims)[0]
+            from ray_tpu.serve.autoscaler import replica_actor_name
+
+            victim = None
+            if args.blackbox:
+                threading.Thread(target=pinned_client, daemon=True,
+                                 name="pinned-client").start()
+                deadline = time.monotonic() + 10.0
+                while pinned["rid"] is None and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.05)
+                if pinned["rid"] is not None:
+                    victim = replica_actor_name(app, pinned["rid"])
+                    _events.emit("chaos.kill", target="replica",
+                                 replica=pinned["rid"],
+                                 request=pinned["request"])
+            if victim is None:
+                victims = membership_names(app, dname)
+                victim = sorted(victims)[0] if victims else None
+            if victim is not None:
                 rt.kill(rt.get_actor(victim, timeout=5))
                 kills += 1
                 c = wait_converged(app, dname)
@@ -277,6 +361,7 @@ def run_cell(args, *, autoscaled: bool, chaos: bool) -> dict:
         try:
             from ray_tpu.serve.config import SERVE_CONTROLLER_NAME
 
+            _events.emit("chaos.kill", target="controller")
             rt.kill(rt.get_actor(SERVE_CONTROLLER_NAME, timeout=5))
             kills += 1
             revive_controller()
@@ -338,6 +423,7 @@ def run_cell(args, *, autoscaled: bool, chaos: bool) -> dict:
             isinstance(c, float) for _, c in convergences),
         "orphans": len(orphans),
         "orphan_names": orphans,
+        "resumed_requests": stats["resumed"][:8],
     }
     serve.delete(app)
     serve.shutdown()
@@ -363,6 +449,13 @@ def main():
     p.add_argument("--seed", type=int, default=17)
     p.add_argument("--no-ab", action="store_true",
                    help="skip the static baseline cell")
+    p.add_argument("--blackbox", action="store_true",
+                   help="arm the flight recorder cluster-wide; dump "
+                        "the merged timeline and one request "
+                        "reconstruction after the chaos cell")
+    p.add_argument("--events-dir", default=None,
+                   help="events directory for --blackbox (default: a "
+                        "fresh temp dir)")
     args = p.parse_args()
 
     if args.smoke:
@@ -372,6 +465,16 @@ def main():
         args.max_out = 24
         args.tok_s = 0.01
         args.no_ab = True
+        args.blackbox = True
+
+    events_dir = None
+    if args.blackbox:
+        # Before rt.init: workers inherit the environment, so every
+        # process in the cluster — replicas included — opens its own
+        # ring under this directory from its first emit.
+        events_dir = args.events_dir or tempfile.mkdtemp(
+            prefix="rt-blackbox-")
+        os.environ[_events.EVENTS_DIR_ENV] = events_dir
 
     import ray_tpu as rt
 
@@ -410,8 +513,61 @@ def main():
         assert auto["orphans"] == 0, auto["orphan_names"]
         assert auto["kills"] >= 1, "chaos never landed a kill"
         assert auto["converged"], auto["convergence"]
+
+        if args.blackbox:
+            blackbox_report(events_dir, auto, smoke=bool(args.smoke))
     finally:
         rt.shutdown()
+
+
+def blackbox_report(events_dir: str, auto: dict, *, smoke: bool):
+    """Merge every ring the run left behind — the SIGKILLed replica's
+    included — and reconstruct the resumed request's story. In smoke
+    mode this is the acceptance gate: the reconstruction must contain
+    the kill, the resume, and the token-identity verdict, with the
+    correlation id intact across processes."""
+    from tools.rtblackbox import (format_timeline, load_rings,
+                                  merge_timeline, reconstruct_request)
+
+    loaded = load_rings(events_dir)
+    tl = merge_timeline(loaded["rings"])
+    resumed = auto.get("resumed_requests") or []
+    rid = resumed[0][0] if resumed else None
+    story = reconstruct_request(tl, rid) if rid else {"events": [],
+                                                      "kinds": []}
+    print(json.dumps({
+        "metric": "serve_cluster_blackbox",
+        "value": len(story["events"]), "unit": "story_events",
+        "events_dir": events_dir,
+        "rings": len(loaded["rings"]),
+        "ring_errors": len(loaded["errors"]),
+        "timeline_events": len(tl["events"]),
+        "procs": len(tl["procs"]),
+        "torn": tl["torn"],
+        "request": rid,
+        "story_kinds": story.get("kinds", []),
+        "story_replicas": story.get("replicas", []),
+    }))
+    if story["events"]:
+        print(f"--- request {rid}: cross-process story "
+              f"(merged from {len(loaded['rings'])} rings) ---",
+              file=sys.stderr)
+        print(format_timeline(story["events"]), file=sys.stderr)
+    if smoke:
+        kinds = set(story.get("kinds", []))
+        assert rid, "blackbox: no resumed request to reconstruct"
+        assert "chaos.kill" in kinds, \
+            f"blackbox: kill missing from the story: {sorted(kinds)}"
+        assert "router.resume" in kinds or "engine.resume" in kinds, \
+            f"blackbox: resume missing from the story: {sorted(kinds)}"
+        verdicts = [e for e in story["events"]
+                    if e["kind"] == "client.verdict"]
+        assert verdicts and verdicts[-1]["attrs"].get("identical"), \
+            "blackbox: token-identity verdict missing or failed"
+        # the story must span processes — the dead replica's ring
+        # contributed, not just the harness's own
+        assert len({e["proc"] for e in story["events"]}) >= 2, \
+            "blackbox: story never left the harness process"
 
 
 if __name__ == "__main__":

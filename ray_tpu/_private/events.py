@@ -1,0 +1,412 @@
+"""Crash-durable flight recorder: the cluster's black box (ISSUE 19).
+
+Every process gets one :func:`emit`-style structured event API backed by
+a **preallocated mmap'd ring file**, so the last-N events of ANY process
+— including one that just took a SIGKILL — are readable from disk
+afterwards. Spans (``util/tracing.py``) explain a request that finished;
+this module explains the one that didn't: the event that was half
+written when the process died is the torn final record, everything
+before it is intact.
+
+Durability model (the same kill-survival contract as
+``_private/wal.py``, adapted from append-only frames to a fixed ring):
+mmap stores land in the kernel page cache, which survives process death
+(power loss is out of scope). Each slot commits with a
+write-payload → write-length+CRC → write-seq protocol, seq last, so a
+reader accepts a slot only when its seq is stamped AND its CRC matches
+— a kill between any two stores yields exactly one torn slot, which
+the reader tolerates and counts.
+
+Event shape: ``emit(kind, **attrs)``. Three attrs are the correlation
+vocabulary the post-mortem collector (``tools/rtblackbox``) joins on:
+
+- ``request=`` — the router-stamped request id (``rq-<pid>-<n>``),
+  carried across proxy → router → prefill handoff → decode → resume;
+- ``lane=`` — the engine stream lane serving the request;
+- ``epoch=`` — the engine driver epoch (restart generation).
+
+Every record carries BOTH clocks: ``time.monotonic()`` for ordering
+(CLOCK_MONOTONIC is machine-wide, so events of different processes on
+one host merge without trusting wall clocks) and ``time.time()`` for
+human labels. The ring header stores a (wall, monotonic) **anchor**
+pair plus the host boot id; the collector uses one reference anchor per
+boot domain to place every process's monotonic stamps on a single
+timeline — a process with a skewed wall clock merges in the right
+order anyway.
+
+Cost contract (pinned by tests):
+
+- **disabled** (no ``RT_EVENTS_DIR``): :func:`emit`/:func:`driver_emit`
+  short-circuit on one module-global load — no dict churn past the
+  kwargs build, no lock, no I/O, and the ring machinery is never
+  constructed;
+- **enabled**: per-kind token-bucket rate caps bound the write rate, so
+  a dispatch-per-token storm costs capped ring writes plus cheap
+  dropped-count increments — the ring file never grows (preallocated)
+  and low-rate kinds are never flooded out by a hot one.
+
+``driver_emit`` is THE helper for ``owner=driver`` hot loops (rtlint
+RT112 enforces this): identical fast path, tighter default cap, and a
+documented promise that it never raises and never blocks on anything
+but the recorder's own mutex.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, Optional
+
+#: Environment switch: a directory path enables the recorder in every
+#: process that inherits the environment (workers inherit os.environ
+#: through the node daemon's spawn env). Unset = recorder fully off.
+EVENTS_DIR_ENV = "RT_EVENTS_DIR"
+
+#: Ring geometry defaults: 4096 slots x 512 bytes = a 2 MiB file plus
+#: one header page per process. ~4k events of last-N is hours of
+#: control-plane history or seconds of a dispatch storm — exactly the
+#: window a post-mortem needs.
+DEFAULT_SLOTS = 4096
+DEFAULT_SLOT_SIZE = 512
+HEADER_SIZE = 4096
+
+#: Per-kind token-bucket caps (events/second, sustained; burst is 2x).
+#: ``driver_emit`` uses the tighter driver cap so the engine hot loop
+#: can call it per dispatch without ever flooding the ring.
+DEFAULT_RATE_PER_S = 500.0
+DRIVER_RATE_PER_S = 200.0
+
+_MAGIC = b"RTEVRING1\0"
+#: Header: magic, version, slot_size, n_slots, pid, wall anchor,
+#: monotonic anchor, boot id (36 ascii), process label (64 utf-8).
+_HEADER = struct.Struct("<10sHIIIdd36s64s")
+#: Slot prefix: seq (0 = never committed), payload length, CRC32.
+_SLOT = struct.Struct("<QII")
+
+
+def _boot_id() -> str:
+    """Host boot identity: monotonic clocks are comparable exactly
+    within one boot of one machine."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()[:36]
+    except OSError:
+        return ""
+
+
+class Recorder:
+    """One process's ring writer. Thread-safe: emits come from router
+    threads, replica request threads, AND the engine driver thread, so
+    the slot claim + store runs under one short mutex (no I/O inside —
+    the mmap store is a memcpy into the page cache)."""
+
+    def __init__(self, path: str, proc: str = "", *,
+                 n_slots: int = DEFAULT_SLOTS,
+                 slot_size: int = DEFAULT_SLOT_SIZE,
+                 rate_per_s: float = DEFAULT_RATE_PER_S,
+                 wall_skew_s: float = 0.0):
+        import mmap
+
+        self.path = path
+        self.proc = proc or f"proc-{os.getpid()}"
+        self.n_slots = int(n_slots)
+        self.slot_size = int(slot_size)
+        self.rate_per_s = float(rate_per_s)
+        #: Test hook ONLY: pretend this process's wall clock is skewed
+        #: (anchor and every record), so merge-ordering tests can prove
+        #: the collector orders by monotonic anchors, not wall time.
+        self._wall_skew = float(wall_skew_s)
+        size = HEADER_SIZE + self.n_slots * self.slot_size
+        # Preallocate the whole ring up front: emit never extends the
+        # file, so a storm can't grow it and a full disk fails HERE
+        # (at enable time), never in a hot loop.
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._lock = threading.Lock()
+        self._seq = 0                      # last committed seq
+        self.emitted = 0
+        self.dropped: Dict[str, int] = {}  # kind -> rate-capped drops
+        self.truncated = 0                 # attrs too big for a slot
+        self._buckets: Dict[str, list] = {}  # kind -> [tokens, last_t]
+        self.wall_anchor = time.time() + self._wall_skew
+        self.mono_anchor = time.monotonic()
+        self._mm[0:_HEADER.size] = _HEADER.pack(
+            _MAGIC, 1, self.slot_size, self.n_slots, os.getpid(),
+            self.wall_anchor, self.mono_anchor,
+            _boot_id().encode("ascii", "replace").ljust(36, b"\0")[:36],
+            self.proc.encode("utf-8", "replace").ljust(64, b"\0")[:64])
+
+    # ------------------------------------------------------------- emit
+    def emit(self, kind: str, attrs: Dict[str, Any],
+             rate_per_s: Optional[float] = None) -> bool:
+        """Record one event; returns False when the kind's rate cap
+        dropped it. Never raises: a recorder failure must never take
+        down the loop it observes."""
+        mono = time.monotonic()
+        with self._lock:
+            if not self._take_token(kind, mono, rate_per_s):
+                self.dropped[kind] = self.dropped.get(kind, 0) + 1
+                _count_dropped(kind)
+                return False
+            seq = self._seq + 1
+            payload = self._encode(kind, mono, attrs)
+            off = HEADER_SIZE + ((seq - 1) % self.n_slots) * self.slot_size
+            try:
+                # Commit protocol (kill-safe): invalidate, payload,
+                # len+crc, seq LAST. A SIGKILL between any two of these
+                # stores leaves a slot the reader rejects (seq zero or
+                # CRC mismatch) — the one torn record the format
+                # tolerates.
+                self._mm[off:off + 8] = b"\0" * 8
+                body = off + _SLOT.size
+                self._mm[body:body + len(payload)] = payload
+                self._mm[off + 8:off + _SLOT.size] = struct.pack(
+                    "<II", len(payload), zlib.crc32(payload))
+                self._mm[off:off + 8] = struct.pack("<Q", seq)
+            except (OSError, ValueError):
+                return False
+            self._seq = seq
+            self.emitted += 1
+            return True
+
+    def _take_token(self, kind: str, now: float,
+                    rate_per_s: Optional[float]) -> bool:
+        """Per-kind token bucket, held under ``_lock``: sustained rate
+        ``rate_per_s``, burst 2x. The cap is the storm guarantee — a
+        dispatch-per-token flood costs one dict increment per drop."""
+        rate = self.rate_per_s if rate_per_s is None else float(rate_per_s)
+        if rate <= 0:
+            return True
+        b = self._buckets.get(kind)
+        if b is None:
+            self._buckets[kind] = [2.0 * rate - 1.0, now]
+            return True
+        b[0] = min(2.0 * rate, b[0] + (now - b[1]) * rate)
+        b[1] = now
+        if b[0] < 1.0:
+            return False
+        b[0] -= 1.0
+        return True
+
+    def _encode(self, kind: str, mono: float,
+                attrs: Dict[str, Any]) -> bytes:
+        wall = time.time() + self._wall_skew
+        cap = self.slot_size - _SLOT.size
+        try:
+            payload = pickle.dumps((mono, wall, kind, attrs), protocol=4)
+        except Exception:  # noqa: BLE001 - unpicklable attr value
+            payload = None
+        if payload is None or len(payload) > cap:
+            # Too big / unpicklable: keep the correlation ids, drop the
+            # rest — a truncated record still joins the timeline.
+            self.truncated += 1
+            core = {k: attrs[k] for k in ("request", "lane", "epoch")
+                    if k in attrs}
+            core["truncated"] = True
+            payload = pickle.dumps((mono, wall, kind, core), protocol=4)
+            payload = payload[:cap] if len(payload) <= cap else \
+                pickle.dumps((mono, wall, kind,
+                              {"truncated": True}), protocol=4)
+        return payload
+
+    # ------------------------------------------------------------ stats
+    def fill(self) -> float:
+        """Fraction of the ring holding live records (1.0 once the ring
+        has wrapped and every slot is a recent event)."""
+        return min(self._seq, self.n_slots) / float(self.n_slots)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"enabled": True, "path": self.path,
+                    "ring_fill": round(self.fill(), 4),
+                    "emitted": self.emitted,
+                    "truncated": self.truncated,
+                    "dropped": dict(self.dropped),
+                    "dropped_total": sum(self.dropped.values())}
+
+    def flush(self):
+        """Best-effort msync — NOT required for kill-durability (the
+        page cache survives the process); only narrows the power-loss
+        window for tests that want it."""
+        try:
+            self._mm.flush()
+        except (OSError, ValueError):
+            pass
+
+    def close(self):
+        with self._lock:
+            try:
+                self._mm.close()
+            except (OSError, ValueError):
+                pass
+
+
+# ------------------------------------------------------------- module API
+_init_lock = threading.Lock()
+_recorder: Optional[Recorder] = None
+#: Tri-state fast path: False until the env decision is made, True
+#: after. Disabled processes pay exactly one global load + one branch
+#: per emit call after the first.
+_resolved = False
+
+
+def ring_path(directory: str, proc: str = "") -> str:
+    """Per-process ring file name: process label, pid, and a start
+    stamp so a recycled pid never collides with a dead ring."""
+    label = (proc or "proc").replace(os.sep, "_")
+    return os.path.join(
+        directory, f"{label}-{os.getpid()}-{int(time.time() * 1000)}.evr")
+
+
+def _default_proc_label() -> str:
+    import sys
+
+    base = os.path.basename(sys.argv[0] or "py").rsplit(".py", 1)[0]
+    return base or "py"
+
+
+def init(directory: Optional[str] = None, proc: str = "",
+         **kw) -> Optional[Recorder]:
+    """Explicitly enable the recorder for this process (tests and
+    tools; servers normally enable via ``RT_EVENTS_DIR``). Idempotent:
+    a second init returns the live recorder."""
+    global _recorder, _resolved
+    with _init_lock:
+        if _recorder is not None:
+            return _recorder
+        directory = directory or os.environ.get(EVENTS_DIR_ENV)
+        if not directory:
+            _resolved = True
+            return None
+        try:
+            os.makedirs(directory, exist_ok=True)
+            _recorder = Recorder(
+                ring_path(directory, proc or _default_proc_label()),
+                proc or _default_proc_label(), **kw)
+        except Exception:  # noqa: BLE001 - an unwritable events dir
+            # must degrade to disabled, never break the host process.
+            _recorder = None
+        _resolved = True
+        return _recorder
+
+
+def enabled() -> bool:
+    return (_recorder if _resolved else init()) is not None
+
+
+def emit(kind: str, **attrs) -> bool:
+    """Structured event emission for control-plane and request-plane
+    paths (router, replica, controller, lease table). Rate-capped per
+    kind; a true no-op when the recorder is disabled."""
+    rec = _recorder
+    if rec is None:
+        if _resolved:
+            return False
+        rec = init()
+        if rec is None:
+            return False
+    return rec.emit(kind, attrs)
+
+
+def driver_emit(kind: str, **attrs) -> bool:
+    """THE emission helper for ``owner=driver`` hot loops (rtlint
+    RT112): same fast no-op when disabled, tighter sustained rate cap
+    when enabled, never raises, never blocks beyond the recorder mutex.
+    """
+    rec = _recorder
+    if rec is None:
+        if _resolved:
+            return False
+        rec = init()
+        if rec is None:
+            return False
+    return rec.emit(kind, attrs, rate_per_s=DRIVER_RATE_PER_S)
+
+
+def stats() -> Dict[str, Any]:
+    """This process's recorder stats — the ``events`` block engines and
+    replicas surface (ring fill fraction, per-kind dropped counts)."""
+    rec = _recorder
+    if rec is None:
+        return {"enabled": False}
+    return rec.stats()
+
+
+def recorder() -> Optional[Recorder]:
+    return _recorder
+
+
+def _reset_for_tests():
+    """Drop the process-global recorder so a test can re-init against a
+    fresh directory (testing only)."""
+    global _recorder, _resolved
+    with _init_lock:
+        if _recorder is not None:
+            _recorder.close()
+        _recorder = None
+        _resolved = False
+
+
+def _count_dropped(kind: str):
+    """Mirror a rate-capped drop into ``rt_events_dropped_total``.
+    Called under the recorder lock on the drop path only — the storm
+    cost is one counter-dict increment per dropped event."""
+    try:
+        from .metrics import serve_metrics
+
+        serve_metrics()["events_dropped"].inc(labels={"kind": kind})
+    except Exception:  # noqa: BLE001 - metrics must never break emit
+        pass
+
+
+# ------------------------------------------------------------- ring read
+def read_ring(path: str) -> Dict[str, Any]:
+    """Read one ring file — typically a DEAD process's — back into
+    ``{"proc", "pid", "wall_anchor", "mono_anchor", "boot_id",
+    "events": [...], "torn": n}``. Events carry ``seq``, ``mono``,
+    ``wall``, ``kind``, ``attrs`` and come back seq-ordered. A slot
+    whose seq is stamped but whose CRC or pickle does not check out is
+    the torn final record the format tolerates: counted, skipped."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < _HEADER.size or not data.startswith(_MAGIC):
+        raise ValueError(f"{path} is not an rtevents ring file")
+    (_, version, slot_size, n_slots, pid, wall_anchor, mono_anchor,
+     boot, proc) = _HEADER.unpack_from(data, 0)
+    out = {
+        "path": path, "version": version,
+        "proc": proc.rstrip(b"\0").decode("utf-8", "replace"),
+        "pid": pid, "wall_anchor": wall_anchor,
+        "mono_anchor": mono_anchor,
+        "boot_id": boot.rstrip(b"\0").decode("ascii", "replace"),
+        "n_slots": n_slots, "slot_size": slot_size,
+        "events": [], "torn": 0,
+    }
+    for i in range(n_slots):
+        off = HEADER_SIZE + i * slot_size
+        if off + _SLOT.size > len(data):
+            break
+        seq, length, crc = _SLOT.unpack_from(data, off)
+        if seq == 0:
+            continue
+        body = data[off + _SLOT.size:off + _SLOT.size + length]
+        if length > slot_size - _SLOT.size or len(body) < length \
+                or zlib.crc32(body) != crc:
+            out["torn"] += 1
+            continue
+        try:
+            mono, wall, kind, attrs = pickle.loads(body)
+        except Exception:  # noqa: BLE001 - torn payload, same tolerance
+            out["torn"] += 1
+            continue
+        out["events"].append({"seq": seq, "mono": mono, "wall": wall,
+                              "kind": kind, "attrs": attrs})
+    out["events"].sort(key=lambda e: e["seq"])
+    return out

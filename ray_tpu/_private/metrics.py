@@ -415,6 +415,10 @@ def serve_metrics() -> dict:
             requests_shed=Counter(
                 "serve_requests_shed_total",
                 "Requests shed under overload (backpressure / 503)"),
+            events_dropped=Counter(
+                "rt_events_dropped_total",
+                "Flight-recorder events dropped by per-kind rate caps "
+                "(labelled by kind; the ring survived a storm)"),
             requests_expired=Counter(
                 "serve_requests_expired_total",
                 "Requests dropped because their deadline passed before "

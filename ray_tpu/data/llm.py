@@ -62,6 +62,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 from . import block as B
+from .._private.events import driver_emit as _driver_emit
 from .executor import BackpressurePolicy
 
 
@@ -525,6 +526,9 @@ class BatchInferencer:
                 skeletons.append(sk)
             self._log.commit(idx, skeletons, list(bs.outs))
         self.stats["blocks"] += 1
+        _driver_emit("data.block_commit", block=idx, rows=len(out_rows),
+                     tokens=sum(int(t.shape[0]) for t in bs.outs),
+                     journaled=self._log is not None)
         return B.rows_to_block(out_rows)
 
     # -------------------------------------------------------------- driving

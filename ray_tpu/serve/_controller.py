@@ -16,6 +16,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
+from .._private import events as _events
 from .autoscaler import (PLAIN_GROUP, Autoscaler, DesiredStateJournal,
                          replica_actor_name)
 from .config import AutoscalingConfig, DeploymentConfig
@@ -234,6 +235,10 @@ class ServeController:
             except Exception:  # noqa: BLE001 - journal lag; drain anyway
                 traceback.print_exc()
             self._maybe_crash("drain_condemned")
+        _events.emit("controller.drain", phase="begin",
+                     deployment=deployment,
+                     replicas=[r.get("rid", "") for r in victims],
+                     timeout_s=timeout_s)
         t0 = time.time()
         refs = []
         for r in victims:
@@ -258,6 +263,10 @@ class ServeController:
                 rt.kill(r["handle"])
             except Exception:  # noqa: BLE001
                 pass
+        _events.emit("controller.drain", phase="end",
+                     deployment=deployment,
+                     replicas=[r.get("rid", "") for r in victims],
+                     elapsed_s=round(dt, 3))
         if app_name is not None:
             try:
                 self._journal_intents(
@@ -627,6 +636,20 @@ class ServeController:
                                     "lanes", "fallback_rounds"):
                             agg[key] = agg.get(key, 0) + int(
                                 sp.get(key, 0))
+                    ev = est.get("events")
+                    if ev and ev.get("enabled"):
+                        # Flight-recorder health (ISSUE 19): summed
+                        # emit/drop totals plus the WORST ring fill —
+                        # a deployment-wide view of whether the rings
+                        # are keeping up, from serve.status() alone.
+                        agg = engine.setdefault("events", {})
+                        for key in ("emitted", "dropped_total",
+                                    "truncated"):
+                            agg[key] = agg.get(key, 0) + int(
+                                ev.get(key, 0))
+                        agg["ring_fill"] = max(
+                            agg.get("ring_fill", 0.0),
+                            float(ev.get("ring_fill", 0.0)))
                     ho = est.get("handoff")
                     if ho:
                         # Disaggregation visibility (ISSUE 14): summed
@@ -662,6 +685,9 @@ class ServeController:
                     / max(sp["lanes"], 1), 3)
             d["engine"] = engine
         if dead:
+            for rid in dead:
+                _events.emit("controller.replica_dead", replica=rid,
+                             deployment=d["name"], cause="health_probe")
             with self._lock:
                 victims = []
                 for rid in dead:

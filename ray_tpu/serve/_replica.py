@@ -17,13 +17,14 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from .._private import events as _events
 from ..util import tracing
-from .request import (HANDOFF_KEY, RESUME_FROM_KEY, SUBMITTED_AT_KEY,
-                      TRACE_CTX_KEY, ReplicaDrainingError,
-                      ReplicaOverloadedError, RequestDeadlineExceeded,
-                      _request_deadline, _request_deployment,
-                      _request_handoff, _request_resume_from,
-                      deadline_expired)
+from .request import (HANDOFF_KEY, REQUEST_ID_KEY, RESUME_FROM_KEY,
+                      SUBMITTED_AT_KEY, TRACE_CTX_KEY,
+                      ReplicaDrainingError, ReplicaOverloadedError,
+                      RequestDeadlineExceeded, _request_deadline,
+                      _request_deployment, _request_handoff, _request_id,
+                      _request_resume_from, deadline_expired)
 
 #: Bound on the fault-injection invocation log (test hook, see below).
 _INVOCATION_LOG_CAP = 10_000
@@ -114,6 +115,12 @@ class Replica:
                     f"{self._max_ongoing}")
             self._ongoing += 1
             self._total += 1
+            ongoing = self._ongoing
+        _events.emit("replica.admit",
+                     request=(ctx or {}).get(REQUEST_ID_KEY, ""),
+                     replica=self.replica_id,
+                     deployment=self.deployment_name,
+                     method=method_name, ongoing=ongoing)
         self._observe_queue_wait(ctx)
         return deadline
 
@@ -179,6 +186,8 @@ class Replica:
             token = _request_model_id.set(ctx["multiplexed_model_id"])
         dl_token = _request_deadline.set(deadline)
         dep_token = _request_deployment.set(self.deployment_name)
+        rid_token = _request_id.set(
+            (ctx or {}).get(REQUEST_ID_KEY) or None)
         # Prefill hop of a disaggregated dispatch (ISSUE 14): the
         # continuous-batching wrapper answers with a leased handoff
         # descriptor instead of a stream.
@@ -205,6 +214,7 @@ class Replica:
             return out
         finally:
             _request_handoff.reset(ho_token)
+            _request_id.reset(rid_token)
             _request_deployment.reset(dep_token)
             _request_deadline.reset(dl_token)
             if token is not None:
@@ -249,6 +259,8 @@ class Replica:
         resume_from = int((ctx or {}).get(RESUME_FROM_KEY, 0) or 0)
         dl_token = _request_deadline.set(deadline)
         dep_token = _request_deployment.set(self.deployment_name)
+        rid_token = _request_id.set(
+            (ctx or {}).get(REQUEST_ID_KEY) or None)
         rf_token = _request_resume_from.set(resume_from)
         # Decode hop of a disaggregated dispatch (ISSUE 14): the
         # continuous-batching wrapper imports the shipped KV instead of
@@ -291,6 +303,7 @@ class Replica:
         finally:
             _request_handoff.reset(ho_token)
             _request_resume_from.reset(rf_token)
+            _request_id.reset(rid_token)
             _request_deployment.reset(dep_token)
             _request_deadline.reset(dl_token)
             if token is not None:
@@ -539,8 +552,15 @@ class Replica:
         with self._lock:
             self._draining = True
             self._drains += 1
+            ongoing = self._ongoing
+        _events.emit("replica.drain", replica=self.replica_id,
+                     deployment=self.deployment_name, phase="begin",
+                     ongoing=ongoing, timeout_s=float(timeout_s))
         for eng in self._engines():
             eng.drain(max(deadline - time.time(), 0.0))
+        _events.emit("replica.drain", replica=self.replica_id,
+                     deployment=self.deployment_name,
+                     phase="engines_drained")
         # Condition wait, not a poll: the last finishing request
         # notifies, so an idle replica returns immediately and a busy
         # one wakes the moment its in-flight count hits zero.
@@ -552,7 +572,13 @@ class Replica:
             while self._ongoing and time.time() < deadline:
                 self._idle_cond.wait(
                     timeout=max(deadline - time.time(), 0.0))
-            return self._ongoing == 0
+            clean = self._ongoing == 0
+            stragglers = self._ongoing
+        _events.emit("replica.drain", replica=self.replica_id,
+                     deployment=self.deployment_name, phase="end",
+                     clean=clean, stragglers=stragglers,
+                     elapsed_s=round(time.time() - t0, 4))
+        return clean
 
 
 def _resolve_handles(app_name: str, obj):

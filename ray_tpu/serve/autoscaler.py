@@ -33,6 +33,7 @@ fleet instead of double-scaling or orphaning it.
 """
 from __future__ import annotations
 
+import collections
 import json
 import math
 import threading
@@ -40,8 +41,15 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+from .._private import events as _events
 from .._private.metrics import EMA, serve_metrics
 from .config import AutoscalingConfig
+
+#: How many past ``decide()`` outcomes each role group remembers, with
+#: their full signal snapshots — surfaced through ``serve.status()``
+#: (ISSUE 19 satellite: the counters-only view from PR 17 could say a
+#: hold happened but never WHY).
+DECISION_RING_N = 32
 
 #: Cluster-KV namespace shared with the declarative config plane.
 KV_NS = "serve"
@@ -169,6 +177,9 @@ class GroupState:
         self.idle_since: Optional[float] = None
         self.cold_until = 0.0
         self.last_decision: Optional[dict] = None
+        #: Last-N decisions WITH their signal snapshots (newest last).
+        self.decisions: "collections.deque[dict]" = \
+            collections.deque(maxlen=DECISION_RING_N)
 
 
 def _load_mode(cfg: AutoscalingConfig,
@@ -391,11 +402,17 @@ class Autoscaler:
             return sum(p for p, t in pend.values() if now - t <= window_s)
 
     def last_decisions(self, app: str, dname: str) -> Dict[str, dict]:
+        """Per-group decision view for ``serve.status()``: the latest
+        decision's fields at the top level (back-compat with the
+        counters-era shape) plus ``ring`` — the last-N ``decide()``
+        outcomes with their full signal snapshots, newest last."""
         with self._lock:
             out = {}
             for (a, d, group), st in self._states.items():
                 if a == app and d == dname and st.last_decision:
-                    out[group] = dict(st.last_decision)
+                    entry = dict(st.last_decision)
+                    entry["ring"] = [dict(e) for e in st.decisions]
+                    out[group] = entry
             return out
 
     # ------------------------------------------------------------- decide
@@ -428,6 +445,34 @@ class Autoscaler:
             st.last_decision = {"target": d.target,
                                 "direction": d.direction,
                                 "reason": d.reason, "t": now}
+            # Decision-ring entry: the decision PLUS everything it was
+            # decided from, so a held/odd scaling call is explainable
+            # after the fact without replaying the controller.
+            _, _, mode = _load_mode(cfg, sig)
+            snapshot = {
+                "queue_depth": sig.queue_depth,
+                "ongoing": sig.ongoing,
+                "active_slots": sig.active_slots,
+                "slots": sig.slots,
+                "occupancy": (sig.active_slots / sig.slots
+                              if sig.slots else 0.0),
+                "pending": sig.pending, "n": sig.n,
+                "fresh": sig.fresh,
+                "newest_age": (round(sig.newest_age, 3)
+                               if math.isfinite(sig.newest_age)
+                               else None),
+                "tpot_p95": sig.tpot_p95,
+            }
+            with self._lock:
+                st.decisions.append({
+                    **st.last_decision, "cur": int(info["cur"]),
+                    "mode": mode, "ema": st.ema.value,
+                    "signals": snapshot})
+            _events.emit("autoscale.decide", deployment=dname,
+                         group=group, target=d.target,
+                         direction=d.direction, reason=d.reason,
+                         cur=int(info["cur"]), mode=mode,
+                         ema=st.ema.value, **snapshot)
             self._observe(dname, group, d)
             decisions[group] = d
         return decisions

@@ -169,10 +169,13 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .._private import events as _events
+from .._private.events import driver_emit as _driver_emit
 from ..util import tracing
 from .batching import (_STREAM_END, _EngineStream, _StreamLane,
                        default_buckets)
-from .request import RequestDeadlineExceeded, deadline_expired
+from .request import (RequestDeadlineExceeded, deadline_expired,
+                      get_request_id)
 
 
 def default_prompt_buckets(max_len: int) -> List[int]:
@@ -222,6 +225,11 @@ class _EngineRequest:
     handoff: Optional[dict] = None
     #: Export-side lease TTL override (0 = the engine's default).
     ttl_s: float = 0.0
+    #: Flight-recorder correlation id: the router-stamped request id
+    #: read from the replica's contextvar at submit time (falls back to
+    #: a local ``eng-<n>`` id for bare in-process engine use), stamped
+    #: on every event this request's slot produces.
+    req_id: str = ""
 
 
 @dataclass
@@ -571,6 +579,9 @@ class DecodeEngine:
         #: Chaos-harness fault armed via inject_fault() (testing only).
         self._fault: Optional[dict] = None
         self._throttle_s = 0.0
+        # Fallback flight-recorder ids for bare in-process submissions
+        # (no router upstream to stamp the contextvar).
+        self._req_uid = 0
         if auto_start:
             self.start()
 
@@ -879,6 +890,18 @@ class DecodeEngine:
                 f"length {self.max_len}")
         return prompt, bucket
 
+    def _new_req_id(self) -> str:
+        """Flight-recorder correlation id for this admission: the
+        router-stamped id when one rode the request context here, else
+        a local ``eng-<pid>-<n>`` id so bare in-process streams still
+        correlate across their own events."""
+        rid = get_request_id()
+        if rid:
+            return rid
+        with self._admit_lock:
+            self._req_uid += 1
+            return f"eng-{os.getpid():x}-{self._req_uid}"
+
     def submit(self, prompt, max_new: int, *,
                deadline_s: Optional[float] = None,
                trace_ctx: Optional[dict] = None,
@@ -910,6 +933,7 @@ class DecodeEngine:
         if max_new <= 0:
             lane.q.put((_STREAM_END, None))
             return lane
+        req_id = self._new_req_id()
         with self._admit_lock:
             # _draining (not thread-aliveness) is the admission gate: a
             # not-yet-started engine (auto_start=False) queues work for
@@ -924,9 +948,13 @@ class DecodeEngine:
             self._queue.put(_EngineRequest(
                 prompt=prompt, bucket=bucket, max_new=int(max_new),
                 lane=lane, deadline_s=deadline_s, trace_ctx=trace_ctx,
-                seed=int(seed), enq_t=time.time(), skip=resume_from))
+                seed=int(seed), enq_t=time.time(), skip=resume_from,
+                req_id=req_id))
         if resume_from:
             self._count(resumed=1)
+            _events.emit("engine.resume", request=req_id,
+                         resume_from=int(resume_from),
+                         epoch=self._epoch)
         return lane
 
     def stream(self, prompt, max_new: int, **kw):
@@ -964,6 +992,7 @@ class DecodeEngine:
             raise ValueError("handoff needs max_new >= 1 (the first "
                              "token is sampled at prefill)")
         lane = _StreamLane()
+        req_id = self._new_req_id()
         with self._admit_lock:
             if self._draining:
                 raise EngineShutdownError(
@@ -973,7 +1002,7 @@ class DecodeEngine:
                 prompt=prompt, bucket=bucket, max_new=int(max_new),
                 lane=lane, deadline_s=deadline_s, trace_ctx=trace_ctx,
                 seed=int(seed), enq_t=time.time(), export=True,
-                ttl_s=float(ttl_s or 0.0)))
+                ttl_s=float(ttl_s or 0.0), req_id=req_id))
         # Synchronous drain: ONE item (the descriptor), then END. The
         # wait is deadline-bounded so a wedged driver surfaces as the
         # deadline error instead of a hang.
@@ -1001,7 +1030,10 @@ class DecodeEngine:
         object) before its expiry. Unknown/stale leases return False —
         the sweep already reclaimed them, which is also fine: the
         claimer holds the bytes it needs. Safe from any thread."""
-        return self._leases.claim(lease_id, int(epoch))
+        ok = self._leases.claim(lease_id, int(epoch))
+        _events.emit("handoff.claim", lease=lease_id,
+                     epoch=int(epoch), released=ok)
+        return ok
 
     def admit_prefilled(self, desc: dict, *,
                         deadline_s: Optional[float] = None,
@@ -1095,6 +1127,7 @@ class DecodeEngine:
         bucket = next((b for b in self.prompt_buckets
                        if b >= prompt.shape[0]), self.prompt_buckets[-1])
         lane = _StreamLane()
+        req_id = self._new_req_id()
         with self._admit_lock:
             if self._draining:
                 raise EngineShutdownError(
@@ -1105,7 +1138,8 @@ class DecodeEngine:
                 lane=lane, deadline_s=deadline_s, trace_ctx=trace_ctx,
                 seed=seed, enq_t=time.time(), skip=resume_from,
                 handoff={"payload": payload,
-                         "created_t": desc.get("created_t")}))
+                         "created_t": desc.get("created_t")},
+                req_id=req_id))
         if resume_from:
             self._count(resumed=1)
         return lane
@@ -1251,6 +1285,8 @@ class DecodeEngine:
         from .._private.metrics import serve_metrics
         serve_metrics()["engine_driver_restarts"].inc(
             labels={"deployment": self.deployment})
+        _events.emit("engine.driver_restart", epoch=self._epoch,
+                     deployment=self.deployment, reason=reason)
         self._thread = None
         self.start()
 
@@ -1355,6 +1391,10 @@ class DecodeEngine:
         out["driver_alive"] = bool(t is not None and t.is_alive())
         out["heartbeat_age_s"] = round(time.monotonic() - self._beat, 3)
         out["draining"] = self._draining
+        # Flight-recorder health (ISSUE 19): ring fill fraction and
+        # per-kind rate-cap drops for THIS process's recorder — rides
+        # the replica metrics pull up into serve.status().
+        out["events"] = _events.stats()
         # Runtime-sanitizer block (ISSUE 13): only when tools/rtsan is
         # already loaded AND active in this process — checked via
         # sys.modules so ray_tpu never imports the analyzer tree into
@@ -1522,7 +1562,13 @@ class DecodeEngine:
         while pool.available() < n:
             if prefix is None or not prefix.evict_lru():
                 return None
-        return pool.alloc(n)
+            _driver_emit("engine.page_evict", epoch=self._epoch,
+                         wanted=n, free=pool.available())
+        pages = pool.alloc(n)
+        if pages is not None:
+            _driver_emit("engine.page_alloc", epoch=self._epoch,
+                         n=n, free=pool.available())
+        return pages
 
     def _observe_pages(self, sm=None):
         if not self.paged:
@@ -1552,6 +1598,9 @@ class DecodeEngine:
 
             serve_metrics()["handoff_leases_reclaimed"].inc(
                 n, labels={"deployment": self.deployment})
+            _driver_emit("handoff.reclaim", count=n,
+                         epoch=self._epoch,
+                         outstanding=len(self._leases))
 
     def _observe_queue_depth(self):  # rtlint: owner=driver
         """Export the admission backlog once per driver loop (gauge
@@ -1690,6 +1739,9 @@ class DecodeEngine:
         the drafter. The replay bookkeeping (``emitted``/``skip``)
         must stay bit-equal between the two entry paths or a resumed
         stream diverges by one token."""
+        _driver_emit("engine.admit", request=req.req_id, slot=slot,
+                     epoch=self._epoch, prompt_len=P,
+                     max_new=req.max_new, resume_from=req.skip)
         skip = req.skip
         if skip > 0:
             skip -= 1            # replay: the first token was delivered
@@ -1870,6 +1922,11 @@ class DecodeEngine:
         # points (kill/throttle at token N) work on prefill engines.
         self._count(handoffs_exported=1, handoff_ship_bytes=nbytes,
                     tokens=1)
+        _driver_emit("handoff.grant", request=req.req_id,
+                     lease=lease_id, epoch=self._epoch, nbytes=nbytes,
+                     ttl_s=req.ttl_s or self._leases.ttl_s)
+        _driver_emit("engine.export", request=req.req_id, slot=slot,
+                     epoch=self._epoch, prompt_len=P, nbytes=nbytes)
         sm["kv_ship_bytes"].inc(
             nbytes, labels={"deployment": self.deployment})
         req.lane.q.put(("item", desc))
@@ -1973,6 +2030,8 @@ class DecodeEngine:
                                 deployment=self.deployment)
         self._count(handoffs_imported=1,
                     admitted=1 if req.skip == 0 else 0)
+        _driver_emit("engine.import", request=req.req_id, slot=slot,
+                     epoch=self._epoch, pos=P)
         return self._enter_steady_state(req, slot, first, P, pages, sm)
 
     def _cover_pages(self) -> bool:  # rtlint: owner=driver
@@ -2061,6 +2120,9 @@ class DecodeEngine:
         self._free_slot(youngest)
         self._pending.appendleft(req)
         self._count(preempted=1)
+        _driver_emit("engine.preempt", request=req.req_id,
+                     slot=youngest, epoch=self._epoch,
+                     delivered=st.emitted)
         self._observe_pages()
         return False
 
@@ -2113,6 +2175,11 @@ class DecodeEngine:
         sm["engine_dispatches"].inc(
             labels={"deployment": self.deployment})
         self._count(dispatches=1, occupancy_sum=n_active / self.slots)
+        # Rate-capped: under a dispatch-per-token storm the cap drops
+        # the excess (counted) instead of flooding the ring.
+        _driver_emit("engine.dispatch", epoch=self._epoch,
+                     active=n_active, chunk=self.chunk,
+                     dispatch_s=round(t1 - t0, 6))
         if self.paged and self.attn_kernel == "pallas":
             # One fused-kernel dispatch per chunk program launch (the
             # kernel runs k times per layer inside it).
@@ -2259,6 +2326,9 @@ class DecodeEngine:
         self._count(dispatches=1, occupancy_sum=n_active / self.slots,
                     spec_rounds=1, spec_proposed=self.draft_k * n_active,
                     spec_accepted=accepted_total, spec_lanes=n_active)
+        _driver_emit("engine.dispatch", epoch=self._epoch,
+                     active=n_active, spec=True,
+                     accepted=accepted_total)
         with self._stats_lock:
             self._stats["peak_active"] = max(self._stats["peak_active"],
                                              n_active)

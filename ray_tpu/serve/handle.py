@@ -36,9 +36,10 @@ from typing import Any, Dict, Optional, Tuple
 from ..exceptions import (ActorDiedError, ActorUnavailableError,
                           GetTimeoutError, RayTpuError, TaskError,
                           WorkerCrashedError)
+from .._private import events as _events
 from ..util import tracing
-from .request import (HANDOFF_KEY, RESUME_FROM_KEY, SUBMITTED_AT_KEY,
-                      TRACE_CTX_KEY, BackPressureError,
+from .request import (HANDOFF_KEY, REQUEST_ID_KEY, RESUME_FROM_KEY,
+                      SUBMITTED_AT_KEY, TRACE_CTX_KEY, BackPressureError,
                       ReplicaDrainingError, ReplicaOverloadedError,
                       RequestDeadlineExceeded, deadline_expired,
                       get_request_deadline, make_deadline, remaining_s,
@@ -239,13 +240,15 @@ class DeploymentResponse:
     def __init__(self, router: "Router", rid: str, ref,
                  call: Tuple[str, tuple, dict], model_id: str = "",
                  deadline_s: Optional[float] = None,
-                 t0: Optional[float] = None):
+                 t0: Optional[float] = None, request_id: str = ""):
         self._router = router
         self._rid = rid
         self._ref = ref
         self._call = call
         self._model_id = model_id
         self._deadline_s = deadline_s
+        #: Flight-recorder correlation id; retries reuse it.
+        self._request_id = request_id
         # Submission instant (perf_counter) for the e2e latency
         # histogram; a retry keeps the ORIGINAL t0 — the caller has been
         # waiting since the first submission. Observed at most once —
@@ -256,6 +259,13 @@ class DeploymentResponse:
     @property
     def object_ref(self):
         return self._ref
+
+    @property
+    def request_id(self) -> str:
+        """Flight-recorder correlation id of this logical request —
+        stable across retries; the join key for ``rtblackbox
+        --request``."""
+        return self._request_id
 
     def result(self, timeout: Optional[float] = None,
                _retries: Optional[int] = None) -> Any:
@@ -319,16 +329,23 @@ class DeploymentResponse:
                         raise
                     attempts += 1
                     _serve_counters()["retries"].inc(labels=labels)
+                    _events.emit("router.retry",
+                                 request=self._request_id,
+                                 deployment=self._router.deployment_name,
+                                 replica=self._rid, attempt=attempts,
+                                 cause=type(e).__name__)
                 else:
                     raise
                 _backoff_sleep(backoff, deadline)
                 backoff = min(backoff * 2, Router.RETRY_BACKOFF_CAP_S)
                 method, args, kwargs = self._call
                 # Carry the multiplexed model id so a transparent retry
-                # still executes in the original tenant's context.
+                # still executes in the original tenant's context (and
+                # the request id so the retry joins the same story).
                 resp = self._router.submit(method, args, kwargs,
                                            deadline_s=deadline,
-                                           model_id=self._model_id)
+                                           model_id=self._model_id,
+                                           request_id=self._request_id)
                 self._rid, self._ref = resp._rid, resp._ref
 
     def __await__(self):
@@ -364,10 +381,14 @@ class DeploymentResponseGenerator:
                  call: Optional[Tuple[str, tuple, dict]] = None,
                  model_id: str = "", flatten_chunks: bool = False,
                  deadline_s: Optional[float] = None,
-                 t0: Optional[float] = None, resumable: bool = False):
+                 t0: Optional[float] = None, resumable: bool = False,
+                 request_id: str = ""):
         self._router = router
         self._rid = rid
         self._gen = gen
+        #: Flight-recorder correlation id; re-routes and resumes reuse
+        #: it, so every hop of this stream's story joins on one key.
+        self._request_id = request_id
         self._call = call
         self._model_id = model_id
         self._flatten_chunks = flatten_chunks
@@ -385,6 +406,19 @@ class DeploymentResponseGenerator:
         # arrival), e2e on clean exhaustion.
         self._t0 = time.perf_counter() if t0 is None else t0
         self._last_item_at: Optional[float] = None
+
+    @property
+    def request_id(self) -> str:
+        """Correlation id of this stream's logical request (stable
+        across re-routes and mid-stream resumes) — the id to hand to
+        ``rtblackbox --request``."""
+        return self._request_id
+
+    @property
+    def resumes(self) -> int:
+        """Re-routes this stream survived (setup re-picks and
+        mid-stream resumes combined)."""
+        return self._reroutes
 
     def _finish(self):
         if not self._done:
@@ -479,12 +513,14 @@ class DeploymentResponseGenerator:
         _backoff_sleep(self._backoff, self._deadline_s)
         self._backoff = min(self._backoff * 2, Router.RETRY_BACKOFF_CAP_S)
         method, args, kwargs = self._call
+        old_rid = self._rid
         try:
             rid, gen = self._router._submit_stream_raw(
                 method, args, kwargs, deadline_s=self._deadline_s,
                 model_id=self._model_id,
                 flatten_chunks=self._flatten_chunks,
-                resume_from=self._delivered if self._got_first else 0)
+                resume_from=self._delivered if self._got_first else 0,
+                request_id=self._request_id)
         except Exception:  # noqa: BLE001 - nothing admitted the re-route;
             return False   # _finish() releases the old slot exactly once
         # Old slot released only now: on the failure path mark_dead
@@ -495,6 +531,18 @@ class DeploymentResponseGenerator:
         self._rid, self._gen = rid, gen
         if self._got_first:
             _serve_counters()["stream_resumes"].inc(labels=labels)
+            _events.emit("router.resume", request=self._request_id,
+                         deployment=self._router.deployment_name,
+                         from_replica=old_rid, to_replica=rid,
+                         delivered=self._delivered,
+                         attempt=self._reroutes,
+                         cause=type(e).__name__)
+        else:
+            _events.emit("router.retry", request=self._request_id,
+                         deployment=self._router.deployment_name,
+                         from_replica=old_rid, to_replica=rid,
+                         attempt=self._reroutes,
+                         cause=type(e).__name__)
         return True
 
     def __del__(self):
@@ -640,6 +688,11 @@ class Router:
         import uuid
 
         self._router_id = uuid.uuid4().hex[:12]
+        # Flight-recorder correlation ids: minted ONCE per logical
+        # request (retries and mid-stream resumes reuse the id), so the
+        # post-mortem collector can follow one request across every
+        # process it touched.
+        self._req_seq = 0
         self.budget = RetryBudget()
         self._last_refresh = 0.0
         self._outstanding: Dict[Any, str] = {}  # ObjectRef -> rid
@@ -845,6 +898,11 @@ class Router:
                             _serve_counters()["requests_shed"].inc(
                                 labels={"deployment": self.deployment_name,
                                         "where": "router"})
+                            _events.emit(
+                                "router.shed",
+                                deployment=self.deployment_name,
+                                pending=self._pending,
+                                max_queued=self._max_queued)
                             raise BackPressureError(
                                 f"all replicas of {self.deployment_name} "
                                 f"saturated and {self._pending} requests "
@@ -884,17 +942,30 @@ class Router:
             deadline_s = ambient
         return deadline_s
 
+    def new_request_id(self) -> str:
+        """Mint a cluster-wide request correlation id. Minted once per
+        LOGICAL request — retries and mid-stream resumes re-send the
+        same id — so rings from every process a request touched (alive
+        or dead) join on it."""
+        import os as _os
+
+        with self._cond:
+            self._req_seq += 1
+            return f"rq-{_os.getpid():x}-{self._req_seq}"
+
     def submit(self, method_name: str, args: tuple, kwargs: dict,
                timeout_s: Optional[float] = None,
                model_id: str = "",
-               deadline_s: Optional[float] = None) -> DeploymentResponse:
+               deadline_s: Optional[float] = None,
+               request_id: str = "") -> DeploymentResponse:
         # A fresh submission stamps its deadline once; a retry passes
         # the original deadline through so the window never restarts.
         t0 = time.perf_counter()
         if deadline_s is None:
             deadline_s = self._stamp_deadline(timeout_s)
+        request_id = request_id or self.new_request_id()
         rid, handle = self._acquire(deadline_s, model_id)
-        ctx = self._request_ctx(deadline_s)
+        ctx = self._request_ctx(deadline_s, request_id)
         if model_id:
             with self._cond:
                 self._model_affinity.setdefault(model_id, set()).add(rid)
@@ -908,16 +979,20 @@ class Router:
         self._waiter_wake.set()
         return DeploymentResponse(self, rid, ref,
                                   (method_name, args, kwargs), model_id,
-                                  deadline_s=deadline_s, t0=t0)
+                                  deadline_s=deadline_s, t0=t0,
+                                  request_id=request_id)
 
-    def _request_ctx(self, deadline_s: Optional[float]) -> Dict[str, Any]:
+    def _request_ctx(self, deadline_s: Optional[float],
+                     request_id: str = "") -> Dict[str, Any]:
         """Request context that rides the wire to the replica: the
         absolute deadline, the dispatch stamp (the replica measures its
-        queue-wait stage against it), and — when the caller is traced —
-        the wire trace context, so replica/batcher stage spans join the
-        request's trace."""
+        queue-wait stage against it), the flight-recorder correlation
+        id, and — when the caller is traced — the wire trace context,
+        so replica/batcher stage spans join the request's trace."""
         ctx: Dict[str, Any] = {"deadline_s": deadline_s,
                                SUBMITTED_AT_KEY: time.time()}
+        if request_id:
+            ctx[REQUEST_ID_KEY] = request_id
         tctx = tracing.current_context()
         if tctx is not None:
             ctx[TRACE_CTX_KEY] = tctx
@@ -925,8 +1000,8 @@ class Router:
 
     def _submit_stream_raw(self, method_name: str, args: tuple, kwargs: dict,
                            deadline_s: Optional[float], model_id: str,
-                           flatten_chunks: bool,
-                           resume_from: int = 0) -> Tuple[str, Any]:
+                           flatten_chunks: bool, resume_from: int = 0,
+                           request_id: str = "") -> Tuple[str, Any]:
         """Admission + dispatch for one stream attempt; returns
         (rid, core streaming generator). Shared by first submission and
         the generator's re-routes. ``resume_from`` is the mid-stream
@@ -951,7 +1026,8 @@ class Router:
         claim = None
         if disagg:
             handoff, claim, prefill_node = self._prefill_hop(
-                method_name, args, kwargs, deadline_s, model_id)
+                method_name, args, kwargs, deadline_s, model_id,
+                request_id)
             if handoff is None:
                 _serve_counters()["prefill_fallbacks"].inc(
                     labels={"deployment": self.deployment_name,
@@ -959,7 +1035,7 @@ class Router:
         rid, handle = self._acquire(deadline_s, model_id,
                                     role="decode" if want_decode else "",
                                     prefer_node=prefill_node)
-        ctx = self._request_ctx(deadline_s)
+        ctx = self._request_ctx(deadline_s, request_id)
         if model_id:
             ctx["multiplexed_model_id"] = model_id
         if flatten_chunks:
@@ -975,7 +1051,8 @@ class Router:
         return rid, gen
 
     def _prefill_hop(self, method_name: str, args: tuple, kwargs: dict,
-                     deadline_s: Optional[float], model_id: str):
+                     deadline_s: Optional[float], model_id: str,
+                     request_id: str = ""):
         """Hop 1 of a disaggregated stream: a unary call to a
         prefill-role replica whose continuous-batching wrapper answers
         with a leased handoff descriptor. Budgeted and backoff-spaced
@@ -1001,7 +1078,7 @@ class Router:
                     return None, None, None
                 self._ongoing[rid] += 1
                 handle = self._replicas[rid]
-            ctx = self._request_ctx(deadline_s)
+            ctx = self._request_ctx(deadline_s, request_id)
             if model_id:
                 ctx["multiplexed_model_id"] = model_id
             ctx[HANDOFF_KEY] = "export"
@@ -1070,13 +1147,16 @@ class Router:
         already-flowing stream may outlive it."""
         t0 = time.perf_counter()
         deadline_s = self._stamp_deadline(timeout_s)
+        request_id = self.new_request_id()
         rid, gen = self._submit_stream_raw(
             method_name, args, kwargs, deadline_s=deadline_s,
-            model_id=model_id, flatten_chunks=flatten_chunks)
+            model_id=model_id, flatten_chunks=flatten_chunks,
+            request_id=request_id)
         return DeploymentResponseGenerator(
             self, rid, gen, call=(method_name, args, kwargs),
             model_id=model_id, flatten_chunks=flatten_chunks,
-            deadline_s=deadline_s, t0=t0, resumable=resumable)
+            deadline_s=deadline_s, t0=t0, resumable=resumable,
+            request_id=request_id)
 
     def release(self, rid: str):
         """Return one in-flight slot (stream finished or abandoned)."""

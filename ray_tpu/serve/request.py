@@ -127,6 +127,13 @@ SUBMITTED_AT_KEY = "submitted_at"
 #: run. Stamped by ``DeploymentResponseGenerator`` on re-route after a
 #: mid-stream replica failure.
 RESUME_FROM_KEY = "resume_from"
+#: Cluster-wide request correlation id (``rq-<pid>-<n>``), stamped ONCE
+#: by the handle/router when the logical request is born and re-sent
+#: verbatim on every retry, resume, and disaggregated hop — the join
+#: key the flight recorder (``_private/events.py``) and the post-mortem
+#: collector (``tools/rtblackbox``) use to stitch one request's story
+#: across processes, including dead ones.
+REQUEST_ID_KEY = "rt_request_id"
 #: Disaggregated prefill/decode hop marker (ISSUE 14), stamped by the
 #: router's two-hop dispatch: the literal string ``"export"`` on the
 #: prefill hop (the continuous-batching wrapper answers with a leased
@@ -164,6 +171,21 @@ def get_request_handoff() -> Any:
     """The current request's handoff hop marker (see
     :data:`HANDOFF_KEY`); None outside a disaggregated dispatch."""
     return _request_handoff.get()
+
+
+#: Correlation id of the request being handled on this thread, set by
+#: the replica around user code from :data:`REQUEST_ID_KEY` so nested
+#: layers (the continuous-batching wrapper above all) can stamp their
+#: flight-recorder events with the router's id instead of minting a
+#: disconnected local one.
+_request_id: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("rt_serve_request_id", default=None)
+
+
+def get_request_id() -> Optional[str]:
+    """Correlation id of the request being handled on this thread
+    (None outside a request scope or for an unstamped legacy caller)."""
+    return _request_id.get()
 
 
 def stream_item_width(item) -> int:

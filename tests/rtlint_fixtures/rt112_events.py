@@ -1,0 +1,29 @@
+"""RT112 fixture: flight-recorder emission discipline in owner=driver
+hot loops (ISSUE 19). Never imported."""
+from ray_tpu._private import events as _events
+from ray_tpu._private.events import driver_emit as _driver_emit
+
+
+class Driver:
+    """The decode-engine shape: a driver-owned dispatch loop plus
+    control-plane methods that run at human frequency."""
+
+    # rtlint: entry=driver
+    def run(self):
+        while True:
+            self._dispatch()
+
+    # rtlint: owner=driver
+    def _dispatch(self):
+        _events.emit("engine.dispatch", active=1)  # FIRES RT112
+        _driver_emit("engine.dispatch", active=1)
+
+    # rtlint: owner=driver
+    def _preempt(self, slot):
+        # rtlint: disable=RT112 cold path: at most once per restart
+        _events.emit("engine.preempt", slot=slot)
+
+    def submit(self, req):
+        # Control plane, not driver-owned: the plain helper is fine.
+        _events.emit("engine.submit", request=req)
+        return req

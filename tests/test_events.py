@@ -1,0 +1,243 @@
+"""Flight recorder (ISSUE 19): crash-durable event rings, the merge
+that defeats wall-clock skew, and post-mortem request reconstruction.
+
+The SIGKILL test is the tentpole's core claim — a process killed with
+no chance to flush still leaves its last-N events readable on disk —
+so it runs a real subprocess and a real ``SIGKILL``, not a mock."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from ray_tpu._private import events as ev
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    ev._reset_for_tests()
+    yield
+    ev._reset_for_tests()
+
+
+# ---------------------------------------------------------------- recorder
+def test_ring_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        rec = ev.Recorder(ev.ring_path(d, "t"), "t")
+        for i in range(7):
+            assert rec.emit("unit.test", {"i": i, "request": f"rq-{i}"})
+        rec.close()
+        ring = ev.read_ring(rec.path)
+        assert ring["torn"] == 0
+        assert [e["attrs"]["i"] for e in ring["events"]] == list(range(7))
+        assert all(e["kind"] == "unit.test" for e in ring["events"])
+        # monotonic stamps are non-decreasing in seq order
+        monos = [e["mono"] for e in ring["events"]]
+        assert monos == sorted(monos)
+
+
+def test_ring_wrap_keeps_last_n():
+    with tempfile.TemporaryDirectory() as d:
+        rec = ev.Recorder(ev.ring_path(d, "t"), "t", n_slots=8)
+        for i in range(20):
+            rec.emit("unit.wrap", {"i": i})
+        rec.close()
+        ring = ev.read_ring(rec.path)
+        assert [e["attrs"]["i"] for e in ring["events"]] == \
+            list(range(12, 20))
+        assert ring["events"][0]["seq"] == 13  # oldest surviving seq
+
+
+def test_rate_cap_bounds_storm_and_file_size():
+    """A dispatch-per-token storm cannot grow the ring file or evict
+    the whole tail: drops are counted per kind, size stays fixed."""
+    with tempfile.TemporaryDirectory() as d:
+        rec = ev.Recorder(ev.ring_path(d, "t"), "t", rate_per_s=10.0)
+        size0 = os.path.getsize(rec.path)
+        for i in range(5000):
+            rec.emit("engine.dispatch", {"i": i})
+        assert os.path.getsize(rec.path) == size0
+        st = rec.stats()
+        assert st["dropped"]["engine.dispatch"] > 4000
+        assert st["emitted"] + st["dropped_total"] == 5000
+        # a different kind has its own bucket and still gets through
+        assert rec.emit("engine.preempt", {"slot": 0})
+        rec.close()
+
+
+def test_disabled_is_true_noop():
+    """Disabled emit must not touch attrs (no pickling, no file): it
+    returns False before looking at the payload — pinned by handing it
+    a value whose repr/reduce would raise."""
+    class Bomb:
+        def __repr__(self):
+            raise RuntimeError("repr touched")
+
+        def __reduce__(self):
+            raise RuntimeError("pickle touched")
+
+    os.environ.pop(ev.EVENTS_DIR_ENV, None)
+    assert ev.emit("unit.noop", payload=Bomb()) is False
+    assert ev.driver_emit("unit.noop", payload=Bomb()) is False
+    # fast path is latched: resolved, no recorder, no ring file
+    assert ev._resolved and ev.recorder() is None
+    assert ev.stats() == {"enabled": False}
+
+
+def test_init_env_fallback_and_idempotence(monkeypatch):
+    with tempfile.TemporaryDirectory() as d:
+        monkeypatch.setenv(ev.EVENTS_DIR_ENV, d)
+        assert ev.emit("unit.env", i=1)       # lazy init via env
+        rec = ev.recorder()
+        assert rec is not None and ev.init() is rec
+        st = ev.stats()
+        assert st["enabled"] and st["emitted"] == 1
+        files = [f for f in os.listdir(d) if f.endswith(".evr")]
+        assert len(files) == 1
+
+
+def test_unwritable_dir_degrades_to_disabled(monkeypatch):
+    monkeypatch.setenv(ev.EVENTS_DIR_ENV,
+                       "/proc/definitely/not/writable")
+    assert ev.emit("unit.bad", i=1) is False
+    assert ev.stats() == {"enabled": False}
+
+
+def test_oversized_attrs_truncated_but_correlated():
+    """An attrs blob too big for a slot keeps its correlation ids —
+    the record degrades, the request's timeline does not lose a hop."""
+    with tempfile.TemporaryDirectory() as d:
+        rec = ev.Recorder(ev.ring_path(d, "t"), "t")
+        rec.emit("unit.big", {"request": "rq-9", "blob": "x" * 10000})
+        rec.close()
+        assert rec.truncated == 1
+        ring = ev.read_ring(rec.path)
+        (e,) = ring["events"]
+        assert e["attrs"]["request"] == "rq-9"
+        assert e["attrs"]["truncated"] is True
+        assert "blob" not in e["attrs"]
+
+
+# ------------------------------------------------------------- crash claim
+_KILLED_WRITER = r"""
+import os, signal, sys
+from ray_tpu._private import events as ev
+rec = ev.init(sys.argv[1], proc="victim")
+for i in range(200):
+    rec.emit("crash.step", {"i": i, "request": "rq-dead"})
+os.kill(os.getpid(), signal.SIGKILL)   # no flush, no atexit, nothing
+"""
+
+
+def test_sigkill_preserves_ring():
+    """The crash-durability claim: SIGKILL mid-run (the writer never
+    flushes or closes) still leaves every committed event readable; a
+    torn FINAL record is tolerated and counted, never fatal."""
+    with tempfile.TemporaryDirectory() as d:
+        p = subprocess.run(
+            [sys.executable, "-c", _KILLED_WRITER, d],
+            cwd=REPO, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert p.returncode == -signal.SIGKILL
+        files = [os.path.join(d, f) for f in os.listdir(d)
+                 if f.endswith(".evr")]
+        assert len(files) == 1
+        ring = ev.read_ring(files[0])
+        assert ring["proc"] == "victim"
+        got = [e["attrs"]["i"] for e in ring["events"]
+               if e["kind"] == "crash.step"]
+        # Complete prefix: the commit protocol (seq stamped LAST) means
+        # every readable record is whole, and at most the final in-
+        # flight one is torn.
+        assert got == list(range(len(got))) and len(got) >= 199
+        assert ring["torn"] <= 1
+
+
+# ----------------------------------------------------------------- merging
+def test_merge_orders_by_monotonic_despite_wall_skew():
+    """Two processes, one with its wall clock an hour in the past: the
+    merged order must follow the monotonic anchors, and the unified
+    stamps must keep the true spacing."""
+    from tools.rtblackbox import merge_timeline
+
+    with tempfile.TemporaryDirectory() as d:
+        a = ev.Recorder(ev.ring_path(d, "a"), "a")
+        b = ev.Recorder(ev.ring_path(d, "b"), "b", wall_skew_s=-3600.0)
+        a.emit("m.first", {})
+        time.sleep(0.02)
+        b.emit("m.second", {})
+        time.sleep(0.02)
+        a.emit("m.third", {})
+        a.close(), b.close()
+        rings = [ev.read_ring(a.path), ev.read_ring(b.path)]
+        # the skew is real: b's raw wall stamps sit an hour early
+        wall_b = rings[1]["events"][0]["wall"]
+        wall_a = rings[0]["events"][0]["wall"]
+        assert wall_b < wall_a - 3000
+        tl = merge_timeline(rings)
+        assert [e["kind"] for e in tl["events"]] == \
+            ["m.first", "m.second", "m.third"]
+        ts = [e["t"] for e in tl["events"]]
+        assert ts == sorted(ts) and ts[-1] - ts[0] < 5.0
+
+
+def test_request_reconstruction_and_cli():
+    """A synthetic kill-and-resume story across three rings (router,
+    dead replica, successor): reconstruction stitches the request's own
+    events with the kill/drain context that explains its fate, and the
+    CLI renders it."""
+    from tools.rtblackbox import (load_rings, merge_timeline,
+                                  reconstruct_request)
+    from tools.rtblackbox.__main__ import main as bb_main
+
+    with tempfile.TemporaryDirectory() as d:
+        rt = ev.Recorder(ev.ring_path(d, "router"), "router")
+        r0 = ev.Recorder(ev.ring_path(d, "rep0"), "rep0")
+        r1 = ev.Recorder(ev.ring_path(d, "rep1"), "rep1")
+        rid = "rq-dead-1"
+        r0.emit("replica.admit", {"request": rid, "replica": "D#0"})
+        r0.emit("engine.admit", {"request": rid, "slot": 0, "epoch": 0})
+        r0.emit("chaos.kill", {"replica": "D#0", "target": "replica"})
+        rt.emit("router.resume", {"request": rid, "from_replica": "D#0",
+                                  "to_replica": "D#1", "delivered": 3})
+        r1.emit("replica.admit", {"request": rid, "replica": "D#1"})
+        r1.emit("engine.resume", {"request": rid, "resume_from": 3,
+                                  "epoch": 0})
+        rt.emit("client.verdict", {"request": rid, "ok": True,
+                                   "identical": True})
+        for r in (rt, r0, r1):
+            r.close()
+        tl = merge_timeline(load_rings(d)["rings"])
+        story = reconstruct_request(tl, rid)
+        kinds = [e["kind"] for e in story["events"]]
+        assert kinds == ["replica.admit", "engine.admit", "chaos.kill",
+                        "router.resume", "replica.admit",
+                        "engine.resume", "client.verdict"]
+        assert story["replicas"] == ["D#0", "D#1"]
+        ctx = [e for e in story["events"] if e["relevance"] == "context"]
+        assert [e["kind"] for e in ctx] == ["chaos.kill"]
+        assert bb_main([d, "--request", rid, "--json"]) == 0
+        assert bb_main([d]) == 0
+
+
+# -------------------------------------------------------------- metrics tie
+def test_dropped_events_feed_the_counter(monkeypatch):
+    from ray_tpu._private.metrics import serve_metrics
+
+    with tempfile.TemporaryDirectory() as d:
+        monkeypatch.setenv(ev.EVENTS_DIR_ENV, d)
+        ev.init(rate_per_s=5.0)
+        c = serve_metrics()["events_dropped"]
+        key = (("kind", "unit.storm"),)
+        before = dict(c.collect()).get(key, 0.0)
+        for i in range(200):
+            ev.emit("unit.storm", i=i)
+        dropped = ev.stats()["dropped"].get("unit.storm", 0)
+        assert dropped > 0
+        assert dict(c.collect()).get(key, 0.0) - before == dropped

@@ -651,3 +651,16 @@ def test_cluster_smoke_benchmark():
     assert row["orphans"] == 0
     assert row["kills"] >= 1
     assert row["converged"] is True
+    # Flight-recorder acceptance (ISSUE 19): --smoke implies
+    # --blackbox, and the killed-replica request's reconstruction —
+    # merged from the DEAD process's ring — must contain the kill, the
+    # resume, and the token-identity verdict with one correlation id.
+    bb = [r for r in rows if r["metric"] == "serve_cluster_blackbox"]
+    assert bb, rows
+    story = bb[0]
+    assert story["request"], story
+    kinds = set(story["story_kinds"])
+    assert "chaos.kill" in kinds, story
+    assert "router.resume" in kinds or "engine.resume" in kinds, story
+    assert "client.verdict" in kinds, story
+    assert story["torn"] == 0 or story["torn"] <= story["rings"], story

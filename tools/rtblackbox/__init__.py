@@ -1,0 +1,224 @@
+"""rtblackbox — post-mortem reconstruction from flight-recorder rings.
+
+Every ray_tpu process with ``RT_EVENTS_DIR`` set appends structured
+events to a preallocated mmap'd ring file (``ray_tpu._private.events``).
+The ring survives SIGKILL: the last-N events of a dead replica are
+still on disk. This package merges a directory of such rings — live
+and dead processes alike — into ONE cluster timeline, and can
+reconstruct a single request's cross-process story (admission →
+dispatches → kill → router resume → completion) from it.
+
+Clock model
+-----------
+Wall clocks lie (NTP steps, deliberate skew); ``CLOCK_MONOTONIC`` does
+not, but is only comparable between processes of the SAME boot. Each
+ring header carries a (wall, monotonic) anchor pair sampled at open
+plus the host's ``boot_id``. The merge therefore:
+
+1. groups rings by ``boot_id``;
+2. within a group, orders events by their RAW monotonic stamps — a
+   process with a skewed wall clock cannot reorder the timeline;
+3. maps monotonic to a unified wall axis through ONE reference offset
+   per group (the median of the rings' ``wall_anchor - mono_anchor``,
+   robust to a minority of skewed processes);
+4. across groups (different hosts), the per-event unified stamps are
+   already wall-comparable and events merge by them.
+
+Use ``python -m tools.rtblackbox <dir>`` for the CLI.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.events import read_ring
+
+# Event kinds that explain a request's fate without carrying its id:
+# the kill that took the replica down, the controller noticing, the
+# drain, the engine epoch bump. They join a reconstruction when they
+# name a replica (or deployment) the request's own events touched.
+CONTEXT_KINDS = (
+    "chaos.kill",
+    "controller.replica_dead",
+    "controller.drain",
+    "replica.drain",
+    "engine.driver_restart",
+)
+
+
+# --------------------------------------------------------------- loading
+def load_rings(directory: str) -> Dict[str, Any]:
+    """Read every ``*.evr`` ring under ``directory`` (non-recursive).
+    Unreadable files are collected, not fatal — a half-written header
+    from a process killed at open must not sink the post-mortem."""
+    rings: List[Dict[str, Any]] = []
+    errors: List[Dict[str, str]] = []
+    for path in sorted(glob.glob(os.path.join(directory, "*.evr"))):
+        try:
+            rings.append(read_ring(path))
+        except Exception as e:  # noqa: BLE001 - skip, report, continue
+            errors.append({"path": path, "error": f"{type(e).__name__}: {e}"})
+    return {"rings": rings, "errors": errors}
+
+
+# --------------------------------------------------------------- merging
+def merge_timeline(rings: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge ring dicts (from :func:`load_rings` / ``read_ring``) into
+    one ordered timeline. Each merged event gains:
+
+    - ``t``     unified wall stamp (monotonic mapped through the boot
+                group's reference offset — see module docstring);
+    - ``proc``  the emitting process label, ``pid`` its pid.
+
+    Ordering within a boot group follows raw monotonic stamps, so a
+    process whose wall clock is hours off still lands where it really
+    ran."""
+    by_boot: Dict[str, List[Dict[str, Any]]] = {}
+    for r in rings:
+        by_boot.setdefault(r.get("boot_id") or "?", []).append(r)
+    events: List[Dict[str, Any]] = []
+    offsets: Dict[str, float] = {}
+    for boot, group in by_boot.items():
+        offs = sorted(r["wall_anchor"] - r["mono_anchor"] for r in group)
+        ref = offs[len(offs) // 2]  # median: robust to skewed minority
+        offsets[boot] = ref
+        for r in group:
+            label = f'{r.get("proc") or "proc"}-{r.get("pid", 0)}'
+            for e in r["events"]:
+                events.append({
+                    "t": e["mono"] + ref, "mono": e["mono"],
+                    "wall": e["wall"], "seq": e["seq"],
+                    "kind": e["kind"], "attrs": e.get("attrs") or {},
+                    "proc": label, "pid": r.get("pid", 0),
+                    "boot_id": boot,
+                })
+    # Same-boot events share one offset, so sorting by t IS sorting by
+    # monotonic there; cross-boot interleaving falls back to the
+    # unified wall axis (the best any merger can do across hosts).
+    events.sort(key=lambda e: (e["t"], e["proc"], e["seq"]))
+    return {
+        "events": events,
+        "torn": sum(r.get("torn", 0) for r in rings),
+        "procs": sorted({e["proc"] for e in events}),
+        "offsets": offsets,
+    }
+
+
+# --------------------------------------------------- request reconstruction
+def _replica_refs(attrs: Dict[str, Any]) -> set:
+    refs = set()
+    for key in ("replica", "from_replica", "to_replica"):
+        v = attrs.get(key)
+        if v:
+            refs.add(str(v))
+    for v in attrs.get("replicas") or []:
+        if v:
+            refs.add(str(v))
+    return refs
+
+
+def reconstruct_request(timeline: Dict[str, Any], request_id: str,
+                        spans: Optional[List[dict]] = None
+                        ) -> Dict[str, Any]:
+    """One request's cross-process story. Core events carry the
+    request's correlation id in ``attrs["request"]``; context events
+    (:data:`CONTEXT_KINDS`) join when they name a replica the request
+    touched — that is how the SIGKILL that murdered the serving
+    replica lands inside the request's own narrative even though the
+    killer never knew the request id.
+
+    ``spans`` (optional, the ``util.tracing`` span dicts) are stitched
+    in by correlation: any span whose attrs mention the request id
+    pulls in its whole trace tree."""
+    core = [e for e in timeline["events"]
+            if str(e["attrs"].get("request", "")) == request_id]
+    replicas: set = set()
+    deployments: set = set()
+    for e in core:
+        replicas |= _replica_refs(e["attrs"])
+        dep = e["attrs"].get("deployment")
+        if dep:
+            deployments.add(str(dep))
+    context = []
+    for e in timeline["events"]:
+        if e["kind"] not in CONTEXT_KINDS:
+            continue
+        refs = _replica_refs(e["attrs"])
+        if (refs & replicas) or (not refs and str(
+                e["attrs"].get("deployment", "")) in deployments):
+            context.append(e)
+    seen = {id(e) for e in core}
+    story = core + [e for e in context if id(e) not in seen]
+    story.sort(key=lambda e: (e["t"], e["proc"], e["seq"]))
+    out: Dict[str, Any] = {
+        "request": request_id,
+        "events": [{**e, "relevance":
+                    "request" if str(e["attrs"].get("request", ""))
+                    == request_id else "context"} for e in story],
+        "replicas": sorted(replicas),
+        "deployments": sorted(deployments),
+        "kinds": sorted({e["kind"] for e in story}),
+    }
+    if story:
+        out["first_t"] = story[0]["t"]
+        out["last_t"] = story[-1]["t"]
+        out["duration_s"] = round(story[-1]["t"] - story[0]["t"], 6)
+    if spans:
+        hit_traces = set()
+        for sp in spans:
+            attrs = sp.get("attrs") or {}
+            if any(str(v) == request_id for v in attrs.values()):
+                hit_traces.add(sp.get("trace_id"))
+        tree = [sp for sp in spans if sp.get("trace_id") in hit_traces]
+        tree.sort(key=lambda sp: sp.get("start", 0.0))
+        out["spans"] = tree
+    return out
+
+
+# ---------------------------------------------------------- chrome trace
+def chrome_trace(timeline: Dict[str, Any]) -> List[dict]:
+    """The merged timeline as Chrome trace-event JSON (load in
+    ``chrome://tracing`` / Perfetto). Events are instants on the
+    unified axis; one row per process."""
+    out: List[dict] = []
+    named = set()
+    for e in timeline["events"]:
+        if e["proc"] not in named:
+            named.add(e["proc"])
+            out.append({"name": "process_name", "ph": "M",
+                        "pid": e["pid"], "tid": 0,
+                        "args": {"name": e["proc"]}})
+        out.append({
+            "name": e["kind"], "ph": "i", "s": "p",
+            "ts": e["t"] * 1e6, "pid": e["pid"], "tid": 0,
+            "cat": e["kind"].split(".", 1)[0],
+            "args": dict(e["attrs"]),
+        })
+    return out
+
+
+# -------------------------------------------------------------- rendering
+def format_event(e: Dict[str, Any], t0: float = 0.0) -> str:
+    attrs = " ".join(f"{k}={v}" for k, v in sorted(e["attrs"].items()))
+    mark = "*" if e.get("relevance") == "context" else " "
+    return (f"{e['t'] - t0:+12.6f}s {mark} {e['proc']:<28s} "
+            f"{e['kind']:<24s} {attrs}")
+
+
+def format_timeline(events: List[Dict[str, Any]]) -> str:
+    if not events:
+        return "(no events)"
+    t0 = events[0]["t"]
+    return "\n".join(format_event(e, t0) for e in events)
+
+
+def load_spans(path: str) -> List[dict]:
+    """Span dicts from a JSON file (a ``tracing.get_spans()`` dump, or
+    the ``{"spans": [...]}`` wrapper ``with_meta=True`` produces)."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("spans", [])
+    return list(data)

@@ -1,0 +1,106 @@
+"""CLI: ``python -m tools.rtblackbox <events-dir>``.
+
+Merges every flight-recorder ring file under the directory (including
+rings left behind by SIGKILLed processes) into one cluster timeline.
+
+  python -m tools.rtblackbox /tmp/rt-events
+      full merged timeline, human-readable
+
+  python -m tools.rtblackbox /tmp/rt-events --request rq-3f21-7
+      one request's cross-process story: its own events plus the
+      context (kill / drain / epoch bump) that explains its fate
+
+  python -m tools.rtblackbox /tmp/rt-events --trace out.json
+      Chrome trace-event export (chrome://tracing, Perfetto)
+
+  python -m tools.rtblackbox /tmp/rt-events --spans spans.json ...
+      stitch a tracing.get_spans() dump into request reconstructions
+
+Exit code 0 on success, 1 when the directory holds no readable rings,
+2 on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (chrome_trace, format_timeline, load_rings, load_spans,
+               merge_timeline, reconstruct_request)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rtblackbox",
+        description="merge flight-recorder rings; reconstruct requests")
+    ap.add_argument("directory", help="directory holding *.evr ring files")
+    ap.add_argument("--request", default=None, metavar="ID",
+                    help="reconstruct one request id instead of the "
+                         "full timeline")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="write Chrome trace-event JSON ('-' = stdout)")
+    ap.add_argument("--spans", default=None, metavar="SPANS.json",
+                    help="span dump to stitch into --request output")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output on stdout")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="print at most N timeline events (0 = all)")
+    args = ap.parse_args(argv)
+
+    loaded = load_rings(args.directory)
+    for err in loaded["errors"]:
+        print(f"warning: {err['path']}: {err['error']}", file=sys.stderr)
+    if not loaded["rings"]:
+        print(f"no readable ring files under {args.directory}",
+              file=sys.stderr)
+        return 1
+    timeline = merge_timeline(loaded["rings"])
+
+    if args.trace:
+        trace = chrome_trace(timeline)
+        if args.trace == "-":
+            json.dump(trace, sys.stdout)
+            sys.stdout.write("\n")
+        else:
+            with open(args.trace, "w", encoding="utf-8") as f:
+                json.dump(trace, f)
+            print(f"wrote {len(trace)} trace events to {args.trace}",
+                  file=sys.stderr)
+
+    if args.request is not None:
+        spans = load_spans(args.spans) if args.spans else None
+        story = reconstruct_request(timeline, args.request, spans=spans)
+        if args.json:
+            json.dump(story, sys.stdout, default=str)
+            sys.stdout.write("\n")
+        else:
+            print(f"request {story['request']}: "
+                  f"{len(story['events'])} events across "
+                  f"{len({e['proc'] for e in story['events']})} "
+                  f"process(es); replicas={story['replicas']}")
+            print(format_timeline(story["events"]))
+            if story.get("spans"):
+                print(f"-- {len(story['spans'])} stitched span(s):")
+                for sp in story["spans"]:
+                    print(f"  {sp.get('name')} "
+                          f"[{sp.get('kind')}] "
+                          f"{sp.get('end', 0) - sp.get('start', 0):.6f}s "
+                          f"status={sp.get('status')}")
+        return 0
+
+    events = timeline["events"]
+    shown = events[-args.limit:] if args.limit else events
+    if args.json:
+        json.dump({"events": shown, "torn": timeline["torn"],
+                   "procs": timeline["procs"]}, sys.stdout, default=str)
+        sys.stdout.write("\n")
+    else:
+        print(f"{len(events)} events from {len(timeline['procs'])} "
+              f"process(es), {timeline['torn']} torn record(s) "
+              f"tolerated")
+        print(format_timeline(shown))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,9 @@ RT110     holds=/owner=driver contracts checked at every resolved call
 RT111     host-device sync points on dispatch results in the driver
           files must carry ``# rtlint: sync-ok=<tag> <why>`` — the
           dispatch loop's sync inventory is explicit and gated
+RT112     flight-recorder emission inside owner=driver hot loops must
+          use the rate-capped ``driver_emit`` helper — a plain
+          ``events.emit`` at dispatch frequency floods the ring
 ========  ============================================================
 
 The lint → sanitize pipeline: one annotation grammar

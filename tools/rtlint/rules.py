@@ -743,6 +743,58 @@ class AnnotationDriftRule(Rule):
         return out
 
 
+# ------------------------------------------------------------------ RT112
+class DriverEmitRule(Rule):
+    """RT112: flight-recorder emission inside ``owner=driver`` hot
+    loops must go through the rate-capped driver helper.
+
+    The driver loop dispatches per token; a plain ``events.emit`` there
+    is a ring-storm hazard — one busy stream floods the ring and the
+    post-mortem loses the interesting tail. The events module ships a
+    dedicated helper, ``driver_emit`` (``ray_tpu._private.events``),
+    with a tighter per-kind rate cap sized for dispatch-frequency call
+    sites; driver-annotated methods must use it.
+
+    Lexically: any call whose terminal name is ``emit`` (``emit(...)``,
+    ``_events.emit(...)``, ``events.emit(...)``) inside a function
+    annotated ``# rtlint: owner=driver`` is flagged; ``driver_emit``
+    (under any import alias ending in ``driver_emit``) is the
+    compliant spelling. Code outside driver-owned functions emits at
+    control-plane frequency and keeps the plain helper."""
+
+    id = "RT112"
+    summary = "plain events.emit inside an owner=driver hot loop"
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        yield from self._walk(mod, mod.tree, scope="<module>",
+                              owned=False)
+
+    def _walk(self, mod, node, scope, owned):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                d = mod.func_directives(child)
+                yield from self._walk(
+                    mod, child, f"{scope}.{child.name}"
+                    if scope != "<module>" else child.name,
+                    d.get("owner") == "driver")
+                continue
+            if isinstance(child, ast.ClassDef):
+                yield from self._walk(mod, child, child.name, False)
+                continue
+            if isinstance(child, ast.Call) and owned \
+                    and _terminal_name(child.func) == "emit":
+                yield Finding(
+                    mod.relpath, child.lineno, self.id,
+                    f"plain events.emit in {scope}, which is annotated "
+                    f"'# rtlint: owner=driver' — the driver loop runs "
+                    f"per dispatch, so emission there must use the "
+                    f"rate-capped driver_emit helper "
+                    f"(ray_tpu._private.events) or a storm floods the "
+                    f"ring and the crash tail is lost",
+                    f"{scope}.emit")
+            yield from self._walk(mod, child, scope, owned)
+
+
 # ----------------------------------------------------------------- shared
 def _nodes_with_scope(tree, node_type):
     """Yield (node, qualified_scope) for every ``node_type`` in the
@@ -771,6 +823,6 @@ ALL_RULES: Tuple[Rule, ...] = (
     LockGuardRule(), DriverOwnershipRule(), RecompileHazardRule(),
     AsyncBlockingRule(), RetryableWireRule(), MetricNameRule(),
     SwallowedExceptRule(), AnnotationDriftRule(), ProgramBudgetRule(),
-    InterprocContractRule(), SyncPointRule())
+    InterprocContractRule(), SyncPointRule(), DriverEmitRule())
 
 RULE_TABLE = {r.id: r for r in ALL_RULES}
