@@ -83,6 +83,16 @@ accounting, and asserts ZERO broken streams and NO handoff leaks
 (pages free back to baseline, no outstanding leases). ``--smoke``
 shrinks it for tier-1 CI.
 
+``--tp N`` (ISSUE 20) switches to the tensor-parallel A/B: the SAME
+saturating burst is driven through a single-chip engine and one whose
+weights and paged KV are sharded over an N-wide ``tp`` mesh, at equal
+offered load. Asserts the exactness contract live — temp-0 token
+identity stream for stream, and dispatch accounting equal chunk for
+chunk (the mesh moves FLOPs, never driver-loop boundaries) — and
+reports TPOT p50 and tok/s per arm. On CPU the mesh is forced host
+devices (plumbing + exactness, not speed); the ratio is the headline
+only on a real multi-chip host. ``--smoke`` shrinks it for tier-1 CI.
+
 ``--chaos`` (ISSUE 7) switches to the crash-safety acceptance run: a
 2-replica continuous-engine deployment serves seeded (deterministic)
 streams under load while a replica is KILLED mid-stream; every client
@@ -175,6 +185,15 @@ def main():
                              "kernel-off TPOT A/B arm (CPU runs the "
                              "kernel in interpret mode — correctness "
                              "plumbing, not speed) (ISSUE 16)")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel A/B (ISSUE 20): the same "
+                             "saturating burst through a tp=1 engine "
+                             "and one sharded over a --tp-wide mesh at "
+                             "equal offered load; asserts temp-0 token "
+                             "identity and equal dispatch accounting, "
+                             "reports TPOT p50 and tok/s per arm (on "
+                             "CPU the mesh is forced host devices — "
+                             "plumbing and exactness, not speed)")
     parser.add_argument("--smoke", action="store_true",
                         help="with --continuous/--paged: shrunk load "
                              "for tier-1 CI (fewer requests, shorter "
@@ -190,6 +209,25 @@ def main():
     chunks = [int(c) for c in args.chunk.split(",") if c.strip()]
 
     import numpy as np
+
+    if args.tp > 1:
+        # Direct engine drive: the A/B isolates the sharded compute
+        # graph (column/row-parallel weights, head-sharded KV) from the
+        # serve transport. On a host platform the mesh needs forced
+        # devices — set the flag BEFORE jax initializes.
+        if "jax" not in sys.modules and \
+                "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{max(8, args.tp)}").strip()
+        import jax as _jax
+
+        cfg_name = args.config or (
+            "small" if _jax.devices()[0].platform == "tpu" else "nano")
+        run_tp_ab(args, np, cfg_name, f"gpt_{cfg_name}")
+        return
 
     if args.paged:
         # Direct engine drive: the A/B isolates the pool architecture
@@ -1352,6 +1390,89 @@ def _run_attn_kernel_arm(args, np, cfg, params, model):
         "token_identical_temp0": identical,
         "kernel_dispatches": rows["pallas"]["kernel_dispatches"],
         "interpret_mode": _jax.default_backend() != "tpu",
+        "smoke": bool(args.smoke),
+    }))
+
+
+def run_tp_ab(args, np, cfg_name, model):
+    """ISSUE 20 acceptance A/B: the SAME saturating burst through a
+    single-chip engine and one whose weights + paged KV are sharded
+    over a ``tp``-wide mesh, at equal offered load. The exactness
+    contract is checked live: at temperature 0 the sharded arm must
+    emit IDENTICAL token streams (psum'd row-parallel partials, not
+    approximately-equal ones), and its dispatch accounting must match
+    chunk for chunk — the mesh changes where the FLOPs run, never how
+    many driver-loop boundaries the stream crosses. On CPU the mesh is
+    forced host devices, so the rows prove plumbing and exactness; the
+    TPOT/tok-s ratio is the headline only on a real multi-chip host."""
+    import jax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import DecodeEngine
+
+    cfg = gpt.CONFIGS[cfg_name]
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    ps = args.page_size
+    plen = 2 * ps                             # two pages of history
+    max_new = 8 if args.smoke else 24
+    max_len = plen + max_new + ps
+    slots = 2 if args.smoke else 4
+    n_req = 2 * slots                         # lanes reuse slots
+    rng = np.random.default_rng(20)
+    prompts = [rng.integers(0, cfg.vocab_size, (plen,)).astype(np.int32)
+               for _ in range(n_req)]
+
+    rows = {}
+    token_streams = {}
+    accounting = {}
+    for tp in (1, args.tp):
+        eng = DecodeEngine(
+            params, cfg, slots=slots, chunk=4, max_len=max_len,
+            prompt_buckets=(plen,), paged=True, page_size=ps,
+            prefix_cache=False, tp=tp, deployment=f"tp{tp}_bench")
+        try:
+            list(eng.stream(prompts[0], max_new, seed=0))   # warm
+            ttfts, comps, wall, streams = _drive_burst(
+                eng, prompts, max_new, np=np)
+            token_streams[tp] = streams
+            tpots = [(comps[i] - ttfts[i]) / max(max_new - 1, 1)
+                     for i in range(n_req)]
+            st = eng.stats()
+            accounting[tp] = (st["prefills"], st["dispatches"])
+            rows[tp] = {
+                "metric": f"serve_{model}_tp{tp}_mode",
+                "value": round(pct(tpots, 0.5) * 1000, 3),
+                "unit": "tpot_p50_ms",
+                "ttft_p50_ms": round(pct(ttfts, 0.5) * 1000, 2),
+                "tok_s": round(n_req * max_new / wall, 1),
+                "dispatches": st["dispatches"],
+                "prefills": st["prefills"],
+                "mesh": [["tp", tp]] if tp > 1 else [],
+                "requests": n_req, "max_new": max_new,
+                "prompt_len": plen,
+            }
+            print(json.dumps(rows[tp]))
+        finally:
+            eng.shutdown()
+    identical = all(
+        np.array_equal(token_streams[1][i], token_streams[args.tp][i])
+        for i in range(n_req))
+    assert identical, \
+        f"tp={args.tp} arm diverged from tp=1 at temp 0"
+    assert accounting[1] == accounting[args.tp], (
+        f"dispatch accounting diverged: tp=1 {accounting[1]} vs "
+        f"tp={args.tp} {accounting[args.tp]} (prefills, dispatches)")
+    print(json.dumps({
+        "metric": f"serve_{model}_tp_ab",
+        "value": round(rows[1]["value"]
+                       / max(rows[args.tp]["value"], 1e-9), 2),
+        "unit": "x_tpot_tp1_vs_sharded",
+        "tp": args.tp,
+        "token_identical_temp0": identical,
+        "dispatches_equal": accounting[1] == accounting[args.tp],
+        "tok_s_tp1": rows[1]["tok_s"],
+        "tok_s_sharded": rows[args.tp]["tok_s"],
+        "host_mesh": jax.default_backend() != "tpu",
         "smoke": bool(args.smoke),
     }))
 

@@ -127,6 +127,33 @@ traced: the whole handoff plane adds exactly TWO compiled programs per
 engine (one export, one import) on top of the usual set, for any
 prompt length and any flat/paged pairing.
 
+Tensor-parallel decode (ISSUE 20): every slot-pool primitive above has
+a mesh-aware twin path selected by the factories' trailing ``tp``
+static. ``tp > 1`` shards the program over the 1-D ``("tp",)`` mesh
+built by :func:`ray_tpu._private.jax_compat.decode_mesh`: qkv and the
+ffn up-projection are column-parallel (each device owns ``H/tp`` whole
+heads and ``d_ff/tp`` ffn lanes — contractions run over the full
+``d_model``, so per-shard math is bitwise the tp=1 math), the output
+projections ``wo``/``w2`` are row-parallel with the f32 partial sums
+``lax.psum``-reduced BEFORE the compute-dtype cast (:func:`_mm_row` —
+the only tp-introduced arithmetic difference is f32 summation order,
+far below the compute dtype's resolution, the same argument as the
+pallas kernel above), and the pooled KV cache (flat AND paged, fp AND
+int8) is sharded over the HEAD axis so attention stays embarrassingly
+head-parallel. Sampling runs replicated on the psum'd logits with the
+same PRNG lanes on every device, so every device commits the same
+token. The factories wrap the SAME inner functions in ``shard_map``
+(through the jax_compat shim) inside ``jax.jit`` with the same
+donation — tp=1 callers get byte-identical wrappers to before, and the
+compiled-program budget is counted per (bucket, tp) key by the same
+lru_cache discipline. The handoff plane is the resharding boundary:
+exports emit head-sharded device arrays whose host gather
+(``np.asarray``) is the canonical layout regardless of tp, and imports
+scatter host-canonical buffers into the target's own mesh — so N-way
+prefill hands off to M-way decode with the digest computed over
+layout-independent bytes. MoE (``n_experts > 0``) is rejected under
+tp>1: :func:`ray_tpu.models.moe.moe_ffn` is not tp-aware.
+
 Paged-attention kernel + int8 KV (ISSUE 16): two orthogonal,
 engine-static knobs on the paged hot path. ``attn_kernel="pallas"``
 swaps the decode step's gather-then-mask attention for
@@ -152,6 +179,7 @@ program each existing factory builds.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Dict, Iterator, Tuple
 
 import jax
@@ -159,9 +187,106 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .._private.jax_compat import decode_mesh, shard_map
 from .gpt import (GPTConfig, Params, _mm, _project_vocab, _rmsnorm)
 
 Cache = Dict[str, jax.Array]
+
+# ------------------------------------------------------- tensor parallel
+#: Block kernels sharded on their OUTPUT dim (column-parallel): each
+#: device owns whole heads (wq/wk/wv) or an ffn slice (w1), so the
+#: contraction runs over the full d_model and per-shard results are
+#: bitwise the tp=1 results.
+_TP_COL = frozenset({"wq", "wk", "wv", "w1"})
+#: Block kernels sharded on their INPUT dim (row-parallel): wo/w2
+#: consume the head-/ffn-sharded activations and psum f32 partials.
+_TP_ROW = frozenset({"wo", "w2"})
+
+
+def _mm_row(x, w, dtype, tp_axis=None):
+    """Row-parallel :func:`ray_tpu.models.gpt._mm`: under shard_map the
+    local contraction covers only this device's slice of the input dim,
+    so the f32 partial sums are ``lax.psum``-reduced across ``tp_axis``
+    BEFORE the compute-dtype cast — the cast point matches tp=1's
+    ``_mm`` exactly, so the only difference is f32 summation order.
+    With ``tp_axis=None`` this IS ``_mm``, bit for bit."""
+    if tp_axis is None:
+        return _mm(x, w, dtype)
+    out = lax.dot_general(x.astype(dtype), w.astype(dtype),
+                          (((x.ndim - 1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    return lax.psum(out, tp_axis).astype(dtype)
+
+
+def _tp_mesh(cfg: GPTConfig, tp: int):
+    """Validate a (cfg, tp) pairing and return its decode mesh — or
+    None for tp=1, the signal to every factory that the stock
+    single-device path (byte-identical to pre-tp builds) applies."""
+    tp = int(tp)
+    if tp <= 1:
+        return None
+    if cfg.n_experts > 0:
+        raise ValueError(
+            f"tensor-parallel decode (tp={tp}) does not support MoE "
+            f"configs (n_experts={cfg.n_experts}): moe_ffn is not "
+            f"tp-aware")
+    if cfg.n_head % tp or cfg.d_ff % tp or cfg.d_model % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_head={cfg.n_head}, "
+            f"d_ff={cfg.d_ff} and d_model={cfg.d_model}")
+    return decode_mesh(tp)
+
+
+def _tp_param_specs(params):
+    """PartitionSpec pytree for the decode params under a ``("tp",)``
+    mesh: column-parallel kernels shard their last axis, row-parallel
+    kernels their axis 1 (axis 0 is the stacked layer axis), everything
+    else (embed, pos_embed, norm scales) replicates."""
+    P = jax.sharding.PartitionSpec
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", None))
+                 for p in path]
+        nd = jnp.ndim(leaf)
+        if any(n in _TP_COL for n in names):
+            return P(*([None] * (nd - 1) + ["tp"]))
+        if any(n in _TP_ROW for n in names):
+            return P(*(["tp"] if nd < 2 else [None, "tp"]
+                       + [None] * (nd - 2)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def _tp_cache_specs(cache):
+    """PartitionSpec dict for a pool cache (flat or paged, fp or int8)
+    under a ``("tp",)`` mesh: K/V pages shard their HEAD axis (axis 3
+    in both layouts), int8 per-page scales their head axis (last), and
+    ``pos`` replicates."""
+    P = jax.sharding.PartitionSpec
+    out = {}
+    for name in cache:
+        if name in ("k", "v"):
+            out[name] = P(None, None, None, "tp", None)
+        elif name in ("ks", "vs"):
+            out[name] = P(None, None, "tp")
+        else:
+            out[name] = P()
+    return out
+
+
+def shard_params(params: Params, cfg: GPTConfig, tp: int) -> Params:
+    """Device-put the decode params into their tp layout
+    (:func:`_tp_param_specs` under :func:`decode_mesh`) so every
+    sharded program consumes pre-placed weights instead of re-slicing
+    host copies per dispatch. tp=1 returns ``params`` untouched."""
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return params
+    specs = _tp_param_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(
+            x, jax.sharding.NamedSharding(mesh, s)), params, specs)
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Cache:
@@ -174,17 +299,22 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Cache:
 
 
 def _block_kv(x, p, cfg: GPTConfig):
-    """Training block minus attention: returns (q, k, v, pre-attn x)."""
+    """Training block minus attention: returns (q, k, v, pre-attn x).
+    The head-count reshape is ``-1`` so that under shard_map (where the
+    local qkv kernels project to ``H/tp`` heads) the same code yields
+    the local head slice."""
     B, S, _ = x.shape
-    H, hd = cfg.n_head, cfg.head_dim
     h = _rmsnorm(x, p["ln1_scale"])
-    q = _mm(h, p["wq"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
-    k = _mm(h, p["wk"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
-    v = _mm(h, p["wv"]["kernel"], cfg.dtype).reshape(B, S, H, hd)
+    q = _mm(h, p["wq"]["kernel"], cfg.dtype).reshape(B, S, -1,
+                                                     cfg.head_dim)
+    k = _mm(h, p["wk"]["kernel"], cfg.dtype).reshape(B, S, -1,
+                                                     cfg.head_dim)
+    v = _mm(h, p["wv"]["kernel"], cfg.dtype).reshape(B, S, -1,
+                                                     cfg.head_dim)
     return q, k, v
 
 
-def _ffn(x, p, cfg: GPTConfig):
+def _ffn(x, p, cfg: GPTConfig, tp_axis=None):
     h = _rmsnorm(x, p["ln2_scale"])
     if cfg.n_experts > 0:
         from ray_tpu.models.moe import moe_ffn
@@ -196,7 +326,7 @@ def _ffn(x, p, cfg: GPTConfig):
         return x + y
     h = _mm(h, p["w1"]["kernel"], cfg.dtype)
     h = jax.nn.gelu(h)
-    return x + _mm(h, p["w2"]["kernel"], cfg.dtype)
+    return x + _mm_row(h, p["w2"]["kernel"], cfg.dtype, tp_axis)
 
 
 def prefill(params: Params, tokens: jax.Array, cfg: GPTConfig,
@@ -357,6 +487,28 @@ def decode_chunk(params: Params, cache: Cache, token: jax.Array,
     return jnp.moveaxis(toks, 0, 1), cache, done, rng
 
 
+def _knob_cache(fn):
+    """``lru_cache`` with DEFAULT-NORMALIZED keys: ``f(cfg)``,
+    ``f(cfg, tp=1)`` and ``f(cfg, ..., 1)`` all land on the SAME cache
+    entry. The engine threads every static knob positionally (including
+    default-valued ones like ``tp=1``), while tests and external
+    callers omit trailing defaults — a raw ``lru_cache`` would key
+    those spellings separately, silently doubling the compiled-program
+    set and breaking the recompile guards' wrapper ``is``-identity."""
+    sig = inspect.signature(fn)
+    cached = functools.lru_cache(maxsize=64)(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        bound = sig.bind(*args, **kwargs)
+        bound.apply_defaults()
+        return cached(*bound.args)
+
+    wrapper.cache_info = cached.cache_info
+    wrapper.cache_clear = cached.cache_clear
+    return wrapper
+
+
 # rtlint: program-budget: 1
 @functools.lru_cache(maxsize=64)
 def jit_decode_chunk(cfg: GPTConfig, k: int, temperature: float = 0.0,
@@ -454,22 +606,38 @@ def generate_chunked(params: Params, prompt: jax.Array, cfg: GPTConfig,
 
 
 # --------------------------------------------------------------- slot pool
-def init_slot_cache(cfg: GPTConfig, slots: int, max_len: int) -> Cache:
+def _shard_cache(cache: Cache, mesh) -> Cache:
+    """Device-put a freshly-zeroed pool into its tp layout so the first
+    donated dispatch doesn't pay a resharding copy (and donation sees
+    matching input/output shardings)."""
+    specs = _tp_cache_specs(cache)
+    return {name: jax.device_put(
+        v, jax.sharding.NamedSharding(mesh, specs[name]))
+        for name, v in cache.items()}
+
+
+def init_slot_cache(cfg: GPTConfig, slots: int, max_len: int,
+                    tp: int = 1) -> Cache:
     """Persistent pooled KV cache for the continuous-batching engine:
     ``pos`` is per-slot ``[slots]`` so each lane decodes at its own
     depth. Allocated ONCE per engine — slots are recycled by
-    re-prefilling, never by reallocating."""
+    re-prefilling, never by reallocating. ``tp > 1`` lays the pool out
+    head-sharded over :func:`decode_mesh` (the layout every sharded
+    program consumes and produces)."""
     shape = (cfg.n_layer, slots, max_len, cfg.n_head, cfg.head_dim)
-    return {
+    cache = {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
         "pos": jnp.zeros((slots,), jnp.int32),
     }
+    mesh = _tp_mesh(cfg, tp)
+    return cache if mesh is None else _shard_cache(cache, mesh)
 
 
 def prefill_into_slot(params: Params, cache: Cache, tokens: jax.Array,
                       length: jax.Array, slot: jax.Array, rng: jax.Array,
-                      *, cfg: GPTConfig, temperature: float = 0.0
+                      *, cfg: GPTConfig, temperature: float = 0.0,
+                      tp_axis=None
                       ) -> Tuple[jax.Array, Cache, jax.Array]:
     """Run one right-padded prompt and write its K/V into slot ``slot``
     of the pool.
@@ -503,9 +671,9 @@ def prefill_into_slot(params: Params, cache: Cache, tokens: jax.Array,
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         att = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
                          preferred_element_type=jnp.float32
-                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
-        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
-        x = _ffn(x, p, cfg)
+                         ).astype(q.dtype).reshape(B, S, -1)
+        x = x + _mm_row(att, p["wo"]["kernel"], cfg.dtype, tp_axis)
+        x = _ffn(x, p, cfg, tp_axis)
         return x, (k, v)
 
     x, (k_new, v_new) = lax.scan(body, x, params["block"])
@@ -521,7 +689,7 @@ def prefill_into_slot(params: Params, cache: Cache, tokens: jax.Array,
 
 
 def _slot_decode_step(params: Params, cache: Cache, token: jax.Array,
-                      active: jax.Array, cfg: GPTConfig
+                      active: jax.Array, cfg: GPTConfig, tp_axis=None
                       ) -> Tuple[jax.Array, Cache]:
     """One masked decode step over the whole slot pool: each slot writes
     its new K/V at ITS OWN ``pos[b]`` (one-hot select — positions differ
@@ -553,9 +721,9 @@ def _slot_decode_step(params: Params, cache: Cache, token: jax.Array,
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         att = jnp.einsum("bhqk,bkhd->bqhd", probs, vc,
                          preferred_element_type=jnp.float32
-                         ).astype(q.dtype).reshape(B, 1, cfg.d_model)
-        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
-        x = _ffn(x, p, cfg)
+                         ).astype(q.dtype).reshape(B, 1, -1)
+        x = x + _mm_row(att, p["wo"]["kernel"], cfg.dtype, tp_axis)
+        x = _ffn(x, p, cfg, tp_axis)
         return x, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(
@@ -584,7 +752,7 @@ def _sample_slots(logits, temperature: float, keys):
 def decode_chunk_slots(params: Params, cache: Cache, token: jax.Array,
                        rngs: jax.Array, active: jax.Array, *,
                        cfg: GPTConfig, k: int, temperature: float = 0.0,
-                       eos_token: int = -1):
+                       eos_token: int = -1, tp_axis=None):
     """Masked twin of :func:`decode_chunk` over a slot pool: k fused
     steps in ONE program, decoding only slots where ``active`` is set.
 
@@ -603,7 +771,8 @@ def decode_chunk_slots(params: Params, cache: Cache, token: jax.Array,
 
     def body(carry, _):
         cache, tok, done, keys = carry
-        logits, cache = _slot_decode_step(params, cache, tok, active, cfg)
+        logits, cache = _slot_decode_step(params, cache, tok, active,
+                                          cfg, tp_axis)
         nxt, keys = _sample_slots(logits, temperature, keys)
         if eos_token >= 0:
             nxt = jnp.where(done, eos, nxt)
@@ -616,33 +785,71 @@ def decode_chunk_slots(params: Params, cache: Cache, token: jax.Array,
 
 
 # rtlint: program-budget: len(prompt_buckets)
-@functools.lru_cache(maxsize=64)
-def jit_prefill_into_slot(cfg: GPTConfig, temperature: float = 0.0):
+@_knob_cache
+def jit_prefill_into_slot(cfg: GPTConfig, temperature: float = 0.0,
+                          tp: int = 1):
     """Jitted :func:`prefill_into_slot`; retraces once per padded-prompt
     SHAPE, so the compiled-program count equals the engine's prompt
-    bucket count. Cached on the static knobs so every engine for the
-    same (cfg, temperature) shares one wrapper (and its trace cache).
-    The pool cache is donated: the engine holds the only reference and
-    immediately rebinds the returned cache, so on TPU the update is
-    in-place instead of a full-pool copy (CPU ignores donation)."""
-    return jax.jit(functools.partial(prefill_into_slot, cfg=cfg,
-                                     temperature=temperature),
-                   donate_argnums=(1,))
+    bucket count — per (cfg, temperature, tp) key: each mesh shape has
+    its own wrapper and its own ``len(prompt_buckets)`` budget. Cached
+    on the static knobs so every engine for the same knobs shares one
+    wrapper (and its trace cache). The pool cache is donated: the
+    engine holds the only reference and immediately rebinds the
+    returned cache, so on TPU the update is in-place instead of a
+    full-pool copy (CPU ignores donation). ``tp > 1`` runs the same
+    inner function under shard_map on :func:`decode_mesh` with weights
+    column/row-parallel and the pool head-sharded."""
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(prefill_into_slot, cfg=cfg,
+                                         temperature=temperature),
+                       donate_argnums=(1,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(prefill_into_slot, cfg=cfg,
+                              temperature=temperature, tp_axis="tp")
+
+    def fn(params, cache, tokens, length, slot, rng):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_param_specs(params), cspec,
+                      P(), P(), P(), P()),
+            out_specs=(P(), cspec, P()))(
+                params, cache, tokens, length, slot, rng)
+
+    return jax.jit(fn, donate_argnums=(1,))
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
+@_knob_cache
 def jit_decode_chunk_slots(cfg: GPTConfig, k: int,
-                           temperature: float = 0.0, eos_token: int = -1):
+                           temperature: float = 0.0, eos_token: int = -1,
+                           tp: int = 1):
     """Jitted :func:`decode_chunk_slots`: ONE compiled program per
-    (pool shape, k) — admission patterns, per-request max_new, and slot
-    choice are all runtime values, never retrace triggers (pinned by the
-    recompile-guard test). The pool cache is donated (see
+    (pool shape, k, tp) — admission patterns, per-request max_new, and
+    slot choice are all runtime values, never retrace triggers (pinned
+    by the recompile-guard test). The pool cache is donated (see
     :func:`jit_prefill_into_slot`)."""
-    return jax.jit(functools.partial(decode_chunk_slots, cfg=cfg, k=k,
-                                     temperature=temperature,
-                                     eos_token=eos_token),
-                   donate_argnums=(1,))
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(decode_chunk_slots, cfg=cfg,
+                                         k=k, temperature=temperature,
+                                         eos_token=eos_token),
+                       donate_argnums=(1,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(decode_chunk_slots, cfg=cfg, k=k,
+                              temperature=temperature,
+                              eos_token=eos_token, tp_axis="tp")
+
+    def fn(params, cache, token, rngs, active):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_param_specs(params), cspec, P(), P(), P()),
+            out_specs=(P(), cspec, P(), P()))(
+                params, cache, token, rngs, active)
+
+    return jax.jit(fn, donate_argnums=(1,))
 
 
 # -------------------------------------------------------------- paged pool
@@ -769,7 +976,8 @@ def _merge_span_int8(codes: jax.Array, scales: jax.Array,
 
 
 def init_paged_cache(cfg: GPTConfig, slots: int, n_pages: int,
-                     page_size: int, kv_dtype: str = "fp") -> Cache:
+                     page_size: int, kv_dtype: str = "fp",
+                     tp: int = 1) -> Cache:
     """Paged KV pool for the continuous-batching engine: physical
     storage is page-granular (``[L, n_pages, page_size, H, hd]``), a
     slot's sequence lives wherever its page table points. ``pos`` stays
@@ -783,18 +991,21 @@ def init_paged_cache(cfg: GPTConfig, slots: int, n_pages: int,
     shape = (cfg.n_layer, n_pages, page_size, cfg.n_head, cfg.head_dim)
     if kv_dtype == "int8":
         sshape = (cfg.n_layer, n_pages, cfg.n_head)
-        return {
+        cache = {
             "k": jnp.zeros(shape, jnp.int8),
             "v": jnp.zeros(shape, jnp.int8),
             "ks": jnp.zeros(sshape, jnp.float32),
             "vs": jnp.zeros(sshape, jnp.float32),
             "pos": jnp.zeros((slots,), jnp.int32),
         }
-    return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
-        "pos": jnp.zeros((slots,), jnp.int32),
-    }
+    else:
+        cache = {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((slots,), jnp.int32),
+        }
+    mesh = _tp_mesh(cfg, tp)
+    return cache if mesh is None else _shard_cache(cache, mesh)
 
 
 def _pallas_interpret() -> bool:
@@ -991,7 +1202,7 @@ def prefill_into_slot_paged(params: Params, cache: Cache,
                             cow_src: jax.Array, slot: jax.Array,
                             rng: jax.Array, *, cfg: GPTConfig,
                             page_size: int, temperature: float = 0.0,
-                            kv_dtype: str = "fp"
+                            kv_dtype: str = "fp", tp_axis=None
                             ) -> Tuple[jax.Array, Cache, jax.Array]:
     """Prefill one prompt **suffix** into its page-table pages, fused
     with an optional copy-on-write fork and the first-token sample.
@@ -1060,12 +1271,12 @@ def prefill_into_slot_paged(params: Params, cache: Cache,
     ptc = jnp.clip(pt_row, 0, n_pages - 1)
     if quant:
         hk = _deq_page(kpool[:, ptc], kscale[:, ptc],
-                       cfg.dtype).reshape(L, V, H, hd)
+                       cfg.dtype).reshape(L, V, -1, hd)
         hv = _deq_page(vpool[:, ptc], vscale[:, ptc],
-                       cfg.dtype).reshape(L, V, H, hd)
+                       cfg.dtype).reshape(L, V, -1, hd)
     else:
-        hk = kpool[:, ptc].reshape(L, V, H, hd)
-        hv = vpool[:, ptc].reshape(L, V, H, hd)
+        hk = kpool[:, ptc].reshape(L, V, -1, hd)
+        hv = vpool[:, ptc].reshape(L, V, -1, hd)
     hist_valid = (jnp.arange(V) < hist_len)[None, None, None, :]
     self_mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
 
@@ -1084,9 +1295,9 @@ def prefill_into_slot_paged(params: Params, cache: Cache,
         vv = jnp.concatenate([hv_l[None].astype(q.dtype), v], axis=1)
         att = jnp.einsum("bhqk,bkhd->bqhd", probs, vv,
                          preferred_element_type=jnp.float32
-                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
-        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
-        x = _ffn(x, p, cfg)
+                         ).astype(q.dtype).reshape(B, S, -1)
+        x = x + _mm_row(att, p["wo"]["kernel"], cfg.dtype, tp_axis)
+        x = _ffn(x, p, cfg, tp_axis)
         return x, (k[0], v[0])
 
     x, (k_new, v_new) = lax.scan(body, x, (params["block"], hk, hv))
@@ -1124,7 +1335,7 @@ def _slot_decode_step_paged(params: Params, cache: Cache,
                             token: jax.Array, active: jax.Array,
                             pt: jax.Array, cfg: GPTConfig,
                             page_size: int, kv_dtype: str = "fp",
-                            attn_kernel: str = "gather"
+                            attn_kernel: str = "gather", tp_axis=None
                             ) -> Tuple[jax.Array, Cache]:
     """Paged twin of :func:`_slot_decode_step`: each active slot writes
     its new K/V at ``(pt[b, pos[b] // ps], pos[b] % ps)`` (scatter with
@@ -1170,9 +1381,9 @@ def _slot_decode_step_paged(params: Params, cache: Cache,
             vc = vc.at[page_w, off].set(v[:, 0], mode="drop")
         att = paged_attention(q, kc, vc, pt, pos, page_size=ps,
                               kernel=attn_kernel, ks=ksc, vs=vsc)
-        x = x + _mm(att.reshape(B, 1, cfg.d_model), p["wo"]["kernel"],
-                    cfg.dtype)
-        x = _ffn(x, p, cfg)
+        x = x + _mm_row(att.reshape(B, 1, -1), p["wo"]["kernel"],
+                        cfg.dtype, tp_axis)
+        x = _ffn(x, p, cfg, tp_axis)
         if quant:
             return x, (kc, vc, ksc, vsc)
         return x, (kc, vc)
@@ -1198,7 +1409,7 @@ def decode_chunk_slots_paged(params: Params, cache: Cache,
                              temperature: float = 0.0,
                              eos_token: int = -1,
                              kv_dtype: str = "fp",
-                             attn_kernel: str = "gather"):
+                             attn_kernel: str = "gather", tp_axis=None):
     """Paged twin of :func:`decode_chunk_slots`: k fused steps in ONE
     program with the page table held constant through the chunk (the
     engine maps pages covering ``pos + k`` before dispatching — a slot
@@ -1217,7 +1428,7 @@ def decode_chunk_slots_paged(params: Params, cache: Cache,
         logits, cache = _slot_decode_step_paged(params, cache, tok,
                                                 active, pt, cfg,
                                                 page_size, kv_dtype,
-                                                attn_kernel)
+                                                attn_kernel, tp_axis)
         nxt, keys = _sample_slots(logits, temperature, keys)
         if eos_token >= 0:
             nxt = jnp.where(done, eos, nxt)
@@ -1230,42 +1441,84 @@ def decode_chunk_slots_paged(params: Params, cache: Cache,
 
 
 # rtlint: program-budget: len(prompt_buckets)
-@functools.lru_cache(maxsize=64)
+@_knob_cache
 def jit_prefill_into_slot_paged(cfg: GPTConfig, page_size: int,
                                 temperature: float = 0.0,
-                                kv_dtype: str = "fp"):
+                                kv_dtype: str = "fp", tp: int = 1):
     """Jitted :func:`prefill_into_slot_paged`; one compiled program per
-    SUFFIX bucket — prefix-hit depth (``hist_len``), page-table
-    contents, and COW source are all traced, so shared-prefix admission
-    never retraces. ``kv_dtype`` is an engine-level static baked into
-    the same program set (it changes the pool layout, not the program
-    COUNT). Pool donated as in :func:`jit_prefill_into_slot`."""
-    return jax.jit(functools.partial(prefill_into_slot_paged, cfg=cfg,
-                                     page_size=page_size,
-                                     temperature=temperature,
-                                     kv_dtype=kv_dtype),
-                   donate_argnums=(1,))
+    SUFFIX bucket per (cfg, page_size, temperature, kv_dtype, tp) key —
+    prefix-hit depth (``hist_len``), page-table contents, and COW
+    source are all traced, so shared-prefix admission never retraces.
+    ``kv_dtype`` is an engine-level static baked into the same program
+    set (it changes the pool layout, not the program COUNT). Pool
+    donated as in :func:`jit_prefill_into_slot`."""
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(prefill_into_slot_paged,
+                                         cfg=cfg, page_size=page_size,
+                                         temperature=temperature,
+                                         kv_dtype=kv_dtype),
+                       donate_argnums=(1,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(prefill_into_slot_paged, cfg=cfg,
+                              page_size=page_size,
+                              temperature=temperature,
+                              kv_dtype=kv_dtype, tp_axis="tp")
+
+    def fn(params, cache, tokens, length, hist_len, pt_row, cow_src,
+           slot, rng):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_param_specs(params), cspec,
+                      P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), cspec, P()))(
+                params, cache, tokens, length, hist_len, pt_row,
+                cow_src, slot, rng)
+
+    return jax.jit(fn, donate_argnums=(1,))
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
+@_knob_cache
 def jit_decode_chunk_slots_paged(cfg: GPTConfig, k: int, page_size: int,
                                  temperature: float = 0.0,
                                  eos_token: int = -1,
                                  kv_dtype: str = "fp",
-                                 attn_kernel: str = "gather"):
+                                 attn_kernel: str = "gather",
+                                 tp: int = 1):
     """Jitted :func:`decode_chunk_slots_paged`: ONE program per (pool
-    shape, k, page_size) — the page table is data, and the
+    shape, k, page_size, tp) — the page table is data, and the
     ``kv_dtype``/``attn_kernel`` knobs are engine-level statics that
     select WHICH one program is built, never additional ones. Pool
     donated."""
-    return jax.jit(functools.partial(decode_chunk_slots_paged, cfg=cfg,
-                                     k=k, page_size=page_size,
-                                     temperature=temperature,
-                                     eos_token=eos_token,
-                                     kv_dtype=kv_dtype,
-                                     attn_kernel=attn_kernel),
-                   donate_argnums=(1,))
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(decode_chunk_slots_paged,
+                                         cfg=cfg, k=k,
+                                         page_size=page_size,
+                                         temperature=temperature,
+                                         eos_token=eos_token,
+                                         kv_dtype=kv_dtype,
+                                         attn_kernel=attn_kernel),
+                       donate_argnums=(1,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(decode_chunk_slots_paged, cfg=cfg, k=k,
+                              page_size=page_size,
+                              temperature=temperature,
+                              eos_token=eos_token, kv_dtype=kv_dtype,
+                              attn_kernel=attn_kernel, tp_axis="tp")
+
+    def fn(params, cache, token, rngs, active, pt):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_param_specs(params), cspec,
+                      P(), P(), P(), P()),
+            out_specs=(P(), cspec, P(), P()))(
+                params, cache, token, rngs, active, pt)
+
+    return jax.jit(fn, donate_argnums=(1,))
 
 
 # rtlint: program-budget: 1
@@ -1353,7 +1606,7 @@ def _spec_accept(logits, draft, keys, temperature: float, k: int):
 def verify_chunk_slots(params: Params, cache: Cache, token: jax.Array,
                        draft: jax.Array, rngs: jax.Array,
                        active: jax.Array, *, cfg: GPTConfig, k: int,
-                       temperature: float = 0.0):
+                       temperature: float = 0.0, tp_axis=None):
     """ONE batched target forward verifying k drafted tokens per active
     slot (ISSUE 9 tentpole; the draft-k-verify-once step).
 
@@ -1409,9 +1662,9 @@ def verify_chunk_slots(params: Params, cache: Cache, token: jax.Array,
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         att = jnp.einsum("bhqk,bkhd->bqhd", probs, vc,
                          preferred_element_type=jnp.float32
-                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
-        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
-        x = _ffn(x, p, cfg)
+                         ).astype(q.dtype).reshape(B, S, -1)
+        x = x + _mm_row(att, p["wo"]["kernel"], cfg.dtype, tp_axis)
+        x = _ffn(x, p, cfg, tp_axis)
         return x, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(
@@ -1429,7 +1682,7 @@ def verify_chunk_slots_paged(params: Params, cache: Cache,
                              rngs: jax.Array, active: jax.Array,
                              pt: jax.Array, *, cfg: GPTConfig, k: int,
                              page_size: int, temperature: float = 0.0,
-                             kv_dtype: str = "fp"):
+                             kv_dtype: str = "fp", tp_axis=None):
     """Paged twin of :func:`verify_chunk_slots`: K/V writes scatter at
     ``(pt[b, (pos+i) // ps], (pos+i) % ps)`` with drop semantics (an
     unmapped or inactive target is discarded, never clamped into
@@ -1487,23 +1740,23 @@ def verify_chunk_slots_paged(params: Params, cache: Cache,
             vc, vsc = _merge_span_int8(vc, vsc, vv, pt, pos, S,
                                        active, ps)
             hk = _deq_page(kc[ptc], ksc[ptc],
-                           q.dtype).reshape(B, V, H, hd)
+                           q.dtype).reshape(B, V, -1, hd)
             hv = _deq_page(vc[ptc], vsc[ptc],
-                           q.dtype).reshape(B, V, H, hd)
+                           q.dtype).reshape(B, V, -1, hd)
         else:
             kc = kc.at[page_w, off].set(kk, mode="drop")
             vc = vc.at[page_w, off].set(vv, mode="drop")
-            hk = kc[ptc].reshape(B, V, H, hd)
-            hv = vc[ptc].reshape(B, V, H, hd)
+            hk = kc[ptc].reshape(B, V, -1, hd)
+            hv = vc[ptc].reshape(B, V, -1, hd)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, hk,
                             preferred_element_type=jnp.float32) * scale
         logits = jnp.where(valid, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         att = jnp.einsum("bhqk,bkhd->bqhd", probs, hv,
                          preferred_element_type=jnp.float32
-                         ).astype(q.dtype).reshape(B, S, cfg.d_model)
-        x = x + _mm(att, p["wo"]["kernel"], cfg.dtype)
-        x = _ffn(x, p, cfg)
+                         ).astype(q.dtype).reshape(B, S, -1)
+        x = x + _mm_row(att, p["wo"]["kernel"], cfg.dtype, tp_axis)
+        x = _ffn(x, p, cfg, tp_axis)
         if quant:
             return x, (kc, vc, ksc, vsc)
         return x, (kc, vc)
@@ -1562,8 +1815,8 @@ def export_slot_kv_paged(cache: Cache, pt_row: jax.Array, *,
     max_pages = pt_row.shape[0]
     V = max_pages * page_size
     ptc = jnp.clip(pt_row, 0, n_pages - 1)
-    k = cache["k"][:, ptc].reshape(L, V, H, hd)
-    v = cache["v"][:, ptc].reshape(L, V, H, hd)
+    k = cache["k"][:, ptc].reshape(L, V, -1, hd)
+    v = cache["v"][:, ptc].reshape(L, V, -1, hd)
     if kv_dtype == "int8":
         return k, v, cache["ks"][:, ptc], cache["vs"][:, ptc]
     return k, v
@@ -1621,79 +1874,199 @@ def import_slot_kv_paged(cache: Cache, k_pages: jax.Array,
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
-def jit_export_slot_kv(cfg: GPTConfig):
+@_knob_cache
+def jit_export_slot_kv(cfg: GPTConfig, tp: int = 1):
     """Jitted :func:`export_slot_kv`: ONE program per flat pool shape
-    (slot index is traced). NOT donated — the exporter keeps its pool."""
-    return jax.jit(functools.partial(export_slot_kv, cfg=cfg))
+    (slot index is traced). NOT donated — the exporter keeps its pool.
+    Under tp the returned rows are head-sharded device arrays whose
+    host gather (``np.asarray``) is the CANONICAL ``[L, max_len, H,
+    hd]`` layout — identical bytes for any exporter tp, which is what
+    makes the handoff digest layout-independent."""
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(export_slot_kv, cfg=cfg))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(export_slot_kv, cfg=cfg)
+    hspec = P(None, None, "tp", None)
+
+    def fn(cache, slot):
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_cache_specs(cache), P()),
+            out_specs=(hspec, hspec))(cache, slot)
+
+    return jax.jit(fn)
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
+@_knob_cache
 def jit_export_slot_kv_paged(cfg: GPTConfig, page_size: int,
-                             kv_dtype: str = "fp"):
+                             kv_dtype: str = "fp", tp: int = 1):
     """Jitted :func:`export_slot_kv_paged`: ONE program per (pool
-    shape, page_size, kv_dtype) — the page table is data. NOT
-    donated."""
-    return jax.jit(functools.partial(export_slot_kv_paged, cfg=cfg,
-                                     page_size=page_size,
-                                     kv_dtype=kv_dtype))
+    shape, page_size, kv_dtype, tp) — the page table is data. NOT
+    donated. See :func:`jit_export_slot_kv` for the tp canonical-layout
+    contract."""
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(export_slot_kv_paged, cfg=cfg,
+                                         page_size=page_size,
+                                         kv_dtype=kv_dtype))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(export_slot_kv_paged, cfg=cfg,
+                              page_size=page_size, kv_dtype=kv_dtype)
+    hspec = P(None, None, "tp", None)
+    sspec = P(None, None, "tp")
+    outs = (hspec, hspec, sspec, sspec) if kv_dtype == "int8" \
+        else (hspec, hspec)
+
+    def fn(cache, pt_row):
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_cache_specs(cache), P()),
+            out_specs=outs)(cache, pt_row)
+
+    return jax.jit(fn)
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
-def jit_import_slot_kv(cfg: GPTConfig):
+@_knob_cache
+def jit_import_slot_kv(cfg: GPTConfig, tp: int = 1):
     """Jitted :func:`import_slot_kv`: ONE program per flat pool shape
     (slot and length are traced). Pool donated as in
-    :func:`jit_prefill_into_slot` — the importer immediately rebinds."""
-    return jax.jit(functools.partial(import_slot_kv, cfg=cfg),
-                   donate_argnums=(0,))
+    :func:`jit_prefill_into_slot` — the importer immediately rebinds.
+    Under tp the host-canonical ship buffer is scattered into THIS
+    engine's mesh — the resharding half of the handoff boundary, so an
+    N-way exporter feeds an M-way importer with no layout coupling."""
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(import_slot_kv, cfg=cfg),
+                       donate_argnums=(0,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(import_slot_kv, cfg=cfg)
+    hspec = P(None, None, "tp", None)
+
+    def fn(cache, k_row, v_row, slot, length):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(cspec, hspec, hspec, P(), P()),
+            out_specs=cspec)(cache, k_row, v_row, slot, length)
+
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
+@_knob_cache
 def jit_import_slot_kv_paged(cfg: GPTConfig, page_size: int,
-                             kv_dtype: str = "fp"):
+                             kv_dtype: str = "fp", tp: int = 1):
     """Jitted :func:`import_slot_kv_paged`: ONE program per (pool
-    shape, page_size, kv_dtype) — int8 wrappers take the shipped
-    scales as trailing positional args. Pool donated."""
+    shape, page_size, kv_dtype, tp) — int8 wrappers take the shipped
+    scales as trailing positional args. Pool donated. See
+    :func:`jit_import_slot_kv` for the tp resharding contract."""
+    mesh = _tp_mesh(cfg, tp)
     if kv_dtype == "int8":
-        def fn(cache, k_pages, v_pages, ks_pages, vs_pages, pt_row,
-               slot, length):
+        def raw(cache, k_pages, v_pages, ks_pages, vs_pages, pt_row,
+                slot, length):
             return import_slot_kv_paged(
                 cache, k_pages, v_pages, pt_row, slot, length, cfg=cfg,
                 page_size=page_size, ks_pages=ks_pages,
                 vs_pages=vs_pages)
+        if mesh is None:
+            return jax.jit(raw, donate_argnums=(0,))
+        P = jax.sharding.PartitionSpec
+        hspec = P(None, None, None, "tp", None)
+        sspec = P(None, None, "tp")
+
+        def fn(cache, k_pages, v_pages, ks_pages, vs_pages, pt_row,
+               slot, length):
+            cspec = _tp_cache_specs(cache)
+            return shard_map(
+                raw, mesh=mesh,
+                in_specs=(cspec, hspec, hspec, sspec, sspec,
+                          P(), P(), P()),
+                out_specs=cspec)(cache, k_pages, v_pages, ks_pages,
+                                 vs_pages, pt_row, slot, length)
+
         return jax.jit(fn, donate_argnums=(0,))
-    return jax.jit(functools.partial(import_slot_kv_paged, cfg=cfg,
-                                     page_size=page_size),
-                   donate_argnums=(0,))
+    if mesh is None:
+        return jax.jit(functools.partial(import_slot_kv_paged, cfg=cfg,
+                                         page_size=page_size),
+                       donate_argnums=(0,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(import_slot_kv_paged, cfg=cfg,
+                              page_size=page_size)
+    hspec = P(None, None, None, "tp", None)
+
+    def fn(cache, k_pages, v_pages, pt_row, slot, length):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(cspec, hspec, hspec, P(), P(), P()),
+            out_specs=cspec)(cache, k_pages, v_pages, pt_row, slot,
+                             length)
+
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
+@_knob_cache
 def jit_verify_chunk_slots(cfg: GPTConfig, k: int,
-                           temperature: float = 0.0):
+                           temperature: float = 0.0, tp: int = 1):
     """Jitted :func:`verify_chunk_slots`: ONE compiled program per
-    (pool shape, k) — draft contents, acceptance pattern, and per-slot
-    positions are all traced data, never retrace triggers (pinned by
-    the spec recompile-guard test). Pool donated as in
+    (pool shape, k, tp) — draft contents, acceptance pattern, and
+    per-slot positions are all traced data, never retrace triggers
+    (pinned by the spec recompile-guard test). Pool donated as in
     :func:`jit_prefill_into_slot`."""
-    return jax.jit(functools.partial(verify_chunk_slots, cfg=cfg, k=k,
-                                     temperature=temperature),
-                   donate_argnums=(1,))
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(verify_chunk_slots, cfg=cfg,
+                                         k=k, temperature=temperature),
+                       donate_argnums=(1,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(verify_chunk_slots, cfg=cfg, k=k,
+                              temperature=temperature, tp_axis="tp")
+
+    def fn(params, cache, token, draft, rngs, active):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_param_specs(params), cspec,
+                      P(), P(), P(), P()),
+            out_specs=(P(), P(), cspec, P()))(
+                params, cache, token, draft, rngs, active)
+
+    return jax.jit(fn, donate_argnums=(1,))
 
 
 # rtlint: program-budget: 1
-@functools.lru_cache(maxsize=64)
+@_knob_cache
 def jit_verify_chunk_slots_paged(cfg: GPTConfig, k: int, page_size: int,
                                  temperature: float = 0.0,
-                                 kv_dtype: str = "fp"):
+                                 kv_dtype: str = "fp", tp: int = 1):
     """Jitted :func:`verify_chunk_slots_paged`: ONE program per (pool
-    shape, k, page_size, kv_dtype) — the page table is data. Pool
+    shape, k, page_size, kv_dtype, tp) — the page table is data. Pool
     donated."""
-    return jax.jit(functools.partial(verify_chunk_slots_paged, cfg=cfg,
-                                     k=k, page_size=page_size,
-                                     temperature=temperature,
-                                     kv_dtype=kv_dtype),
-                   donate_argnums=(1,))
+    mesh = _tp_mesh(cfg, tp)
+    if mesh is None:
+        return jax.jit(functools.partial(verify_chunk_slots_paged,
+                                         cfg=cfg, k=k,
+                                         page_size=page_size,
+                                         temperature=temperature,
+                                         kv_dtype=kv_dtype),
+                       donate_argnums=(1,))
+    P = jax.sharding.PartitionSpec
+    inner = functools.partial(verify_chunk_slots_paged, cfg=cfg, k=k,
+                              page_size=page_size,
+                              temperature=temperature,
+                              kv_dtype=kv_dtype, tp_axis="tp")
+
+    def fn(params, cache, token, draft, rngs, active, pt):
+        cspec = _tp_cache_specs(cache)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(_tp_param_specs(params), cspec,
+                      P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), cspec, P()))(
+                params, cache, token, draft, rngs, active, pt)
+
+    return jax.jit(fn, donate_argnums=(1,))

@@ -625,7 +625,7 @@ class ServeController:
                     # not counters — pass through, don't sum. Replicas
                     # of one deployment share the knobs, so last wins.
                     for key in ("attn_kernel", "kv_dtype",
-                                "kv_bytes_per_token"):
+                                "kv_bytes_per_token", "tp"):
                         if key in est:
                             engine[key] = est[key]
                     sp = est.get("spec")

@@ -437,7 +437,8 @@ class DecodeEngine:
                  spec_decode=None, draft_k: int = 4,
                  spec_threshold: float = 0.0,
                  role: str = "both", handoff_ttl_s: float = 30.0,
-                 attn_kernel: str = "gather", kv_dtype: str = "fp"):
+                 attn_kernel: str = "gather", kv_dtype: str = "fp",
+                 tp: int = 1):
         from ..models import gpt_decode
         from .draft import make_drafter
         from .handoff import LeaseTable
@@ -525,6 +526,14 @@ class DecodeEngine:
                 "the config plane)")
         self.attn_kernel = attn_kernel
         self.kv_dtype = kv_dtype
+        # ---- tensor parallelism (ISSUE 20): ENGINE-STATIC mesh width.
+        # tp=N shards weights over heads/ffn and the KV pool over the
+        # head dim; validated eagerly so a bad (cfg, tp) pair fails at
+        # construction, not first dispatch. _tp_mesh also raises when
+        # fewer than N devices are visible — on CPU, force host devices
+        # via XLA_FLAGS before importing jax.
+        self.tp = int(tp)
+        gpt_decode._tp_mesh(cfg, self.tp)
         # Guards the put-vs-final-drain race: once _fail_all flips
         # _draining under this lock, no new submission can land in a
         # queue nobody will ever read again. Created BEFORE the pool so
@@ -606,6 +615,12 @@ class DecodeEngine:
         gpt_decode = self._gd
         cfg = self.cfg
         self.paged = bool(paged)
+        # The dispatch-side weights: placed once per pool build (a
+        # NamedSharding scatter when tp > 1, the raw host pytree when
+        # tp == 1 — shard_params is an identity there). The drafter
+        # keeps ``self.params``: it runs its own single-chip programs.
+        self._params_dev = gpt_decode.shard_params(
+            self.params, cfg, self.tp)
         if not self.paged:
             self.page_size = 0
             self.n_pages = 0
@@ -614,13 +629,15 @@ class DecodeEngine:
             self._prefix = None
             self._pt = None
             self._prefill = gpt_decode.jit_prefill_into_slot(
-                cfg, self.temperature)
+                cfg, self.temperature, self.tp)
             self._step = gpt_decode.jit_decode_chunk_slots(
-                cfg, self.chunk, self.temperature, self.eos_token)
-            self._export = gpt_decode.jit_export_slot_kv(cfg)
-            self._import = gpt_decode.jit_import_slot_kv(cfg)
+                cfg, self.chunk, self.temperature, self.eos_token,
+                self.tp)
+            self._export = gpt_decode.jit_export_slot_kv(cfg, self.tp)
+            self._import = gpt_decode.jit_import_slot_kv(cfg, self.tp)
             self._cache = gpt_decode.init_slot_cache(cfg, self.slots,
-                                                     self.max_len)
+                                                     self.max_len,
+                                                     self.tp)
             self._bind_verify()
             return
         self.page_size = int(page_size)
@@ -648,17 +665,18 @@ class DecodeEngine:
         self._pt = np.full((self.slots, self.max_pages),
                            gpt_decode.PT_SENTINEL, np.int32)
         self._prefill = gpt_decode.jit_prefill_into_slot_paged(
-            cfg, self.page_size, self.temperature, self.kv_dtype)
+            cfg, self.page_size, self.temperature, self.kv_dtype,
+            self.tp)
         self._step = gpt_decode.jit_decode_chunk_slots_paged(
             cfg, self.chunk, self.page_size, self.temperature,
-            self.eos_token, self.kv_dtype, self.attn_kernel)
+            self.eos_token, self.kv_dtype, self.attn_kernel, self.tp)
         self._export = gpt_decode.jit_export_slot_kv_paged(
-            cfg, self.page_size, self.kv_dtype)
+            cfg, self.page_size, self.kv_dtype, self.tp)
         self._import = gpt_decode.jit_import_slot_kv_paged(
-            cfg, self.page_size, self.kv_dtype)
+            cfg, self.page_size, self.kv_dtype, self.tp)
         self._cache = gpt_decode.init_paged_cache(
             cfg, self.slots, self.n_pages, self.page_size,
-            self.kv_dtype)
+            self.kv_dtype, self.tp)
         self._bind_verify()
 
     # rtlint: program-budget: 1
@@ -674,10 +692,10 @@ class DecodeEngine:
         elif self.paged:
             self._verify = self._gd.jit_verify_chunk_slots_paged(
                 self.cfg, self.draft_k, self.page_size,
-                self.temperature, self.kv_dtype)
+                self.temperature, self.kv_dtype, self.tp)
         else:
             self._verify = self._gd.jit_verify_chunk_slots(
-                self.cfg, self.draft_k, self.temperature)
+                self.cfg, self.draft_k, self.temperature, self.tp)
 
     def ensure_paging(self, page_size: Optional[int] = None,
                       prefix_cache: Optional[bool] = None,
@@ -835,11 +853,41 @@ class DecodeEngine:
                 self._leases.ttl_s = float(handoff_ttl_s)
         return self
 
+    def ensure_tp(self, tp: Optional[int] = None):
+        """Idempotently apply the tensor-parallel width from the config
+        plane (the deployment schema's ``engine: tp:`` knob). A
+        matching engine is a no-op; a mismatched engine is rebuilt IF
+        it has never admitted a request, else this raises — the mesh is
+        baked into every compiled program AND the pool's device layout,
+        so flipping it under live lanes would orphan the sharded
+        cache."""
+        if tp is None:
+            return self
+        want = int(tp)
+        with self._admit_lock:
+            if want == self.tp:
+                return self
+            with self._stats_lock:
+                used = self._stats["admitted"]
+            if used or self._queue.qsize() or self._pending or \
+                    any(s is not None for s in self._state):
+                raise ValueError(
+                    f"cannot change tp ({self.tp} -> {want}) on a "
+                    f"live engine; construct it with tp= or apply the "
+                    f"config before traffic")
+            # Validate (divisibility + visible devices) BEFORE mutating.
+            self._gd._tp_mesh(self.cfg, want)
+            self.tp = want
+            self._build_pool(self.paged, self.page_size, self.n_pages,
+                             self._prefix is not None)
+        return self
+
     #: Config-plane knob split for :meth:`apply_config`.
     _PAGE_KEYS = ("page_size", "prefix_cache", "n_pages",
                   "attn_kernel", "kv_dtype")
     _SPEC_KEYS = ("spec_decode", "draft_k", "spec_threshold")
     _ROLE_KEYS = ("role", "handoff_ttl_s")
+    _TP_KEYS = ("tp",)
 
     def apply_config(self, **knobs):
         """Route a deployment ``engine:`` config block to the right
@@ -849,7 +897,7 @@ class DecodeEngine:
         raise (the schema validates too — this guards direct callers).
         """
         known = set(self._PAGE_KEYS) | set(self._SPEC_KEYS) \
-            | set(self._ROLE_KEYS)
+            | set(self._ROLE_KEYS) | set(self._TP_KEYS)
         unknown = set(knobs) - known
         if unknown:
             raise ValueError(
@@ -861,6 +909,12 @@ class DecodeEngine:
                 if k in self._SPEC_KEYS and v is not None}
         rolek = {k: v for k, v in knobs.items()
                  if k in self._ROLE_KEYS and v is not None}
+        tpk = {k: v for k, v in knobs.items()
+               if k in self._TP_KEYS and v is not None}
+        # tp first: a repage after the mesh flip lands on the already-
+        # sharded pool, while the reverse would rebuild twice.
+        if tpk:
+            self.ensure_tp(**tpk)
         if page:
             self.ensure_paging(**page)
         if spec:
@@ -1102,6 +1156,19 @@ class DecodeEngine:
                 raise HandoffError(
                     f"shipped page_size {payload.get('page_size')} "
                     f"does not match this engine's ({self.page_size})")
+            # tp-layout identity (ISSUE 20): the handoff plane ships
+            # CANONICAL host-order KV only — the exporter gathers its
+            # mesh and the importer's jit scatters into its own, so an
+            # N-way prefill feeds an M-way decode with no negotiation.
+            # A payload stamped with any other layout came from a
+            # foreign/newer protocol; its bytes would scatter wrong, so
+            # it degrades to the counted local re-prefill below.
+            ship_layout = payload.get("layout", "canonical")
+            if ship_layout != "canonical":
+                raise HandoffError(
+                    f"shipped KV layout {ship_layout!r} is not the "
+                    f"canonical host layout; refusing to scatter into "
+                    f"a tp={self.tp} mesh")
         except HandoffError:
             payload = None
         if payload is None:
@@ -1354,6 +1421,7 @@ class DecodeEngine:
             (out["dispatches"] + out["prefills"]) / max(out["tokens"], 1))
         out["paged"] = self.paged
         out["deployment"] = self.deployment
+        out["tp"] = self.tp
         sp_r = out.pop("spec_rounds")
         sp_p = out.pop("spec_proposed")
         sp_a = out.pop("spec_accepted")
@@ -1701,7 +1769,7 @@ class DecodeEngine:
             padded = np.zeros((1, req.bucket), np.int32)
             padded[0, :P] = req.prompt
             tok, cache, key = self._prefill(
-                self.params, self._cache, padded, np.int32(P),
+                self._params_dev, self._cache, padded, np.int32(P),
                 np.int32(slot), jax.random.PRNGKey(req.seed))
             # One transfer per admission — THE TTFT point.
             # rtlint: sync-ok=ttft first token streams from the host
@@ -1820,7 +1888,7 @@ class DecodeEngine:
         pt_row[:len(pages)] = pages
         self._pt[slot] = pt_row
         tok, cache, key = self._prefill(
-            self.params, self._cache, padded, np.int32(sl),
+            self._params_dev, self._cache, padded, np.int32(sl),
             np.int32(hist), pt_row, np.int32(cow_src), np.int32(slot),
             jax.random.PRNGKey(req.seed))
         # One transfer per admission — THE TTFT point.
@@ -2153,11 +2221,11 @@ class DecodeEngine:
         t0 = time.time()
         if self.paged:
             toks, cache, _done, rngs = self._step(
-                self.params, self._cache, self._token, self._rngs,
+                self._params_dev, self._cache, self._token, self._rngs,
                 active, self._pt)
         else:
             toks, cache, _done, rngs = self._step(
-                self.params, self._cache, self._token, self._rngs,
+                self._params_dev, self._cache, self._token, self._rngs,
                 active)
         # ONE transfer per fused k-step chunk — the engine's designed
         # streaming granularity.
@@ -2180,6 +2248,14 @@ class DecodeEngine:
         _driver_emit("engine.dispatch", epoch=self._epoch,
                      active=n_active, chunk=self.chunk,
                      dispatch_s=round(t1 - t0, 6))
+        if self.tp > 1:
+            # Post-mortem breadcrumb for sharded dispatch: which mesh
+            # shape ran which compiled program. Same rate cap as
+            # engine.dispatch — one pair per chunk boundary.
+            _driver_emit("shard.dispatch", epoch=self._epoch,
+                         mesh=[("tp", self.tp)],
+                         program="chunk_paged" if self.paged
+                         else "chunk")
         if self.paged and self.attn_kernel == "pallas":
             # One fused-kernel dispatch per chunk program launch (the
             # kernel runs k times per layer inside it).
@@ -2295,11 +2371,11 @@ class DecodeEngine:
         t0 = time.time()
         if self.paged:
             committed, n_acc, cache, rngs = self._verify(
-                self.params, self._cache, self._token, draft,
+                self._params_dev, self._cache, self._token, draft,
                 self._rngs, active, self._pt)
         else:
             committed, n_acc, cache, rngs = self._verify(
-                self.params, self._cache, self._token, draft,
+                self._params_dev, self._cache, self._token, draft,
                 self._rngs, active)
         # ONE transfer per verify round: committed tokens, accept
         # counts, and PRNG lanes come back together.
@@ -2329,6 +2405,11 @@ class DecodeEngine:
         _driver_emit("engine.dispatch", epoch=self._epoch,
                      active=n_active, spec=True,
                      accepted=accepted_total)
+        if self.tp > 1:
+            _driver_emit("shard.dispatch", epoch=self._epoch,
+                         mesh=[("tp", self.tp)],
+                         program="verify_paged" if self.paged
+                         else "verify")
         with self._stats_lock:
             self._stats["peak_active"] = max(self._stats["peak_active"],
                                              n_active)

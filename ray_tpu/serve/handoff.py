@@ -84,6 +84,15 @@ def payload_digest(payload: Dict[str, Any]) -> str:
         h.update((f"kv_dtype={payload.get('kv_dtype', 'int8')};"
                   f"page_size={int(payload.get('page_size', 0))}"
                   ).encode())
+    if payload.get("layout", "canonical") != "canonical":
+        # tp resharding boundary (ISSUE 20): the hash is defined over
+        # the CANONICAL host-order bytes — exporters gather their mesh
+        # before building the payload, so "canonical" (the only layout
+        # this protocol ships) folds nothing in and every existing
+        # digest is unchanged. A non-canonical stamp is hashed so it
+        # cannot be stripped in flight to sneak mesh-local bytes past
+        # the importer's layout check.
+        h.update(f"layout={payload['layout']}".encode())
     return h.hexdigest()
 
 
@@ -92,7 +101,8 @@ def build_payload(*, k: np.ndarray, v: np.ndarray, prompt: np.ndarray,
                   max_new: int, ks: Optional[np.ndarray] = None,
                   vs: Optional[np.ndarray] = None,
                   kv_dtype: Optional[str] = None,
-                  page_size: Optional[int] = None) -> Dict[str, Any]:
+                  page_size: Optional[int] = None,
+                  layout: Optional[str] = None) -> Dict[str, Any]:
     """Assemble one ship buffer: the slot's K/V trimmed to ``pos``
     (``[L, pos, H, hd]``, contiguous), the first sampled token, the
     post-prefill PRNG lane, and the replay identity (prompt, seed,
@@ -117,6 +127,12 @@ def build_payload(*, k: np.ndarray, v: np.ndarray, prompt: np.ndarray,
         payload["vs"] = np.ascontiguousarray(np.asarray(vs, np.float32))
         payload["kv_dtype"] = str(kv_dtype or "int8")
         payload["page_size"] = int(page_size or 0)
+    if layout is not None and layout != "canonical":
+        # Only a NON-canonical stamp is recorded (and digest-folded):
+        # canonical is the protocol default, so tp-aware exporters —
+        # which always gather to host order first — emit payloads
+        # byte-identical to the single-chip plane.
+        payload["layout"] = str(layout)
     payload["digest"] = payload_digest(payload)
     return payload
 

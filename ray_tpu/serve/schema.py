@@ -70,7 +70,7 @@ class DeploymentSchema:
                               "spec_decode", "draft_k",
                               "spec_threshold", "role", "roles",
                               "handoff_ttl_s", "attn_kernel",
-                              "kv_dtype"})
+                              "kv_dtype", "tp"})
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSchema":

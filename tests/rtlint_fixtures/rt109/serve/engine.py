@@ -138,3 +138,64 @@ class SuppressedBinder:
     # rtlint: disable=RT109 experimental probe engine, not in serving
     def _build(self, cfg):
         self._x = jit_budget_fixture(cfg)
+
+
+# The mesh-keyed factory (ISSUE 20): one program per (prompt bucket,
+# mesh shape) — the budget is the PRODUCT atom, a real bound.
+# rtlint: program-budget: len(prompt_buckets) * len(tps)
+def jit_mesh_budget_fixture(cfg, bucket=8, tp=1):
+    return lambda *a: a
+
+
+class MeshKeyedEngine:
+    """ISSUE 20 negative case: a program table keyed by (bucket, tp)
+    over two bounded collections is ``len(prompt_buckets) * len(tps)``
+    programs — the product of two symbolic cardinalities distributes
+    instead of collapsing to unbounded."""
+
+    # rtlint: program-budget: len(prompt_buckets) * len(tps) + 1
+    def _build(self, cfg):
+        self._chunkprog = jit_mesh_budget_fixture(cfg)
+        progs = {}
+        for b in self.prompt_buckets:
+            for tp in self.tps:
+                progs[(b, tp)] = jit_mesh_budget_fixture(cfg, b, tp)
+        self._table = progs
+
+
+class MeshOverBudget:
+    """Positive case: the declaration forgot the mesh axis — the
+    (bucket, tp) table exceeds a per-bucket-only budget."""
+
+    # FIRES-BELOW RT109
+    # rtlint: program-budget: len(prompt_buckets)
+    def _build(self, cfg):
+        progs = {}
+        for b in self.prompt_buckets:
+            for tp in self.tps:
+                progs[(b, tp)] = jit_mesh_budget_fixture(cfg, b, tp)
+        self._table = progs
+
+
+# rtlint: program-budget: len(tps)
+def jit_width_fixture(cfg, tp=1):
+    return lambda *a: a
+
+
+class MeshLaunderedWidth:
+    """Positive case: a mesh width derived from the DEVICE COUNT —
+    request/host-varying, laundered through a helper so RT103 cannot
+    see it — reaches a trace key; the bounded discipline is an
+    explicit ``tps`` collection, never ``len(jax.devices())``."""
+
+    # rtlint: program-budget: len(tps)
+    def _build(self, cfg):
+        self._progs = {tp: jit_width_fixture(cfg, tp)
+                       for tp in self.tps}
+
+    def _host_width(self):
+        return len(jax.devices())
+
+    def admit(self, cfg):
+        tp = self._host_width()
+        return jit_width_fixture(cfg, tp)  # FIRES RT109

@@ -44,14 +44,17 @@ take, as a symbolic linear expression over ``len(<collection>)`` atoms:
   so per-instance-fixed values cost one trace key.
 - bounded — ``len(X)``: an element of a collection whose terminal name
   matches ``buckets`` (``self.prompt_buckets``, the repo's compile-
-  shape discipline), extracted via ``for``/``next(...)``/subscript.
-  ``len(X)`` of such a collection is itself a config scalar (``1``).
+  shape discipline) or the mesh-shape discipline (``tps``/``meshes``,
+  ISSUE 20), extracted via ``for``/``next(...)``/subscript. ``len(X)``
+  of such a collection is itself a config scalar (``1``).
 - unbounded: ``len(...)``, ``.shape``, ``.size`` of anything else —
   one compiled program per distinct value — and anything arithmetic
   derives from one.
 
 Cardinalities propagate through assignments, arithmetic (``|A·B|``
-bounds, two symbolic factors collapse to unbounded), returned values,
+bounds; a product of two symbolic factors distributes into product
+atoms — ``len(buckets)·len(tps)`` keys, the mesh-keyed factory-table
+bound — never collapsing to unbounded), returned values,
 and function parameters (a small fixpoint over the call graph), so
 ``len(prompt)`` laundered through a helper still arrives unbounded at
 the trace key — the blind spot RT103 cannot see. Array SHAPES propagate
@@ -68,8 +71,10 @@ join by max (one engine takes one config branch); arrays not built by a
 recognized constructor (``zeros``/``ones``/``full``/``empty``/
 ``reshape``) have shape multiplicity 1.
 
-Budget grammar: integers, ``len(<name>)`` atoms, ``+``, and
-``int * len(<name>)`` — e.g. ``len(prompt_buckets) + 3``. For a
+Budget grammar: integers, ``len(<name>)`` atoms, ``+``, and products
+of the above — ``int * len(<name>)`` or ``len(<a>) * len(<b>)`` (a
+per-mesh-shape budget: ``len(prompt_buckets) * len(tps)``) — e.g.
+``len(prompt_buckets) + 3``. For a
 BINDING method (one that assigns ``self.X = <factory>(...)``) the
 declaration bounds the method's total across everything it binds; for
 a factory DEF it bounds the programs any single call site can create.
@@ -88,8 +93,11 @@ from .callgraph import (CallGraph, ClassNode, FuncNode, self_attr,
 from .core import Finding, Module, ProjectRule
 
 #: Collections whose elements are compile-shape knobs: the repo's
-#: bucket discipline (prompt_buckets, default_buckets, ...).
-BUCKETS_RE = re.compile(r"buckets$")
+#: bucket discipline (prompt_buckets, default_buckets, ...) plus the
+#: mesh-shape discipline (ISSUE 20: ``tps`` / ``meshes`` collections —
+#: a sharded factory keyed by (bucket, tp) compiles one program per
+#: element of each, never per request).
+BUCKETS_RE = re.compile(r"(buckets|tps|meshes)$")
 
 #: Files under the compiled-program-budget discipline: factory defs and
 #: binding methods here MUST declare budgets (RT109), and dispatch
@@ -114,6 +122,18 @@ _FIXPOINT_ROUNDS = 4
 
 
 # ------------------------------------------------------------------ Card
+def _compose_atoms(a: str, b: str) -> str:
+    """Product-atom name: the sorted ``*``-join of both factor lists
+    (``"" `` is the constant term and contributes no factor), so
+    ``len(x)*len(y)`` names one atom regardless of operand order or
+    association."""
+    if not a:
+        return b
+    if not b:
+        return a
+    return "*".join(sorted(a.split("*") + b.split("*")))
+
+
 class Card:
     """A symbolic upper bound on distinct values: ``terms`` maps atom
     name -> coefficient, with the constant under ``""``; ``terms is
@@ -163,7 +183,16 @@ class Card:
             return Card({k: v * max(a, 1) for k, v in other.terms.items()})
         if b is not None:
             return Card({k: v * max(b, 1) for k, v in self.terms.items()})
-        return Card.unbounded()      # two symbolic factors: give up
+        # Two symbolic factors: distribute into product atoms (ISSUE 20
+        # — a mesh-keyed factory table is len(buckets)*len(tps) programs,
+        # a REAL bound, not "give up"). Atom names compose as the sorted
+        # "*"-join of their factors so `a*b` and `b*a` meet in leq/join.
+        out: Dict[str, int] = {}
+        for ka, va in self.terms.items():
+            for kb, vb in other.terms.items():
+                k = _compose_atoms(ka, kb)
+                out[k] = out.get(k, 0) + va * vb
+        return Card(out)
 
     def join(self, other: "Card") -> "Card":
         """Branch join: per-atom max (branch-exclusive configs — one
@@ -214,10 +243,18 @@ class Card:
 
     def evaluate(self, atoms: Dict[str, int]) -> int:
         """Numeric value given concrete atom sizes (raises KeyError on
-        a missing atom; ValueError when unbounded)."""
+        a missing atom; ValueError when unbounded). Product atoms
+        (``len(x)*len(y)``) evaluate as the product of their factors."""
         if self.is_unbounded:
             raise ValueError("unbounded budget has no numeric value")
-        return sum(v * (1 if k == "" else atoms[k])
+
+        def val(k: str) -> int:
+            out = 1
+            for f in k.split("*"):
+                out *= atoms[f]
+            return out
+
+        return sum(v * (1 if k == "" else val(k))
                    for k, v in self.terms.items())
 
     def __eq__(self, other):
@@ -230,7 +267,9 @@ class Card:
 def parse_budget(expr: str) -> Card:
     """``len(prompt_buckets) + 3`` -> :class:`Card`. Grammar: integer
     literals, ``len(<name>)`` / ``len(<obj>.<name>)`` atoms, ``+``, and
-    products with an integer. Raises ValueError on anything else."""
+    products — with an integer, or of two atoms (a mesh-keyed budget:
+    ``len(prompt_buckets) * len(tps)``). Raises ValueError on anything
+    else."""
     try:
         tree = ast.parse(expr.strip(), mode="eval").body
     except SyntaxError as e:
@@ -253,7 +292,8 @@ def parse_budget(expr: str) -> Card:
                 return Card.atom(f"len({t})")
         raise ValueError(
             f"budget expression {expr!r} must be built from integers, "
-            f"len(<name>) atoms, '+' and 'int * atom'")
+            f"len(<name>) atoms, '+', and products ('int * atom' or "
+            f"'atom * atom')")
 
     return ev(tree)
 
